@@ -1,0 +1,45 @@
+"""bifrost_tpu — a TPU-native stream-processing framework for
+high-throughput radio astronomy, with the capabilities of
+ledatelescope/bifrost re-designed for JAX/XLA.
+
+Architecture (see SURVEY.md for the reference layer map):
+
+- ring buffer runtime + thread-per-block pipeline (host side)
+- every device op is a jit-compiled function over gulp-shaped arrays
+- device memory space 'tpu' holds jax.Arrays; XLA replaces NVRTC as the
+  JIT engine; jax collectives over an ICI mesh replace point-to-point
+  GPU transports for scale-out
+
+Usage mirrors the reference::
+
+    import bifrost_tpu as bf
+    bc = bf.BlockChainer()
+    bc.blocks.read_sigproc(['obs.fil'], gulp_nframe=16384)
+    bc.blocks.copy('tpu')
+    bc.blocks.fft(axes='freq', axis_labels='fine_freq')
+    bc.blocks.detect('stokes')
+    bc.blocks.copy('system')
+    bc.blocks.write_sigproc()
+    bf.get_default_pipeline().run()
+"""
+
+__version__ = '0.1.0'
+
+from .dtype import DataType
+from .space import Space, SPACES
+from .ndarray import (ndarray, asarray, empty, zeros, empty_like, zeros_like,
+                      copy_array, memset_array)
+from .ring import (Ring, EndOfDataStop, WouldBlock, split_shape, ring_view)
+from .pipeline import (Pipeline, BlockScope, Block, SourceBlock,
+                       MultiTransformBlock, TransformBlock, SinkBlock,
+                       get_default_pipeline, get_current_block_scope,
+                       block_scope, block_view, PipelineInitError)
+from .block_chainer import BlockChainer
+from . import device
+from . import memory
+from . import proclog
+from .ops.map import map  # noqa: A001  (shadows builtin by design, like bf.map)
+
+from . import ops
+from . import blocks
+from . import views

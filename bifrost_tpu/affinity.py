@@ -1,0 +1,31 @@
+"""CPU core pinning for block threads (reference: src/affinity.cpp:1-191,
+python/bifrost/affinity.py).  Uses Linux sched_setaffinity; no-ops on
+platforms without it."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ['get_core', 'set_core', 'set_openmp_cores']
+
+
+def get_core():
+    try:
+        cores = os.sched_getaffinity(0)
+        return min(cores) if len(cores) < os.cpu_count() else -1
+    except AttributeError:  # pragma: no cover
+        return -1
+
+
+def set_core(core):
+    if core is None or core < 0:
+        return
+    try:
+        os.sched_setaffinity(0, {core})
+    except (AttributeError, OSError):  # pragma: no cover
+        pass
+
+
+def set_openmp_cores(cores):
+    os.environ['OMP_NUM_THREADS'] = str(len(cores)) \
+        if not isinstance(cores, int) else str(cores)

@@ -1,0 +1,18 @@
+"""Pipeline block library (reference: python/bifrost/blocks/__init__.py).
+
+Each block mirrors its reference namesake's tensor semantics; compute
+dispatches to numpy on host rings and jit-compiled JAX on 'tpu' rings.
+"""
+
+from .copy import CopyBlock, copy
+from .transpose import TransposeBlock, transpose
+from .fft import FftBlock, fft
+from .fftshift import FftShiftBlock, fftshift
+from .detect import DetectBlock, detect
+from .reduce import ReduceBlock, reduce
+from .accumulate import AccumulateBlock, accumulate
+from .scrunch import ScrunchBlock, scrunch
+from .reverse import ReverseBlock, reverse
+from .quantize import QuantizeBlock, quantize
+from .unpack import UnpackBlock, unpack
+from .print_header import PrintHeaderBlock, print_header

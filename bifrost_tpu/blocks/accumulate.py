@@ -1,0 +1,75 @@
+"""Integrate gulps: b = beta*b + a, committing every ``nframe`` inputs
+(reference: python/bifrost/blocks/accumulate.py:41-74).
+
+On TPU the accumulator is carried as a jax array in the block (functional
+update each gulp); the output span is only published on the commit gulp.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+from ..pipeline import TransformBlock
+from ..dtype import DataType
+from ..ops.common import complexify
+
+__all__ = ['AccumulateBlock', 'accumulate']
+
+
+class AccumulateBlock(TransformBlock):
+    def __init__(self, iring, nframe, dtype=None, gulp_nframe=1,
+                 *args, **kwargs):
+        assert gulp_nframe == 1
+        super(AccumulateBlock, self).__init__(iring, gulp_nframe=1,
+                                              *args, **kwargs)
+        self.nframe = nframe
+        self.dtype = dtype
+
+    def define_valid_input_spaces(self):
+        return ('tpu', 'system')
+
+    def on_sequence(self, iseq):
+        ihdr = iseq.header
+        ohdr = deepcopy(ihdr)
+        otensor = ohdr['_tensor']
+        if 'scales' in otensor:
+            frame_axis = otensor['shape'].index(-1)
+            otensor['scales'][frame_axis][1] *= self.nframe
+        if self.dtype is not None:
+            otensor['dtype'] = str(self.dtype)
+        self.frame_count = 0
+        self._acc = None
+        self.otype = DataType(otensor['dtype'])
+        return ohdr
+
+    def on_data(self, ispan, ospan):
+        if ispan.ring.space == 'tpu':
+            import jax.numpy as jnp
+            x = complexify(ispan.data, ispan.dtype)
+            x = x.astype(self.otype.as_jax_dtype())
+            if self.frame_count == 0 or self._acc is None:
+                self._acc = x
+            else:
+                self._acc = self._acc + x
+        else:
+            import numpy as np
+            x = ispan.data.as_numpy()
+            odt = self.otype.as_numpy_dtype()
+            if self.frame_count == 0 or self._acc is None:
+                self._acc = x.astype(odt) if odt.names is None else x.copy()
+            else:
+                self._acc = self._acc + x
+        self.frame_count += 1
+        if self.frame_count == self.nframe:
+            if ispan.ring.space == 'tpu':
+                ospan.set(self._acc)
+            else:
+                ospan.data.as_numpy()[...] = self._acc
+            self.frame_count = 0
+            return 1
+        return 0
+
+
+def accumulate(iring, nframe, dtype=None, *args, **kwargs):
+    """Block: accumulate ``nframe`` frames before outputting one."""
+    return AccumulateBlock(iring, nframe, dtype, *args, **kwargs)

@@ -1,0 +1,54 @@
+"""Space↔space mover — the H2D/D2H block (reference:
+python/bifrost/blocks/copy.py:45-71).
+
+Conversion between host storage and the device representation is defined
+in :mod:`bifrost_tpu.devrep` (bit-exact round trips; complex never
+crosses the host boundary — see xfer.py).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+from ..pipeline import TransformBlock
+from ..ndarray import copy_array
+from ..devrep import to_device_rep, from_device_rep, device_rep_zeros
+
+__all__ = ['CopyBlock', 'copy',
+           'to_device_rep', 'from_device_rep', 'device_rep_zeros']
+
+
+class CopyBlock(TransformBlock):
+    """Copy data, possibly between spaces
+    (reference: blocks/copy.py:36-58)."""
+
+    def __init__(self, iring, space=None, *args, **kwargs):
+        super(CopyBlock, self).__init__(iring, *args, **kwargs)
+        if space is None:
+            space = self.irings[0].space
+        self.orings = [self.create_ring(space=space)]
+
+    def define_valid_input_spaces(self):
+        return 'any'
+
+    def on_sequence(self, iseq):
+        return deepcopy(iseq.header)
+
+    def on_data(self, ispan, ospan):
+        ispace = ispan.ring.space
+        ospace = ospan.ring.space
+        if ospace == 'tpu' and ispace != 'tpu':
+            buf = ispan.data.as_numpy()
+            ospan.set(to_device_rep(buf, ispan.dtype))
+        elif ispace == 'tpu' and ospace != 'tpu':
+            from_device_rep(ispan.data, ospan.dtype,
+                            ospan.data.as_numpy())
+        elif ispace == 'tpu' and ospace == 'tpu':
+            ospan.set(ispan.data)
+        else:
+            copy_array(ospan.data, ispan.data)
+
+
+def copy(iring, space=None, *args, **kwargs):
+    """Block: copy data, possibly to another space."""
+    return CopyBlock(iring, space, *args, **kwargs)

@@ -1,0 +1,56 @@
+"""FFT-shift block (reference: python/bifrost/blocks/fftshift.py:37-81)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+from ..pipeline import TransformBlock
+
+__all__ = ['FftShiftBlock', 'fftshift']
+
+
+class FftShiftBlock(TransformBlock):
+    def __init__(self, iring, axes, inverse=False, *args, **kwargs):
+        super(FftShiftBlock, self).__init__(iring, *args, **kwargs)
+        if not isinstance(axes, (list, tuple)):
+            axes = [axes]
+        self.specified_axes = axes
+        self.inverse = inverse
+
+    def define_valid_input_spaces(self):
+        return ('tpu', 'system')
+
+    def on_sequence(self, iseq):
+        ihdr = iseq.header
+        itensor = ihdr['_tensor']
+        self.axes = [itensor['labels'].index(ax) if isinstance(ax, str)
+                     else ax for ax in self.specified_axes]
+        frame_axis = itensor['shape'].index(-1)
+        if frame_axis in self.axes:
+            raise KeyError("Cannot fftshift the frame axis")
+        ohdr = deepcopy(ihdr)
+        otensor = ohdr['_tensor']
+        if 'scales' in itensor:
+            for ax in self.axes:
+                sgn = +1 if self.inverse else -1
+                step = otensor['scales'][ax][1]
+                otensor['scales'][ax][0] += \
+                    sgn * (otensor['shape'][ax] // 2) * step
+        return ohdr
+
+    def on_data(self, ispan, ospan):
+        axes = self.axes
+        if ispan.ring.space == 'tpu':
+            import jax.numpy as jnp
+            fn = jnp.fft.ifftshift if self.inverse else jnp.fft.fftshift
+            ospan.set(fn(ispan.data, axes=axes))
+        else:
+            import numpy as np
+            fn = np.fft.ifftshift if self.inverse else np.fft.fftshift
+            ospan.data.as_numpy()[...] = fn(ispan.data.as_numpy(),
+                                            axes=axes)
+
+
+def fftshift(iring, axes, inverse=False, *args, **kwargs):
+    """Block: shift the zero-frequency component to the array center."""
+    return FftShiftBlock(iring, axes, inverse, *args, **kwargs)

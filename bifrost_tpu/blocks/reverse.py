@@ -1,0 +1,61 @@
+"""Axis-reversal block (reference: python/bifrost/blocks/reverse.py:36-75).
+The reference runs a bf.map gather; here it's jnp.flip under jit."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+from ..pipeline import TransformBlock
+
+__all__ = ['ReverseBlock', 'reverse']
+
+
+class ReverseBlock(TransformBlock):
+    def __init__(self, iring, axes, *args, **kwargs):
+        super(ReverseBlock, self).__init__(iring, *args, **kwargs)
+        if not isinstance(axes, (list, tuple)):
+            axes = [axes]
+        self.specified_axes = axes
+
+    def define_valid_input_spaces(self):
+        return ('tpu', 'system')
+
+    def on_sequence(self, iseq):
+        ihdr = iseq.header
+        itensor = ihdr['_tensor']
+        self.axes = [itensor['labels'].index(ax) if isinstance(ax, str)
+                     else ax for ax in self.specified_axes]
+        frame_axis = itensor['shape'].index(-1)
+        if frame_axis in self.axes:
+            raise KeyError("Cannot reverse the frame axis")
+        ohdr = deepcopy(ihdr)
+        otensor = ohdr['_tensor']
+        if 'scales' in itensor:
+            for ax in self.axes:
+                step = otensor['scales'][ax][1]
+                otensor['scales'][ax][0] += otensor['shape'][ax] * step
+                otensor['scales'][ax][1] = -step
+        return ohdr
+
+    def on_data(self, ispan, ospan):
+        # reference semantics: b(i) = a(-i), i.e. element 0 stays put and
+        # the rest reverse (a cyclic reversal), matching the map gather.
+        if ispan.ring.space == 'tpu':
+            import jax.numpy as jnp
+            x = ispan.data
+            y = x
+            for ax in self.axes:
+                y = jnp.roll(jnp.flip(y, axis=ax), 1, axis=ax)
+            ospan.set(y)
+        else:
+            import numpy as np
+            x = ispan.data.as_numpy()
+            y = x
+            for ax in self.axes:
+                y = np.roll(np.flip(y, axis=ax), 1, axis=ax)
+            ospan.data.as_numpy()[...] = y
+
+
+def reverse(iring, axes, *args, **kwargs):
+    """Block: reverse data along the given axes."""
+    return ReverseBlock(iring, axes, *args, **kwargs)

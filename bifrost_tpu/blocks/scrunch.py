@@ -1,0 +1,56 @@
+"""Time-scrunch block: average ``factor`` frames into one
+(reference: python/bifrost/blocks/scrunch.py:38-66).  Works in any space
+(the reference is system-only; the TPU path is a jitted mean)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+from ..pipeline import TransformBlock
+
+__all__ = ['ScrunchBlock', 'scrunch']
+
+
+class ScrunchBlock(TransformBlock):
+    def __init__(self, iring, factor, *args, **kwargs):
+        super(ScrunchBlock, self).__init__(iring, *args, **kwargs)
+        assert isinstance(factor, int)
+        self.factor = factor
+
+    def define_output_nframes(self, input_nframe):
+        if input_nframe % self.factor != 0:
+            raise ValueError("Scrunch factor does not divide gulp size")
+        return input_nframe // self.factor
+
+    def on_sequence(self, iseq):
+        ohdr = deepcopy(iseq.header)
+        frame_axis = ohdr['_tensor']['shape'].index(-1)
+        ohdr['_tensor']['scales'][frame_axis][1] *= self.factor
+        return ohdr
+
+    def on_data(self, ispan, ospan):
+        f = self.factor
+        if ispan.ring.space == 'tpu':
+            import jax.numpy as jnp
+            x = ispan.data
+            t = ispan.tensor
+            taxis = len(t['ringlet_shape'])
+            nf = x.shape[taxis] // f
+            shp = x.shape[:taxis] + (nf, f) + x.shape[taxis + 1:]
+            ospan.set(jnp.mean(x.reshape(shp), axis=taxis + 1,
+                               dtype=x.dtype if jnp.issubdtype(
+                                   x.dtype, jnp.inexact) else jnp.float32
+                               ).astype(x.dtype))
+        else:
+            x = ispan.data.as_numpy()
+            out = ospan.data.as_numpy()
+            taxis = len(ispan.tensor['ringlet_shape'])
+            nf = x.shape[taxis] // f
+            shp = x.shape[:taxis] + (nf, f) + x.shape[taxis + 1:]
+            out[...] = x.reshape(shp).mean(axis=taxis + 1).astype(out.dtype)
+        return ispan.nframe // f
+
+
+def scrunch(iring, factor, *args, **kwargs):
+    """Block: average ``factor`` incoming frames into one output frame."""
+    return ScrunchBlock(iring, factor, *args, **kwargs)

@@ -1,0 +1,53 @@
+"""Axis permutation block (reference:
+python/bifrost/blocks/transpose.py:41-83)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+import numpy as np
+
+from ..pipeline import TransformBlock
+from .. import ops
+
+__all__ = ['TransposeBlock', 'transpose']
+
+
+class TransposeBlock(TransformBlock):
+    def __init__(self, iring, axes, *args, **kwargs):
+        super(TransposeBlock, self).__init__(iring, *args, **kwargs)
+        self.specified_axes = axes
+        self.space = self.orings[0].space
+
+    def on_sequence(self, iseq):
+        ihdr = iseq.header
+        itensor = ihdr['_tensor']
+        if 'labels' in itensor:
+            labels = itensor['labels']
+            self.axes = [labels.index(ax) if isinstance(ax, str) else ax
+                         for ax in self.specified_axes]
+        else:
+            self.axes = list(self.specified_axes)
+        ohdr = deepcopy(ihdr)
+        otensor = ohdr['_tensor']
+        for item in ('shape', 'labels', 'scales', 'units'):
+            if item in itensor:
+                otensor[item] = [itensor[item][ax] for ax in self.axes]
+        return ohdr
+
+    def on_data(self, ispan, ospan):
+        if self.space == 'tpu':
+            import jax.numpy as jnp
+            arr = ispan.data
+            axes = list(self.axes)
+            if arr.ndim == len(axes) + 1:   # trailing re/im pair axis
+                axes = axes + [len(axes)]
+            ospan.set(jnp.transpose(arr, axes))
+        else:
+            ospan.data.as_numpy()[...] = np.transpose(
+                ispan.data.as_numpy(), self.axes)
+
+
+def transpose(iring, axes, *args, **kwargs):
+    """Block: transpose (permute) axes of the data stream."""
+    return TransposeBlock(iring, axes, *args, **kwargs)

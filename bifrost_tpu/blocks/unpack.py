@@ -1,0 +1,46 @@
+"""Unpack block: packed sub-byte / complex-int -> int8/float
+(reference: python/bifrost/blocks/unpack.py)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+from ..pipeline import TransformBlock
+from ..dtype import DataType
+from .. import ops
+from ..ops.common import complexify
+
+__all__ = ['UnpackBlock', 'unpack']
+
+
+class UnpackBlock(TransformBlock):
+    def __init__(self, iring, dtype, *args, **kwargs):
+        super(UnpackBlock, self).__init__(iring, *args, **kwargs)
+        self.dtype = DataType(dtype)
+
+    def on_sequence(self, iseq):
+        ohdr = deepcopy(iseq.header)
+        ohdr['_tensor']['dtype'] = str(self.dtype)
+        return ohdr
+
+    def on_data(self, ispan, ospan):
+        if ispan.ring.space == 'tpu':
+            import jax.numpy as jnp
+            x = ispan.data
+            dt = self.dtype
+            if dt.kind == 'ci':
+                # keep int-pair device representation at the new width
+                comp = jnp.int8 if dt.nbits <= 8 else (
+                    jnp.int16 if dt.nbits == 16 else jnp.int32)
+                ospan.set(x.astype(comp))
+            elif dt.kind == 'cf':
+                ospan.set(complexify(x, ispan.dtype))
+            else:
+                ospan.set(x.astype(dt.as_jax_dtype()))
+        else:
+            ops.unpack(ispan.data, ospan.data)
+
+
+def unpack(iring, dtype, *args, **kwargs):
+    """Block: unpack packed data to a wider dtype."""
+    return UnpackBlock(iring, dtype, *args, **kwargs)

@@ -1,0 +1,75 @@
+"""Device management for the TPU build.
+
+The reference exposes set_device / get_device / stream_synchronize over a
+thread-local CUDA stream (reference: src/cuda.cpp:34-99,
+python/bifrost/device.py:33-95).  JAX's execution model is different in a
+way that *favours* the bifrost pipeline design: every op dispatch is
+already asynchronous (the TPU runtime pipelines transfers + compute), so
+the per-gulp ``stream_synchronize()`` maps to ``block_until_ready`` on the
+arrays produced in that gulp — or to nothing at all, since a downstream
+consumer forces the value when it needs it.
+
+Threads select a device with :func:`set_device`; ops read
+:func:`get_device` when placing new arrays.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_tls = threading.local()
+
+
+def _devices():
+    import jax
+    return jax.devices()
+
+
+def set_device(device):
+    """Bind this thread to a device (reference: bfDeviceSet, src/cuda.cpp).
+    Accepts an int index or a jax Device."""
+    if device is None:
+        _tls.device = None
+        return
+    if isinstance(device, int):
+        device = _devices()[device]
+    _tls.device = device
+
+
+def get_device():
+    """The jax Device bound to this thread (default device if unset)."""
+    dev = getattr(_tls, 'device', None)
+    if dev is None:
+        dev = _devices()[0]
+    return dev
+
+
+def get_device_index():
+    return get_device().id
+
+
+def stream_synchronize(*arrays):
+    """Wait for async work. With arguments, blocks until those arrays are
+    ready; with no arguments this is a no-op by design — JAX data
+    dependencies give the ordering the reference got from
+    cudaStreamSynchronize (reference: pipeline.py:628)."""
+    import jax
+    for a in arrays:
+        if hasattr(a, 'as_jax') and a.space == 'tpu':
+            a = a.data
+        if isinstance(a, jax.Array):
+            a.block_until_ready()
+
+
+class ExternalStream(object):
+    """No-op context manager kept for API compatibility with the
+    reference's cupy/pycuda interop (reference: device.py:56-84)."""
+
+    def __init__(self, stream=None):
+        self.stream = stream
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,241 @@
+"""Bifrost-style data type system for the TPU build.
+
+Mirrors the semantics of the reference DataType (reference:
+python/bifrost/DataType.py:62-109): a type is ``kind`` + ``nbits`` where
+kind is one of
+
+- ``i``  : signed integer
+- ``u``  : unsigned integer
+- ``f``  : floating point
+- ``ci`` : complex signed integer (nbits per real component)
+- ``cf`` : complex floating point (nbits per real component)
+
+and nbits is the bit width of one *real component* (so ``ci4`` packs a
+4-bit re + 4-bit im pair into one byte, ``cf32`` is numpy complex64).
+Sub-byte types (i1/i2/i4/u1/u2/u4/ci4) are stored packed, little-endian
+within the byte, exactly as the reference packs them (reference:
+python/bifrost/DataType.py:55-60 custom dtypes; src/unpack.cpp).
+
+On device (space='tpu') the canonical unpacked representations are:
+
+- integer kinds -> jnp int8/int16/int32
+- float kinds   -> jnp float32/float16/bfloat16
+- complex kinds -> jnp complex64/complex128 (ci* promoted)
+
+with the exception of the MXU int8 fast path used by linalg, which keeps
+ci8 as an int8 array with a trailing (re, im) axis of length 2.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['DataType']
+
+# Structured numpy dtypes for complex-integer / complex-half types, matching
+# the reference's custom dtypes (reference: python/bifrost/DataType.py:55-60).
+ci4 = np.dtype([('re_im', np.uint8)])   # 4-bit re in high nibble, im low
+ci8 = np.dtype([('re', np.int8), ('im', np.int8)])
+ci16 = np.dtype([('re', np.int16), ('im', np.int16)])
+ci32 = np.dtype([('re', np.int32), ('im', np.int32)])
+cf16 = np.dtype([('re', np.float16), ('im', np.float16)])
+
+_KINDS = ('i', 'u', 'f', 'ci', 'cf')
+
+_FROM_NUMPY = {
+    np.dtype(np.int8): ('i', 8), np.dtype(np.int16): ('i', 16),
+    np.dtype(np.int32): ('i', 32), np.dtype(np.int64): ('i', 64),
+    np.dtype(np.uint8): ('u', 8), np.dtype(np.uint16): ('u', 16),
+    np.dtype(np.uint32): ('u', 32), np.dtype(np.uint64): ('u', 64),
+    np.dtype(np.float16): ('f', 16), np.dtype(np.float32): ('f', 32),
+    np.dtype(np.float64): ('f', 64),
+    np.dtype(np.complex64): ('cf', 32), np.dtype(np.complex128): ('cf', 64),
+    ci8: ('ci', 8), ci16: ('ci', 16), ci32: ('ci', 32), cf16: ('cf', 16),
+    ci4: ('ci', 4),
+}
+
+_TO_NUMPY = {
+    ('i', 8): np.dtype(np.int8), ('i', 16): np.dtype(np.int16),
+    ('i', 32): np.dtype(np.int32), ('i', 64): np.dtype(np.int64),
+    ('u', 8): np.dtype(np.uint8), ('u', 16): np.dtype(np.uint16),
+    ('u', 32): np.dtype(np.uint32), ('u', 64): np.dtype(np.uint64),
+    ('f', 16): np.dtype(np.float16), ('f', 32): np.dtype(np.float32),
+    ('f', 64): np.dtype(np.float64),
+    ('cf', 16): cf16, ('cf', 32): np.dtype(np.complex64),
+    ('cf', 64): np.dtype(np.complex128),
+    ('ci', 8): ci8, ('ci', 16): ci16, ('ci', 32): ci32, ('ci', 4): ci4,
+}
+
+try:
+    import ml_dtypes as _ml_dtypes
+    bf16 = np.dtype(_ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _ml_dtypes = None
+    bf16 = None
+
+
+class DataType(object):
+    """kind + nbits type tag. Construct from a string ('ci8', 'f32', ...),
+    a numpy dtype, a python scalar type, or another DataType."""
+
+    __slots__ = ('kind', 'nbits', 'veclen')
+
+    def __init__(self, t='f32', veclen=1):
+        if isinstance(t, DataType):
+            self.kind, self.nbits, self.veclen = t.kind, t.nbits, t.veclen
+            return
+        if isinstance(t, str):
+            s = t
+            # vector suffix e.g. 'f32_x2'
+            if '_x' in s:
+                s, _, v = s.partition('_x')
+                veclen = int(v)
+            kind = ''
+            while s and s[0].isalpha():
+                kind += s[0]
+                s = s[1:]
+            if kind in _KINDS and s.isdigit():
+                self.kind, self.nbits, self.veclen = kind, int(s), veclen
+                return
+            # fall through: maybe a numpy name like 'float32'
+            t = np.dtype(t)
+        if t in (int,):
+            t = np.dtype(np.int64)
+        elif t in (float,):
+            t = np.dtype(np.float64)
+        elif t in (complex,):
+            t = np.dtype(np.complex128)
+        try:
+            npt = np.dtype(t)
+        except TypeError:
+            # jax dtypes (e.g. bfloat16) expose .dtype / are dtype-like
+            npt = np.dtype(getattr(t, 'dtype', t))
+        if bf16 is not None and npt == bf16:
+            self.kind, self.nbits, self.veclen = 'f', 16, veclen
+            return
+        if npt not in _FROM_NUMPY:
+            raise TypeError("Unsupported dtype: %r" % (t,))
+        self.kind, self.nbits = _FROM_NUMPY[npt]
+        self.veclen = veclen
+
+    # ---- identity ----
+    def __str__(self):
+        s = '%s%d' % (self.kind, self.nbits)
+        if self.veclen != 1:
+            s += '_x%d' % self.veclen
+        return s
+
+    def __repr__(self):
+        return "DataType('%s')" % (self,)
+
+    def __eq__(self, other):
+        try:
+            other = DataType(other)
+        except (TypeError, ValueError):
+            return NotImplemented
+        return (self.kind, self.nbits, self.veclen) == \
+               (other.kind, other.nbits, other.veclen)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __hash__(self):
+        return hash((self.kind, self.nbits, self.veclen))
+
+    # ---- classification ----
+    @property
+    def is_complex(self):
+        return self.kind in ('ci', 'cf')
+
+    @property
+    def is_real(self):
+        return not self.is_complex
+
+    @property
+    def is_floating_point(self):
+        return self.kind in ('f', 'cf')
+
+    @property
+    def is_integer(self):
+        return self.kind in ('i', 'u', 'ci')
+
+    @property
+    def is_signed(self):
+        return self.kind in ('i', 'ci', 'f', 'cf')
+
+    # ---- sizes ----
+    @property
+    def itemsize_bits(self):
+        """Total bits per element (both components of a complex)."""
+        return self.nbits * (2 if self.is_complex else 1) * self.veclen
+
+    @property
+    def itemsize(self):
+        """Bytes per element; raises for packed sub-byte types."""
+        nbit = self.itemsize_bits
+        if nbit % 8:
+            raise ValueError("%s is a packed sub-byte type" % self)
+        return nbit // 8
+
+    @property
+    def is_packed(self):
+        """True for types whose element is smaller than one byte
+        (i1/i2/i4/u1/u2/u4/ci1/ci2/ci4), stored bit-packed."""
+        return self.itemsize_bits < 8
+
+    # ---- conversions ----
+    def as_numpy_dtype(self):
+        """Unpacked host (numpy) dtype. Packed types report their
+        byte-storage dtype of uint8; use ops.unpack to expand them."""
+        if self.veclen != 1:
+            base = DataType('%s%d' % (self.kind, self.nbits))
+            return np.dtype((base.as_numpy_dtype(), (self.veclen,)))
+        key = (self.kind, self.nbits)
+        if key in _TO_NUMPY:
+            return _TO_NUMPY[key]
+        if self.is_packed:
+            return np.dtype(np.uint8)
+        raise TypeError("No numpy equivalent for %s" % self)
+
+    def as_jax_dtype(self):
+        """Canonical unpacked device dtype (see module docstring)."""
+        if self.kind == 'cf':
+            return np.complex128 if self.nbits > 32 else np.complex64
+        if self.kind == 'ci':
+            return np.complex64 if self.nbits <= 16 else np.complex128
+        if self.kind == 'f':
+            return {16: np.float16, 32: np.float32, 64: np.float64}[self.nbits]
+        if self.kind == 'i':
+            return {8: np.int8, 16: np.int16, 32: np.int32,
+                    64: np.int32}.get(max(self.nbits, 8), np.int32)
+        if self.kind == 'u':
+            return {8: np.uint8, 16: np.uint16,
+                    32: np.uint32}.get(max(self.nbits, 8), np.uint32)
+        raise TypeError("No jax equivalent for %s" % self)
+
+    def as_floating_point(self):
+        """Promote to the smallest floating-point type that can represent
+        this type (reference: python/bifrost/DataType.py as_floating_point)."""
+        if self.is_floating_point:
+            return self
+        nbits = 32 if self.nbits <= 16 else 64
+        kind = 'cf' if self.is_complex else 'f'
+        return DataType('%s%d' % (kind, nbits))
+
+    def as_real(self):
+        if not self.is_complex:
+            return self
+        return DataType('%s%d' % (self.kind[1:], self.nbits))
+
+    def as_complex(self):
+        if self.is_complex:
+            return self
+        if self.kind == 'u':
+            raise TypeError("No complex-unsigned types")
+        return DataType('c%s%d' % (self.kind, self.nbits))
+
+    def as_vector(self, veclen):
+        return DataType('%s%d' % (self.kind, self.nbits), veclen)
+
+    def as_nbit(self, nbits):
+        return DataType('%s%d' % (self.kind, nbits), self.veclen)

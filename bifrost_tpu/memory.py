@@ -1,0 +1,42 @@
+"""Memory helpers: the space lattice plus alloc/copy primitives.
+
+Reference equivalents: python/bifrost/memory.py:37-101 and the native
+memory core src/memory.cpp:94-230.  On TPU there is no raw device pointer
+to hand out — HBM is owned by the XLA runtime — so raw_malloc returns
+host buffers and device 'allocation' happens by constructing jax arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .space import space_accessible, canonical, Space, SPACES  # noqa: F401
+from .ndarray import copy_array, memset_array  # noqa: F401
+
+#: Alignment used for host ring allocations; matches the reference default
+#: BF_ALIGNMENT=512 (reference: src/memory.cpp:334-351).
+ALIGNMENT = 512
+
+
+def raw_malloc(size, space='system'):
+    """Allocate ``size`` bytes in a host space, returned as a uint8 numpy
+    array aligned to ALIGNMENT (reference: bfMalloc, src/memory.cpp:110)."""
+    space = canonical(space)
+    if space == 'tpu':
+        raise ValueError("Raw device allocation is managed by XLA; "
+                         "allocate with bifrost_tpu.empty(space='tpu')")
+    buf = np.empty(size + ALIGNMENT, dtype=np.uint8)
+    off = (-buf.ctypes.data) % ALIGNMENT
+    return buf[off:off + size]
+
+
+def memcpy(dst, src):
+    """Byte copy between host buffers (reference: bfMemcpy,
+    src/memory.cpp:163)."""
+    dst[...] = src
+    return dst
+
+
+def memset(buf, value=0):
+    buf[...] = value
+    return buf

@@ -1,0 +1,303 @@
+"""Space-aware ndarray for the TPU build.
+
+The reference's ``bf.ndarray`` is a numpy subclass carrying
+(space, dtype, native, conjugated) metadata and device pointers
+(reference: python/bifrost/ndarray.py:120-166).  On TPU, device data is a
+``jax.Array`` — immutable, asynchronously computed, and owned by the XLA
+runtime — so instead of a pointer-carrying numpy subclass this build uses a
+thin wrapper that holds either
+
+- a ``numpy.ndarray``  (space 'system' / 'tpu_host'), or
+- a ``jax.Array``      (space 'tpu')
+
+plus a :class:`bifrost_tpu.dtype.DataType`.  Copies between spaces go
+through ``jax.device_put`` / ``np.asarray`` (zero-copy where XLA allows,
+reference equivalent: bfMemcpy, src/memory.cpp:163-230).
+
+Packed sub-byte dtypes (i4/ci4/u2/...) store a uint8 byte buffer whose last
+axis is ``ceil(shape[-1] * nbits_per_element / 8)`` bytes; ``shape`` always
+reports *logical* elements (reference: ndarray.py:311-337 packed shape
+handling).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .dtype import DataType
+from .space import Space, canonical
+
+__all__ = ['ndarray', 'asarray', 'empty', 'zeros', 'empty_like', 'zeros_like',
+           'copy_array', 'memset_array']
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _packed_byte_shape(shape, dtype):
+    """Byte-buffer shape for a packed logical shape."""
+    shape = tuple(shape)
+    nbit = dtype.itemsize_bits
+    if not shape:
+        raise ValueError("Packed dtypes require ndim >= 1")
+    last_bits = shape[-1] * nbit
+    if last_bits % 8:
+        raise ValueError("Last axis of a packed %s array must span whole "
+                         "bytes (got %d bits)" % (dtype, last_bits))
+    return shape[:-1] + (last_bits // 8,)
+
+
+class ndarray(object):
+    """Space-tagged array. See module docstring."""
+
+    __slots__ = ('_buf', '_space', '_dtype', '_shape', 'native', 'conjugated')
+
+    def __init__(self, buf, dtype=None, space=None, shape=None,
+                 native=True, conjugated=False):
+        if isinstance(buf, ndarray):
+            dtype = dtype or buf._dtype
+            space = space or buf._space
+            shape = shape if shape is not None else buf._shape
+            buf = buf._buf
+        self._dtype = DataType(dtype) if dtype is not None else None
+        import jax
+        if isinstance(buf, jax.Array):
+            self._space = 'tpu' if space is None else canonical(space)
+            if self._dtype is None:
+                self._dtype = DataType(np.dtype(buf.dtype))
+        else:
+            buf = np.asarray(buf)
+            self._space = 'system' if space is None else canonical(space)
+            if self._dtype is None:
+                self._dtype = DataType(buf.dtype)
+        self._buf = buf
+        if shape is not None:
+            self._shape = tuple(shape)
+        elif self._dtype.is_packed:
+            raise ValueError("Must pass logical `shape` for packed dtype %s"
+                             % self._dtype)
+        else:
+            self._shape = tuple(buf.shape)
+        self.native = native
+        self.conjugated = conjugated
+
+    # ---- metadata ----
+    @property
+    def space(self):
+        return self._space
+
+    @property
+    def bf_dtype(self):
+        return self._dtype
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape)) if self._shape else 1
+
+    @property
+    def nbytes(self):
+        return self.size * self._dtype.itemsize_bits // 8
+
+    @property
+    def data(self):
+        """The underlying numpy.ndarray or jax.Array."""
+        return self._buf
+
+    # ---- conversion ----
+    def as_numpy(self):
+        """Host numpy view/copy of the raw storage (packed types stay
+        packed; complex-int types keep their structured dtype)."""
+        if self._space == 'tpu':
+            from .xfer import to_host
+            return to_host(self._buf)
+        return self._buf
+
+    def as_jax(self):
+        """Device array. Packed and complex-int types are returned in their
+        raw storage form (uint8 / trailing re-im axis); use ops.unpack /
+        ops.quantize for value conversion."""
+        if self._space == 'tpu':
+            return self._buf
+        buf = self._buf
+        if buf.dtype.names is not None:  # structured ci8/ci16/ci32/cf16
+            buf = buf.view(buf.dtype[0]).reshape(buf.shape + (2,))
+        from .xfer import to_device
+        return to_device(buf)
+
+    def __array__(self, dtype=None):
+        a = self.as_numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def copy(self, space=None):
+        """Copy to ``space`` (default: same space).  The H2D/D2H mover —
+        reference equivalent bfArrayCopy (src/array.cpp:59)."""
+        space = self._space if space is None else canonical(space)
+        if space == 'tpu':
+            buf = self.as_jax()
+            if self._space == 'tpu':
+                buf = _jax().numpy.copy(buf)
+        else:
+            buf = np.array(self.as_numpy(), copy=True)
+        return ndarray(buf, dtype=self._dtype, space=space, shape=self._shape,
+                       native=self.native, conjugated=self.conjugated)
+
+    def astype(self, dtype):
+        from . import ops
+        return ops.astype(self, dtype)
+
+    # ---- element access (host spaces delegate to numpy; device arrays
+    #      support read-only indexing through jax) ----
+    def __getitem__(self, idx):
+        sub = self._buf[idx] if not self._dtype.is_packed else None
+        if sub is None:
+            raise TypeError("Indexing packed arrays is not supported; "
+                            "unpack first (ops.unpack)")
+        return sub
+
+    def __setitem__(self, idx, value):
+        if self._space == 'tpu':
+            if isinstance(value, ndarray):
+                value = value.as_jax()
+            self._buf = self._buf.at[idx].set(value)
+            return
+        if isinstance(value, ndarray):
+            value = value.as_numpy()
+        self._buf[idx] = value
+
+    def __len__(self):
+        return self._shape[0]
+
+    def __repr__(self):
+        return ("ndarray(space=%r, dtype=%s, shape=%s)\n%r"
+                % (self._space, self._dtype, self._shape, self._buf))
+
+
+def empty(shape, dtype='f32', space='system'):
+    dtype = DataType(dtype)
+    space = canonical(space)
+    if dtype.is_packed:
+        store_shape, store_dtype = _packed_byte_shape(shape, dtype), np.uint8
+    else:
+        store_shape, store_dtype = tuple(shape), dtype.as_numpy_dtype()
+    if space == 'tpu':
+        jnp = _jax().numpy
+        if np.dtype(store_dtype).names is not None:
+            store_dtype = dtype.as_jax_dtype()
+        buf = jnp.empty(store_shape, dtype=store_dtype)
+    else:
+        buf = np.empty(store_shape, dtype=store_dtype)
+    return ndarray(buf, dtype=dtype, space=space, shape=tuple(shape))
+
+
+def zeros(shape, dtype='f32', space='system'):
+    a = empty(shape, dtype, space)
+    memset_array(a, 0)
+    return a
+
+
+def empty_like(other, space=None):
+    return empty(other.shape, other.dtype,
+                 other.space if space is None else space)
+
+
+def zeros_like(other, space=None):
+    return zeros(other.shape, other.dtype,
+                 other.space if space is None else space)
+
+
+def asarray(obj, space=None, dtype=None):
+    """Wrap/convert ``obj`` into a bifrost_tpu.ndarray in ``space``."""
+    import jax
+    if isinstance(obj, ndarray):
+        if space is None or canonical(space) == obj.space:
+            return obj
+        return obj.copy(space=space)
+    if isinstance(obj, jax.Array):
+        a = ndarray(obj, dtype=dtype, space='tpu')
+        if space is not None and canonical(space) != 'tpu':
+            return a.copy(space=space)
+        return a
+    buf = np.asarray(obj)
+    shape = None
+    if dtype is not None:
+        dt = DataType(dtype)
+        if dt.is_packed:
+            # Interpret ``obj`` as the byte storage of a packed array and
+            # derive the logical shape from it.
+            if buf.dtype != np.uint8:
+                buf = buf.view(np.uint8)
+            shape = buf.shape[:-1] + \
+                (buf.shape[-1] * 8 // dt.itemsize_bits,)
+        elif dt.as_numpy_dtype() != buf.dtype:
+            if dt.as_numpy_dtype().names is not None:
+                buf = buf.view(dt.as_numpy_dtype()).reshape(
+                    buf.shape[:-1] + (-1,)) \
+                    if buf.dtype == np.uint8 else buf
+            else:
+                buf = buf.astype(dt.as_numpy_dtype())
+    a = ndarray(buf, dtype=dtype, space='system', shape=shape)
+    if space is not None and canonical(space) != 'system':
+        return a.copy(space=space)
+    return a
+
+
+def copy_array(dst, src):
+    """Copy ``src`` into ``dst`` across spaces (reference: bfArrayCopy,
+    src/array.cpp:59; python/bifrost/ndarray.py:96-112).  Returns dst."""
+    if not isinstance(dst, ndarray):
+        raise TypeError("dst must be a bifrost_tpu.ndarray")
+    if isinstance(src, ndarray):
+        if src.shape != dst.shape:
+            raise ValueError("Shape mismatch: %s vs %s"
+                             % (src.shape, dst.shape))
+        sbuf = src.as_jax() if dst.space == 'tpu' else src.as_numpy()
+    else:
+        sbuf = src
+    if dst.space == 'tpu':
+        from .xfer import to_device
+        import jax
+        jbuf = sbuf if isinstance(sbuf, jax.Array) else to_device(sbuf)
+        if jbuf.dtype != dst._buf.dtype:
+            jbuf = jbuf.astype(dst._buf.dtype)
+        if tuple(jbuf.shape) != tuple(dst._buf.shape):
+            jbuf = jbuf.reshape(dst._buf.shape)
+        dst._buf = jbuf
+    else:
+        import jax
+        if isinstance(sbuf, jax.Array):
+            from .xfer import to_host
+            nbuf = to_host(sbuf)
+        else:
+            nbuf = np.asarray(sbuf)
+        if nbuf.dtype != dst._buf.dtype and dst._buf.dtype.names is None:
+            nbuf = nbuf.astype(dst._buf.dtype)
+        dst._buf[...] = nbuf.reshape(dst._buf.shape) \
+            if nbuf.dtype == dst._buf.dtype else nbuf
+    return dst
+
+
+def memset_array(a, value=0):
+    """Fill ``a`` with a byte/scalar value (reference: bfArrayMemset,
+    src/array.cpp:102)."""
+    if a.space == 'tpu':
+        a._buf = _jax().numpy.full(a._buf.shape, value, dtype=a._buf.dtype)
+    else:
+        if a._buf.dtype.names is not None:
+            a._buf.view(a._buf.dtype[0])[...] = value
+        else:
+            a._buf[...] = value
+    return a

@@ -1,0 +1,10 @@
+"""JAX/XLA compute operators — the TPU equivalents of the reference's
+CUDA kernel library (reference inventory: SURVEY.md §2.2)."""
+
+from .common import as_jax, as_logical_numpy, astype, logical_dtype
+from .map import map, map_compute, clear_map_cache
+from .fft import Fft, fft
+from .linalg import LinAlg, matmul
+from .reduce import reduce
+from .transpose import transpose
+from .quantize import quantize, unpack

@@ -1,0 +1,76 @@
+"""Shared helpers for the op library."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..dtype import DataType
+
+__all__ = ['as_jax', 'as_logical_numpy', 'logical_dtype', 'astype',
+           'complexify']
+
+
+def complexify(arr, dtype):
+    """Convert a device-representation array (int pairs for ci*) into a
+    complex jnp array; no-op for already-complex/real data."""
+    import jax.numpy as jnp
+    dtype = DataType(dtype)
+    if dtype.kind == 'ci' and arr.shape and arr.shape[-1] == 2 and \
+            not jnp.issubdtype(arr.dtype, jnp.complexfloating):
+        re = arr[..., 0].astype(jnp.float32)
+        im = arr[..., 1].astype(jnp.float32)
+        return (re + 1j * im).astype(jnp.complex64)
+    return arr
+
+
+def logical_dtype(x):
+    """DataType of x's logical values (complex-int -> cf32 etc.)."""
+    from ..ndarray import ndarray as bf_ndarray
+    if isinstance(x, bf_ndarray):
+        return x.dtype
+    return DataType(np.dtype(getattr(x, 'dtype', type(x))))
+
+
+def as_jax(x):
+    """Convert any supported array (bf ndarray incl. packed/complex-int,
+    numpy, jax) to a logical-valued jax array."""
+    import jax
+    from ..ndarray import ndarray as bf_ndarray
+    from ..xfer import to_device
+    from .map import _to_logical
+    if isinstance(x, bf_ndarray):
+        if x.space == 'tpu':
+            return x.data
+        dt = x.dtype
+        return to_device(_to_logical(
+            x.as_numpy(), DataType('%s%d' % (dt.kind, dt.nbits))))
+    if isinstance(x, jax.Array):
+        return x
+    arr = np.asarray(x)
+    if arr.dtype.names is not None:
+        return to_device(_to_logical(arr, DataType(arr.dtype)))
+    return to_device(arr)
+
+
+def as_logical_numpy(x):
+    import jax
+    from ..xfer import to_host
+    v = x
+    if not isinstance(v, jax.Array):
+        v = as_jax(v)
+    return to_host(v)
+
+
+def astype(x, dtype):
+    """Space-preserving dtype conversion (reference: ndarray.py:373-395
+    GPU astype via bfMap)."""
+    from ..ndarray import ndarray as bf_ndarray, asarray
+    from .map import _from_logical
+    dtype = DataType(dtype)
+    arr = as_jax(x)
+    if isinstance(x, bf_ndarray) and x.space == 'tpu':
+        return bf_ndarray(arr.astype(dtype.as_jax_dtype()), dtype=dtype,
+                          space='tpu', shape=x.shape)
+    res = _from_logical(np.asarray(arr), dtype)
+    shape = x.shape if hasattr(x, 'shape') else res.shape
+    return bf_ndarray(res, dtype=dtype, space='system', shape=tuple(shape))

@@ -1,0 +1,136 @@
+"""Batched linear algebra on the MXU (reference: src/linalg.cu:877-904,
+src/linalg_kernels.cu; python/bifrost/linalg.py).
+
+Two operations, mirroring bfLinAlgMatMul:
+
+- ``c = alpha * a @ b + beta * c``      (beamforming GEMM)
+- ``c = alpha * a @ a^H + beta * c``    (correlation, when b is None)
+
+The reference ships custom xGPU-style small-N kernels and a Cherk3mEx
+int8 path (reference: src/linalg.cu:130-148, 210-226).  On TPU the MXU
+natively multiplies int8 with int32 accumulation, so the complex-int8
+correlation is expressed as real int8 matmuls via the 3-multiply (Karatsuba)
+trick — the same trick Cherk3mEx uses — with
+``preferred_element_type=int32``, then scaled into the output dtype.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..dtype import DataType
+from .common import as_jax, logical_dtype
+from .fft import _writeback
+
+__all__ = ['LinAlg', 'matmul']
+
+
+def _int8_reim(x):
+    """Extract (re, im) int8 arrays from a ci8 bf ndarray without promoting
+    to complex — keeps the MXU int8 path honest."""
+    from ..ndarray import ndarray as bf_ndarray
+    import jax.numpy as jnp
+    if isinstance(x, bf_ndarray) and x.dtype.kind == 'ci' \
+            and x.dtype.nbits == 8:
+        if x.space == 'tpu':
+            arr = x.data  # trailing (re, im) axis of length 2, int8
+            if arr.dtype == jnp.int8 and arr.shape[-1] == 2:
+                return arr[..., 0], arr[..., 1]
+            return None
+        buf = x.as_numpy()
+        return jnp.asarray(buf['re']), jnp.asarray(buf['im'])
+    return None
+
+
+class LinAlg(object):
+    """Plan-style wrapper (reference: python/bifrost/linalg.py)."""
+
+    def __init__(self):
+        import jax
+        self._jit_ab = jax.jit(self._ab, static_argnames=('alpha', 'beta'))
+        self._jit_aah = jax.jit(self._aah, static_argnames=('alpha', 'beta'))
+        self._jit_aah_i8 = jax.jit(self._aah_int8,
+                                   static_argnames=('alpha', 'beta'))
+
+    @staticmethod
+    def _ab(a, b, c, alpha, beta):
+        import jax.numpy as jnp
+        acc = jnp.complex64 if jnp.iscomplexobj(a) or jnp.iscomplexobj(b) \
+            else jnp.float32
+        y = alpha * jnp.matmul(a, b, preferred_element_type=acc)
+        if beta != 0 and c is not None:
+            y = y + beta * c
+        return y
+
+    @staticmethod
+    def _aah(a, c, alpha, beta):
+        import jax.numpy as jnp
+        y = alpha * jnp.matmul(a, jnp.conj(jnp.swapaxes(a, -1, -2)),
+                               preferred_element_type=jnp.complex64)
+        if beta != 0 and c is not None:
+            y = y + beta * c
+        return y
+
+    @staticmethod
+    def _aah_int8(re, im, c, alpha, beta):
+        """Complex Hermitian rank-k update from int8 re/im planes with
+        three real int8 MXU matmuls, int32 accumulation:
+
+            A A^H = (re·reᵀ + im·imᵀ) + i(K - Kᵀ),   K = im·reᵀ
+
+        The Hermitian structure makes the cross term a single multiply —
+        the same reduction the reference's Cherk3mEx exploits
+        (reference: src/linalg.cu:130-148)."""
+        import jax.numpy as jnp
+        reT = jnp.swapaxes(re, -1, -2)
+        imT = jnp.swapaxes(im, -1, -2)
+        rr = jnp.matmul(re, reT, preferred_element_type=jnp.int32)
+        ii = jnp.matmul(im, imT, preferred_element_type=jnp.int32)
+        k = jnp.matmul(im, reT, preferred_element_type=jnp.int32)
+        y = (rr + ii).astype(jnp.float32) + \
+            1j * (k - jnp.swapaxes(k, -1, -2)).astype(jnp.float32)
+        y = alpha * y
+        if beta != 0 and c is not None:
+            y = y + beta * c
+        return y
+
+    def matmul(self, alpha, a, b, beta, c):
+        """c = alpha*a@b + beta*c, or a@a^H when b is None
+        (reference: bfLinAlgMatMul, src/linalg.cu:877)."""
+        alpha = complex(alpha) if np.iscomplexobj(np.asarray(alpha)) \
+            else float(alpha)
+        beta = complex(beta) if np.iscomplexobj(np.asarray(beta)) \
+            else float(beta)
+        cj = as_jax(c) if (c is not None and beta != 0) else None
+        if b is None:
+            reim = _int8_reim(a)
+            if reim is not None:
+                y = self._jit_aah_i8(reim[0], reim[1], cj,
+                                     alpha=alpha, beta=beta)
+            else:
+                aj = as_jax(a)
+                y = self._jit_aah(aj, cj, alpha=alpha, beta=beta)
+        else:
+            aj, bj = as_jax(a), as_jax(b)
+            y = self._jit_ab(aj, bj, cj, alpha=alpha, beta=beta)
+        if c is not None:
+            odt = logical_dtype(c)
+            import jax.numpy as jnp
+            tgt = jnp.dtype(odt.as_jax_dtype())
+            if y.dtype != tgt:
+                if not np.issubdtype(tgt, np.complexfloating) and \
+                        np.issubdtype(y.dtype, np.complexfloating):
+                    y = y.real
+                y = y.astype(tgt)
+            return _writeback(y, c)
+        return y
+
+
+_default = None
+
+
+def matmul(alpha, a, b, beta, c):
+    global _default
+    if _default is None:
+        _default = LinAlg()
+    return _default.matmul(alpha, a, b, beta, c)

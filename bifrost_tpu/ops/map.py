@@ -1,0 +1,856 @@
+"""bf.map: user-defined array transformations, JIT-compiled for TPU.
+
+``map(func_string, data, ...)`` evaluates a C-like elementwise/ND-indexed
+expression over arrays (see map_lang for the language).  The reference
+implements this with runtime CUDA codegen + NVRTC (reference:
+src/map.cpp:630-797); here the AST is evaluated with jax.numpy inside
+``jax.jit`` so XLA performs the fusion/codegen, and executors are memoized
+on (function string, shapes, dtypes, axis spec) exactly like the
+reference's kernel cache (reference: src/map.cpp:676-701, ObjectCache).
+
+Semantics notes:
+- integer '/' and '%' follow C (truncate toward zero)
+- gathers with negative indices wrap (used by fftshift's ``a(_-n/2)``)
+- ``if``/``else`` are evaluated in SIMT style: both branches run, results
+  merge under the condition mask — identical observable behavior to the
+  CUDA original.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..dtype import DataType
+from .map_lang import (compile_map, MapSyntaxError, Num, Name, BinOp, UnOp,
+                       Ternary, CallIndex, Subscript, Method, Attr, Cast,
+                       Ctor, Decl, Assign, AssignCall, If)
+
+__all__ = ['map', 'map_compute', 'clear_map_cache', 'MapSyntaxError']
+
+_cache = {}
+_cache_lock = threading.Lock()
+
+
+def clear_map_cache():
+    with _cache_lock:
+        _cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# Runtime values
+# ---------------------------------------------------------------------------
+
+class IndexVec(object):
+    """The implicit index vector ``_`` (or a transformed version): one
+    integer component per iteration axis, supporting per-axis arithmetic."""
+
+    def __init__(self, parts):
+        self.parts = tuple(parts)
+
+    def binop(self, op, other, reverse=False):
+        if isinstance(other, IndexVec):
+            oparts = other.parts
+        elif isinstance(other, ShapeVec):
+            oparts = other.dims
+        else:
+            oparts = (other,) * len(self.parts)
+        if len(oparts) != len(self.parts):
+            raise ValueError("Index-vector length mismatch")
+        if reverse:
+            return IndexVec([op(b, a) for a, b in zip(self.parts, oparts)])
+        return IndexVec([op(a, b) for a, b in zip(self.parts, oparts)])
+
+
+class ShapeVec(object):
+    """Result of ``a.shape()``: a tuple of ints with per-axis arithmetic."""
+
+    def __init__(self, dims):
+        self.dims = tuple(dims)
+
+    def binop(self, op, other, reverse=False):
+        if isinstance(other, (ShapeVec, IndexVec)):
+            oparts = other.dims if isinstance(other, ShapeVec) \
+                else other.parts
+        else:
+            oparts = (other,) * len(self.dims)
+        if reverse:
+            return ShapeVec([op(b, a) for a, b in zip(self.dims, oparts)])
+        return ShapeVec([op(a, b) for a, b in zip(self.dims, oparts)])
+
+
+class Vec(object):
+    """A small fixed-length vector value (reference: Vector.hpp) —
+    a jnp array whose trailing axis is the component axis."""
+
+    def __init__(self, arr):
+        self.arr = arr
+
+
+class ArrayRef(object):
+    """A named data array, before we know whether it's used elementwise or
+    explicitly indexed."""
+
+    def __init__(self, name, arr, veclen=1):
+        self.name = name
+        self.arr = arr         # jnp array (logical values; vec axis last)
+        self.veclen = veclen
+
+    @property
+    def index_ndim(self):
+        return self.arr.ndim - (1 if self.veclen > 1 else 0)
+
+
+# ---------------------------------------------------------------------------
+# dtype conversion (packed / complex-int types <-> logical jnp values)
+# ---------------------------------------------------------------------------
+
+def _to_logical(buf, dtype):
+    """numpy storage (possibly packed/structured) -> logical jnp-ready
+    numpy array (complex-int becomes complex64)."""
+    dtype = DataType(dtype)
+    if dtype.kind == 'ci':
+        if dtype.nbits == 4:
+            b = buf.view(np.uint8)
+            re = (b.astype(np.int8) >> 4).astype(np.float32)
+            im = (np.left_shift(b, 4).astype(np.int8) >> 4).astype(np.float32)
+            return (re + 1j * im).astype(np.complex64)
+        re = buf['re'].astype(np.float32)
+        im = buf['im'].astype(np.float32)
+        return (re + 1j * im).astype(np.complex64)
+    if dtype.kind == 'cf' and dtype.nbits == 16:
+        return (buf['re'].astype(np.float32) +
+                1j * buf['im'].astype(np.float32)).astype(np.complex64)
+    if dtype.is_packed:
+        # unpack sub-byte ints to int8/uint8
+        nbits = dtype.nbits
+        b = buf.view(np.uint8)
+        per = 8 // nbits
+        shifts = np.arange(per, dtype=np.uint8) * nbits
+        vals = (b[..., None] >> shifts[::-1]) & ((1 << nbits) - 1)
+        vals = vals.reshape(buf.shape[:-1] + (-1,))
+        if dtype.kind == 'i':
+            vals = (vals.astype(np.int8) << (8 - nbits)) >> (8 - nbits)
+        return vals
+    return buf
+
+
+def _from_logical(arr, dtype, out_buf=None):
+    """logical numpy values -> reference storage representation."""
+    dtype = DataType(dtype)
+    arr = np.asarray(arr)
+    if dtype.kind == 'ci':
+        if dtype.nbits == 4:
+            re = np.round(arr.real).astype(np.int64) & 0xF
+            im = np.round(arr.imag).astype(np.int64) & 0xF
+            packed = ((re << 4) | im).astype(np.uint8)
+            if out_buf is not None:
+                out_buf[...] = packed.view(out_buf.dtype).reshape(
+                    out_buf.shape)
+                return out_buf
+            return packed
+        comp = dtype.as_numpy_dtype()
+        out = np.empty(arr.shape, dtype=comp) if out_buf is None else out_buf
+        out['re'] = np.round(arr.real)
+        out['im'] = np.round(arr.imag)
+        return out
+    if dtype.kind == 'cf' and dtype.nbits == 16:
+        out = np.empty(arr.shape, dtype=dtype.as_numpy_dtype()) \
+            if out_buf is None else out_buf
+        out['re'] = arr.real
+        out['im'] = arr.imag
+        return out
+    npdt = dtype.as_numpy_dtype()
+    if dtype.is_integer and np.issubdtype(arr.dtype, np.floating):
+        arr = np.round(arr)
+    res = arr.astype(npdt)
+    if out_buf is not None:
+        out_buf[...] = res
+        return out_buf
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Evaluator
+# ---------------------------------------------------------------------------
+
+def _is_int(x):
+    import jax.numpy as jnp
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+def _cdiv(a, b):
+    """C-style integer division (truncation toward zero)."""
+    import jax.numpy as jnp
+    q = jnp.floor_divide(a, b)
+    r = a - q * b
+    fix = (r != 0) & ((r < 0) != (b < 0))
+    return q + fix.astype(q.dtype)
+
+
+def _cmod(a, b):
+    import jax.numpy as jnp
+    return a - _cdiv(a, b) * b
+
+
+_TYPE_MAP = {
+    'int': np.int32, 'long': np.int64, 'short': np.int16,
+    'char': np.int8, 'signed char': np.int8, 'unsigned char': np.uint8,
+    'unsigned': np.uint32, 'unsigned int': np.uint32,
+    'float': np.float32, 'double': np.float64, 'bool': np.bool_,
+}
+
+
+class _Eval(object):
+    def __init__(self, shape, axis_names, arrays, scalars, dtypes, veclens):
+        import jax.numpy as jnp
+        self.jnp = jnp
+        self.shape = tuple(shape)
+        self.axis_names = list(axis_names or [])
+        self.arrays = arrays          # name -> jnp array (logical)
+        self.scalars = scalars        # name -> traced scalar
+        self.dtypes = dtypes          # name -> DataType (logical)
+        self.veclens = veclens
+        self.env = {}
+        self.out = {}                 # name -> current output array
+        self.mask = None              # active SIMT mask
+
+    # -- helpers ----------------------------------------------------------
+    def iota(self, axis):
+        jnp = self.jnp
+        n = len(self.shape)
+        return jnp.reshape(
+            jnp.arange(self.shape[axis], dtype=jnp.int32),
+            [self.shape[axis] if k == axis else 1 for k in range(n)])
+
+    def index_vec(self):
+        return IndexVec([self.iota(k) for k in range(len(self.shape))])
+
+    def value(self, v):
+        """Collapse ArrayRef used elementwise / 1-length vectors."""
+        if isinstance(v, ArrayRef):
+            if v.veclen > 1:
+                return Vec(v.arr)
+            return v.arr
+        if isinstance(v, (IndexVec, ShapeVec)):
+            parts = v.parts if isinstance(v, IndexVec) else v.dims
+            if len(parts) == 1:
+                return parts[0]
+            raise ValueError("Index vector used as scalar")
+        return v
+
+    def resolve_dtype(self, tname):
+        tname = tname.strip()
+        if tname == 'auto':
+            return None
+        if tname.startswith('Complex'):
+            inner = tname[len('Complex'):].strip('<> ')
+            base = self.resolve_dtype(inner) if inner else np.float32
+            return np.complex128 if base == np.float64 else np.complex64
+        if '::' in tname:
+            base, _, member = tname.partition('::')
+            dt = self.resolve_dtype(base)
+            return dt  # value_type of a vector = element type
+        if tname.endswith('_type'):
+            name = tname[:-len('_type')]
+            if name in self.dtypes:
+                return self.dtypes[name].as_jax_dtype()
+            raise MapSyntaxError("Unknown type %r" % tname)
+        if tname in _TYPE_MAP:
+            return _TYPE_MAP[tname]
+        raise MapSyntaxError("Unknown type %r" % tname)
+
+    def cast(self, val, tname):
+        jnp = self.jnp
+        dt = self.resolve_dtype(tname)
+        if dt is None:
+            return val
+        if isinstance(val, Vec):
+            return Vec(val.arr.astype(dt))
+        val = self.value(val)
+        if jnp.issubdtype(jnp.asarray(val).dtype, jnp.complexfloating) \
+                and not jnp.issubdtype(np.dtype(dt), np.complexfloating):
+            val = jnp.real(val)
+        if np.issubdtype(np.dtype(dt), np.integer):
+            v = jnp.asarray(val)
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                val = jnp.trunc(v)
+        return jnp.asarray(val).astype(dt)
+
+    def masked(self, new, old):
+        jnp = self.jnp
+        if self.mask is None:
+            return new
+        if isinstance(new, Vec):
+            m = jnp.asarray(self.mask)[..., None]
+            oldarr = old.arr if isinstance(old, Vec) else old
+            return Vec(jnp.where(m, new.arr, oldarr))
+        if old is None:
+            return new
+        return jnp.where(self.mask, new, old)
+
+    # -- name resolution ---------------------------------------------------
+    def lookup(self, name):
+        if name == '_':
+            return self.index_vec()
+        if name in self.env:
+            return self.env[name]
+        if name in self.axis_names:
+            return self.iota(self.axis_names.index(name))
+        if name in self.out:
+            return ArrayRef(name, self.out[name],
+                            self.veclens.get(name, 1))
+        if name in self.arrays:
+            return ArrayRef(name, self.arrays[name],
+                            self.veclens.get(name, 1))
+        if name in self.scalars:
+            return self.scalars[name]
+        raise MapSyntaxError("Unknown name %r" % name)
+
+    # -- gather / scatter ---------------------------------------------------
+    def build_index(self, ref, args):
+        """Evaluate index args into a tuple of index arrays for ``ref``."""
+        parts = []
+        for a in args:
+            v = self.eval(a)
+            if isinstance(v, IndexVec):
+                parts.extend(v.parts)
+            elif isinstance(v, ShapeVec):
+                parts.extend(v.dims)
+            elif isinstance(v, ArrayRef):
+                parts.append(v.arr)
+            else:
+                parts.append(v)
+        if len(parts) != ref.index_ndim:
+            raise MapSyntaxError(
+                "Array %r indexed with %d indices; has %d axes"
+                % (ref.name, len(parts), ref.index_ndim))
+        return tuple(self.jnp.asarray(p).astype(self.jnp.int32)
+                     if not isinstance(p, int) else p for p in parts)
+
+    def gather(self, ref, args):
+        idx = self.build_index(ref, args)
+        res = ref.arr[idx]
+        if ref.veclen > 1:
+            return Vec(res)
+        return res
+
+    # -- expression evaluation ----------------------------------------------
+    def eval(self, node):
+        jnp = self.jnp
+        if isinstance(node, Num):
+            if node.is_float:
+                return jnp.float32(node.value) if node.is_f32 \
+                    else jnp.asarray(node.value)
+            return node.value
+        if isinstance(node, Name):
+            return self.lookup(node.id)
+        if isinstance(node, BinOp):
+            return self.binop(node.op, node.left, node.right)
+        if isinstance(node, UnOp):
+            v = self.eval(node.operand)
+            if node.op == '-':
+                if isinstance(v, (IndexVec, ShapeVec)):
+                    return v.binop(lambda a, b: -a, 0)
+                if isinstance(v, Vec):
+                    return Vec(-v.arr)
+                return -self.value(v)
+            if node.op == '+':
+                return v
+            if node.op == '!':
+                return jnp.logical_not(self.value(v))
+            if node.op == '~':
+                return ~self.value(v)
+        if isinstance(node, Ternary):
+            c = self.value(self.eval(node.cond))
+            t = self.eval(node.then)
+            o = self.eval(node.other)
+            if isinstance(t, Vec) or isinstance(o, Vec):
+                ta = t.arr if isinstance(t, Vec) else t
+                oa = o.arr if isinstance(o, Vec) else o
+                return Vec(jnp.where(jnp.asarray(c)[..., None], ta, oa))
+            return jnp.where(c, self.value(t), self.value(o))
+        if isinstance(node, CallIndex):
+            base = node.base.id
+            # math function or cast-call?
+            if base in _TYPE_MAP or base == 'Complex':
+                args = [self.value(self.eval(a)) for a in node.args]
+                if base == 'Complex':
+                    return self.make_complex(np.complex64, args)
+                return self.cast(args[0], base)
+            if base in _FUNCS:
+                args = [self.value(self.eval(a)) for a in node.args]
+                return _FUNCS[base](jnp, *args)
+            ref = self.lookup(base)
+            if isinstance(ref, ArrayRef):
+                return self.gather(ref, node.args)
+            raise MapSyntaxError("Cannot call %r" % base)
+        if isinstance(node, Subscript):
+            v = self.eval(node.base)
+            i = self.value(self.eval(node.index))
+            if isinstance(v, Vec):
+                return v.arr[..., i]
+            if isinstance(v, ArrayRef):
+                return v.arr[self.jnp.asarray(i)]
+            return v[..., i]
+        if isinstance(node, Method):
+            return self.method(node)
+        if isinstance(node, Attr):
+            v = self.eval(node.base)
+            if node.name == 'real':
+                return jnp.real(self.value(v))
+            if node.name == 'imag':
+                return jnp.imag(self.value(v))
+            raise MapSyntaxError("Unknown attribute .%s" % node.name)
+        if isinstance(node, Cast):
+            return self.cast(self.eval(node.operand), node.type_name)
+        if isinstance(node, Ctor):
+            return self.ctor(node)
+        raise MapSyntaxError("Cannot evaluate %r" % node)
+
+    def make_complex(self, dt, args):
+        jnp = self.jnp
+        if len(args) == 1:
+            return jnp.asarray(args[0]).astype(dt)
+        re, im = args
+        return (jnp.asarray(re) + 1j * jnp.asarray(im)).astype(dt)
+
+    def ctor(self, node):
+        tname = node.type_name
+        args = [self.eval(a) for a in node.args]
+        if tname.startswith('Complex') or '::' in tname:
+            dt = self.resolve_dtype(tname) or np.complex64
+            if not np.issubdtype(np.dtype(dt), np.complexfloating):
+                dt = np.complex64
+            return self.make_complex(dt, [self.value(a) for a in args])
+        # vector construction: T(a, b, c, d)
+        vals = [self.value(a) for a in args]
+        if len(vals) == 1:
+            return self.cast(vals[0], tname)
+        jnp = self.jnp
+        vals = jnp.broadcast_arrays(*[jnp.asarray(v) for v in vals])
+        return Vec(jnp.stack(vals, axis=-1))
+
+    def method(self, node):
+        jnp = self.jnp
+        name = node.name
+        base = self.eval(node.base)
+        if name == 'shape':
+            if isinstance(base, ArrayRef):
+                shp = base.arr.shape
+                if base.veclen > 1:
+                    shp = shp[:-1]
+            else:
+                shp = jnp.asarray(self.value(base)).shape
+            if node.args:
+                ax = self.value(self.eval(node.args[0]))
+                return shp[int(ax)]
+            return ShapeVec(shp)
+        v = self.value(base)
+        if name == 'conj':
+            if isinstance(base, Vec) or isinstance(v, Vec):
+                arr = v.arr if isinstance(v, Vec) else v
+                return Vec(jnp.conj(arr))
+            return jnp.conj(v)
+        if name in ('mag2', 'norm'):
+            return jnp.real(v) ** 2 + jnp.imag(v) ** 2
+        if name in ('mag', 'abs'):
+            return jnp.abs(v)
+        if name in ('phase', 'arg'):
+            return jnp.angle(v)
+        raise MapSyntaxError("Unknown method .%s()" % name)
+
+    def binop(self, op, lnode, rnode):
+        jnp = self.jnp
+        lv = self.eval(lnode)
+        rv = self.eval(rnode)
+        if isinstance(lv, (IndexVec, ShapeVec)) or \
+                isinstance(rv, (IndexVec, ShapeVec)):
+            fn = _VEC_OPS[op]
+            if isinstance(lv, (IndexVec, ShapeVec)):
+                return lv.binop(fn, rv)
+            return rv.binop(fn, lv, reverse=True)
+        if isinstance(lv, Vec) or isinstance(rv, Vec):
+            la = lv.arr if isinstance(lv, Vec) else \
+                jnp.asarray(self.value(lv))[..., None]
+            ra = rv.arr if isinstance(rv, Vec) else \
+                jnp.asarray(self.value(rv))[..., None]
+            return Vec(_apply_binop(jnp, op, la, ra))
+        return _apply_binop(jnp, op, self.value(lv), self.value(rv))
+
+    # -- statements ---------------------------------------------------------
+    def run(self, body):
+        for stmt in body:
+            self.exec_stmt(stmt)
+
+    def exec_stmt(self, stmt):
+        jnp = self.jnp
+        if stmt is None:
+            return
+        if isinstance(stmt, Decl):
+            val = self.eval(stmt.expr) if stmt.expr is not None else 0
+            if stmt.type_name != 'auto' and not isinstance(stmt.expr, Ctor):
+                val = self.cast(val, stmt.type_name) \
+                    if not isinstance(val, (Vec, IndexVec, ShapeVec)) else val
+            self.env[stmt.name] = val
+            return
+        if isinstance(stmt, If):
+            cond = self.value(self.eval(stmt.cond))
+            cond = jnp.asarray(cond).astype(bool)
+            outer = self.mask
+            self.mask = cond if outer is None else (outer & cond)
+            self.run(stmt.then_body)
+            if stmt.else_body:
+                notc = jnp.logical_not(cond)
+                self.mask = notc if outer is None else (outer & notc)
+                self.run(stmt.else_body)
+            self.mask = outer
+            return
+        if isinstance(stmt, AssignCall):
+            re = self.value(self.eval(stmt.args[0]))
+            im = self.value(self.eval(stmt.args[1]))
+            val = self.make_complex(np.complex64, [re, im])
+            self.store(stmt.target, '=', val)
+            return
+        if isinstance(stmt, Assign):
+            if stmt.target is None:
+                self.eval(stmt.expr)   # bare expression
+                return
+            val = self.eval(stmt.expr)
+            self.store(stmt.target, stmt.op, val)
+            return
+        raise MapSyntaxError("Cannot execute %r" % stmt)
+
+    def _combine(self, op, old, new):
+        if op == '=':
+            return new
+        fn = {'+=': '+', '-=': '-', '*=': '*', '/=': '/'}[op]
+        return _apply_binop(self.jnp, fn, old, new)
+
+    def store(self, target, op, val):
+        jnp = self.jnp
+        if isinstance(val, (IndexVec, ShapeVec)):
+            val = self.value(val)
+        if isinstance(target, Name):
+            name = target.id
+            if name in self.env:
+                old = self.env[name]
+                if isinstance(val, Vec) or isinstance(old, Vec):
+                    va = val if isinstance(val, Vec) else Vec(
+                        jnp.asarray(self.value(val))[..., None])
+                    if op != '=':
+                        olda = old.arr if isinstance(old, Vec) else old
+                        va = Vec(_apply_binop(jnp, op[0], olda, va.arr))
+                    self.env[name] = self.masked(va, old)
+                else:
+                    new = self._combine(op, self.value(old), self.value(val))
+                    self.env[name] = self.masked(new, self.value(old))
+                return
+            if name in self.arrays or name in self.out:
+                # whole-array elementwise store
+                cur = self.out.get(name, self.arrays.get(name))
+                veclen = self.veclens.get(name, 1)
+                if isinstance(val, Vec):
+                    new = jnp.broadcast_to(val.arr, cur.shape)
+                else:
+                    v = jnp.asarray(self.value(val))
+                    tgt_shape = cur.shape[:-1] if veclen > 1 else cur.shape
+                    v = jnp.broadcast_to(v, tgt_shape)
+                    new = v[..., None] * jnp.ones(
+                        (veclen,), v.dtype) if veclen > 1 else v
+                if op != '=':
+                    new = self._combine(op, cur, new)
+                new = self.masked(new, cur)
+                self.out[name] = new.astype(cur.dtype)
+                return
+            # new local variable via plain assignment
+            self.env[name] = self.masked(val, None)
+            return
+        if isinstance(target, CallIndex):
+            name = target.base.id
+            if name in self.env:
+                raise MapSyntaxError("Cannot index-assign local %r" % name)
+            cur = self.out.get(name, self.arrays.get(name))
+            if cur is None:
+                raise MapSyntaxError("Unknown output %r" % name)
+            veclen = self.veclens.get(name, 1)
+            ref = ArrayRef(name, cur, veclen)
+            idx = self.build_index(ref, target.args)
+            v = val.arr if isinstance(val, Vec) else \
+                jnp.asarray(self.value(val))
+            if op != '=':
+                v = self._combine(op, cur[idx], v)
+            if self.mask is not None:
+                v = jnp.where(self.mask[..., None] if isinstance(val, Vec)
+                              else self.mask, v, cur[idx])
+            if not np.issubdtype(np.dtype(cur.dtype), np.complexfloating) \
+                    and jnp.issubdtype(jnp.asarray(v).dtype,
+                                       jnp.complexfloating):
+                v = jnp.real(v)
+            self.out[name] = cur.at[idx].set(
+                jnp.asarray(v).astype(cur.dtype))
+            return
+        if isinstance(target, Subscript):
+            # component assignment on a local vector variable
+            base = target.base
+            if not isinstance(base, Name) or base.id not in self.env:
+                raise MapSyntaxError("Unsupported subscript store")
+            old = self.env[base.id]
+            if not isinstance(old, Vec):
+                raise MapSyntaxError("Subscript store on non-vector")
+            k = self.value(self.eval(target.index))
+            v = jnp.asarray(self.value(val))
+            if op != '=':
+                v = self._combine(op, old.arr[..., k], v)
+            if self.mask is not None:
+                v = jnp.where(self.mask, v, old.arr[..., k])
+            self.env[base.id] = Vec(old.arr.at[..., k].set(
+                v.astype(old.arr.dtype)))
+            return
+        raise MapSyntaxError("Bad assignment target %r" % target)
+
+
+def _apply_binop(jnp, op, a, b):
+    if op == '+':
+        return a + b
+    if op == '-':
+        return a - b
+    if op == '*':
+        return a * b
+    if op == '/':
+        ja, jb = jnp.asarray(a), jnp.asarray(b)
+        if jnp.issubdtype(ja.dtype, jnp.integer) and \
+                jnp.issubdtype(jb.dtype, jnp.integer):
+            return _cdiv(ja, jb)
+        return a / b
+    if op == '%':
+        ja, jb = jnp.asarray(a), jnp.asarray(b)
+        if jnp.issubdtype(ja.dtype, jnp.integer) and \
+                jnp.issubdtype(jb.dtype, jnp.integer):
+            return _cmod(ja, jb)
+        return jnp.fmod(ja, jb)
+    if op == '==':
+        return a == b
+    if op == '!=':
+        return a != b
+    if op == '<':
+        return a < b
+    if op == '<=':
+        return a <= b
+    if op == '>':
+        return a > b
+    if op == '>=':
+        return a >= b
+    if op == '&&':
+        return jnp.logical_and(a, b)
+    if op == '||':
+        return jnp.logical_or(a, b)
+    if op == '&':
+        return a & b
+    if op == '|':
+        return a | b
+    if op == '^':
+        return a ^ b
+    if op == '<<':
+        return a << b
+    if op == '>>':
+        return a >> b
+    raise MapSyntaxError("Unknown operator %r" % op)
+
+
+_VEC_OPS = {
+    '+': lambda a, b: a + b,
+    '-': lambda a, b: a - b,
+    '*': lambda a, b: a * b,
+    '/': lambda a, b: a // b if isinstance(a, int) and isinstance(b, int)
+    else _cdiv(a, b),
+    '%': lambda a, b: a % b,
+}
+
+_FUNCS = {
+    'abs': lambda jnp, x: jnp.abs(x),
+    'fabs': lambda jnp, x: jnp.abs(x),
+    'sqrt': lambda jnp, x: jnp.sqrt(_as_float(jnp, x)),
+    'rsqrt': lambda jnp, x: 1.0 / jnp.sqrt(_as_float(jnp, x)),
+    'exp': lambda jnp, x: jnp.exp(_as_float(jnp, x)),
+    'exp2': lambda jnp, x: jnp.exp2(_as_float(jnp, x)),
+    'log': lambda jnp, x: jnp.log(_as_float(jnp, x)),
+    'log2': lambda jnp, x: jnp.log2(_as_float(jnp, x)),
+    'log10': lambda jnp, x: jnp.log10(_as_float(jnp, x)),
+    'sin': lambda jnp, x: jnp.sin(_as_float(jnp, x)),
+    'cos': lambda jnp, x: jnp.cos(_as_float(jnp, x)),
+    'tan': lambda jnp, x: jnp.tan(_as_float(jnp, x)),
+    'asin': lambda jnp, x: jnp.arcsin(_as_float(jnp, x)),
+    'acos': lambda jnp, x: jnp.arccos(_as_float(jnp, x)),
+    'atan': lambda jnp, x: jnp.arctan(_as_float(jnp, x)),
+    'atan2': lambda jnp, y, x: jnp.arctan2(y, x),
+    'pow': lambda jnp, x, y: jnp.power(x, y),
+    'rint': lambda jnp, x: jnp.rint(x),
+    'round': lambda jnp, x: jnp.round(x),
+    'floor': lambda jnp, x: jnp.floor(x),
+    'ceil': lambda jnp, x: jnp.ceil(x),
+    'trunc': lambda jnp, x: jnp.trunc(x),
+    'min': lambda jnp, a, b: jnp.minimum(a, b),
+    'max': lambda jnp, a, b: jnp.maximum(a, b),
+    'fmin': lambda jnp, a, b: jnp.minimum(a, b),
+    'fmax': lambda jnp, a, b: jnp.maximum(a, b),
+    'erf': lambda jnp, x: __import__('jax').scipy.special.erf(x),
+    'conj': lambda jnp, x: jnp.conj(x),
+}
+
+
+def _as_float(jnp, x):
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return x.astype(jnp.float32)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def _find_outputs(body, data_names):
+    """Names assigned at top level that refer to data arrays."""
+    outs = []
+
+    def walk(stmts):
+        for s in stmts:
+            if s is None:
+                continue
+            if isinstance(s, (Assign, AssignCall)):
+                t = s.target
+                if isinstance(t, CallIndex):
+                    t = t.base
+                if isinstance(t, Subscript):
+                    t = t.base
+                if isinstance(t, Name) and t.id in data_names \
+                        and t.id not in outs:
+                    outs.append(t.id)
+            elif isinstance(s, If):
+                walk(s.then_body)
+                walk(s.else_body)
+
+    walk(body)
+    return outs
+
+
+def _prep_array(x):
+    """Extract (logical numpy/jax array, DataType, veclen, holder_kind)."""
+    import jax
+    from ..ndarray import ndarray as bf_ndarray
+    if isinstance(x, bf_ndarray):
+        dt = x.dtype
+        veclen = dt.veclen
+        if x.space == 'tpu':
+            return x.data, dt, veclen, 'bf_dev'
+        buf = x.as_numpy()
+        logical = _to_logical(buf, DataType('%s%d' % (dt.kind, dt.nbits)))
+        return logical, dt, veclen, 'bf_host'
+    if isinstance(x, jax.Array):
+        return x, DataType(np.dtype(x.dtype)), 1, 'jax'
+    arr = np.asarray(x)
+    if arr.ndim == 0:
+        return arr, None, 1, 'scalar'
+    dt = DataType(arr.dtype)
+    return _to_logical(arr, dt), dt, 1, 'np'
+
+
+def map_compute(func_string, data, axis_names=None, shape=None):
+    """Functional core: returns {output_name: jnp array} without writing
+    back.  Arrays in ``data`` may be bf ndarrays, numpy, jax arrays, or
+    python scalars."""
+    import jax
+    import jax.numpy as jnp
+
+    arrays, scalars, dtypes, veclens = {}, {}, {}, {}
+    kinds = {}
+    for name, x in data.items():
+        if isinstance(x, (int, float, complex)) and not isinstance(x, bool):
+            scalars[name] = x
+            kinds[name] = 'scalar'
+            continue
+        arr, dt, veclen, kind = _prep_array(x)
+        kinds[name] = kind
+        if kind == 'scalar':
+            scalars[name] = arr[()]
+            continue
+        arrays[name] = arr
+        dtypes[name] = dt if dt is not None else DataType('f32')
+        veclens[name] = veclen
+
+    body = compile_map(func_string, list(data.keys()))
+    outputs = _find_outputs(body, set(arrays.keys()))
+
+    if shape is None:
+        # elementwise mode: iteration space = broadcast of non-output arrays
+        shapes = [np.shape(a) for n, a in arrays.items() if n not in outputs]
+        if not shapes:
+            shapes = [np.shape(arrays[outputs[0]])] if outputs else [()]
+        it_shape = np.broadcast_shapes(*shapes) if shapes else ()
+    else:
+        it_shape = tuple(int(s) for s in shape)
+
+    key = (func_string, tuple(sorted(
+        (n, np.shape(a), str(np.asarray(a).dtype), veclens.get(n, 1))
+        for n, a in arrays.items())),
+        tuple(sorted(scalars)), tuple(axis_names or ()), it_shape)
+
+    with _cache_lock:
+        fn = _cache.get(key)
+    if fn is None:
+        arr_names = sorted(arrays)
+        sca_names = sorted(scalars)
+
+        def executor(arr_vals, sca_vals):
+            ev = _Eval(it_shape, axis_names,
+                       dict(zip(arr_names, arr_vals)),
+                       dict(zip(sca_names, sca_vals)),
+                       dtypes, veclens)
+            for o in outputs:
+                ev.out[o] = ev.arrays.pop(o)
+            ev.run(body)
+            return [ev.out[o] for o in outputs]
+
+        fn = jax.jit(executor)
+        with _cache_lock:
+            _cache[key] = fn
+    from ..xfer import to_device
+    arr_vals = [arrays[n] if isinstance(arrays[n], jax.Array)
+                else to_device(arrays[n]) for n in sorted(arrays)]
+    sca_vals = [scalars[n] for n in sorted(scalars)]
+    res = fn(arr_vals, sca_vals)
+    return dict(zip(outputs, res))
+
+
+def map(func_string, data=None, axis_names=None, shape=None, func_name=None,
+        extra_code=None, block_shape=None, block_axes=None, **kwargs):
+    """Apply a user-defined transformation to arrays (reference:
+    python/bifrost/map.py:58-143).  Output arrays named in ``data`` are
+    updated in place (host arrays are overwritten; device bf.ndarrays have
+    their backing jax.Array replaced).  Also returns the dict of computed
+    outputs.  ``func_name``/``extra_code``/``block_shape``/``block_axes``
+    are accepted for API compatibility; XLA chooses its own tiling."""
+    from ..ndarray import ndarray as bf_ndarray
+    from ..xfer import to_host
+    if data is None:
+        data = kwargs
+    results = map_compute(func_string, data, axis_names=axis_names,
+                          shape=shape)
+    for name, res in results.items():
+        holder = data[name]
+        if isinstance(holder, bf_ndarray):
+            dt = holder.dtype
+            if holder.space == 'tpu':
+                holder._buf = res.astype(holder.data.dtype) \
+                    if res.dtype != holder.data.dtype else res
+            else:
+                _from_logical(to_host(res),
+                              DataType('%s%d' % (dt.kind, dt.nbits)),
+                              out_buf=holder.as_numpy().view()
+                              if not dt.is_packed else holder.as_numpy())
+        elif isinstance(holder, np.ndarray):
+            _from_logical(to_host(res), DataType(holder.dtype),
+                          out_buf=holder)
+    return results

@@ -1,0 +1,113 @@
+"""Quantization and sub-byte unpacking (reference: src/quantize.cpp:52-90,
+src/guantize.cu:73-355, src/unpack.cpp, src/gunpack.cu).
+
+quantize: float -> int with scale, clipping at the type limits, including
+packed 1/2/4-bit outputs.  unpack: packed 1/2/4-bit -> int8/f32.
+All bit-twiddling is jnp shifts/masks under jit — XLA vectorizes it on the
+VPU the way the reference's hand-written launchers do on CUDA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..dtype import DataType
+from .common import as_jax
+from .map import _from_logical, _to_logical
+
+__all__ = ['quantize', 'unpack']
+
+
+def _clip_limits(dtype):
+    if dtype.kind in ('i', 'ci'):
+        hi = (1 << (dtype.nbits - 1)) - 1
+        return -hi - 1, hi
+    if dtype.kind == 'u':
+        return 0, (1 << dtype.nbits) - 1
+    return None, None
+
+
+_quant_cache = {}
+
+
+def _quant_kernel(dt_str):
+    """jit-cached quantize kernel per destination dtype (scale is a traced
+    argument, so changing it never recompiles)."""
+    fn = _quant_cache.get(dt_str)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+    ddt = DataType(dt_str)
+    lo, hi = _clip_limits(ddt)
+
+    def kernel(x, scale):
+        y = x * scale
+        if jnp.iscomplexobj(y) and ddt.kind in ('i', 'u', 'f'):
+            y = jnp.real(y)
+        if ddt.kind == 'ci':
+            re = jnp.clip(jnp.round(jnp.real(y)), lo, hi)
+            im = jnp.clip(jnp.round(jnp.imag(y)), lo, hi)
+            return re + 1j * im
+        if lo is not None:
+            y = jnp.clip(jnp.round(y), lo, hi)
+        return y
+
+    fn = jax.jit(kernel)
+    _quant_cache[dt_str] = fn
+    return fn
+
+
+def quantize(src, dst, scale=1.):
+    """dst = clip(round(src * scale)) in dst's (possibly packed) dtype
+    (reference: python/bifrost/quantize.py)."""
+    from ..ndarray import ndarray as bf_ndarray
+    x = as_jax(src)
+    ddt = dst.dtype if isinstance(dst, bf_ndarray) else DataType(dst.dtype)
+    y = _quant_kernel(str(ddt))(x, scale)
+    if isinstance(dst, bf_ndarray) and dst.space == 'tpu':
+        dst._buf = y.astype(dst.data.dtype)
+        return dst
+    from ..xfer import to_host
+    buf = dst.as_numpy() if isinstance(dst, bf_ndarray) else dst
+    _pack_into(to_host(y), ddt, buf)
+    return dst
+
+
+def _pack_into(vals, dtype, out_buf):
+    """Pack logical values into (possibly sub-byte) storage."""
+    if dtype.kind == 'ci' and dtype.nbits == 4:
+        _from_logical(vals, dtype, out_buf=out_buf)
+        return
+    if dtype.is_packed:
+        nbits = dtype.nbits
+        per = 8 // nbits
+        v = np.asarray(vals).astype(np.int64) & ((1 << nbits) - 1)
+        v = v.reshape(v.shape[:-1] + (v.shape[-1] // per, per))
+        shifts = (np.arange(per) * nbits)[::-1]
+        packed = np.bitwise_or.reduce(v << shifts, axis=-1).astype(np.uint8)
+        out_buf[...] = packed.reshape(out_buf.shape)
+        return
+    _from_logical(vals, dtype, out_buf=out_buf)
+
+
+def unpack(src, dst):
+    """Expand packed sub-byte data into dst's dtype
+    (reference: python/bifrost/unpack.py)."""
+    from ..ndarray import ndarray as bf_ndarray
+    sdt = src.dtype if isinstance(src, bf_ndarray) else DataType(src.dtype)
+    if isinstance(src, bf_ndarray) and src.space != 'tpu':
+        logical = _to_logical(src.as_numpy(), sdt)
+    elif isinstance(src, bf_ndarray):
+        from ..xfer import to_host
+        logical = to_host(src.data)
+    else:
+        logical = _to_logical(np.asarray(src), sdt)
+    ddt = dst.dtype if isinstance(dst, bf_ndarray) else DataType(dst.dtype)
+    if isinstance(dst, bf_ndarray) and dst.space == 'tpu':
+        import jax.numpy as jnp
+        dst._buf = jnp.asarray(logical).astype(ddt.as_jax_dtype())
+        return dst
+    buf = dst.as_numpy() if isinstance(dst, bf_ndarray) else dst
+    _from_logical(logical, ddt, out_buf=buf)
+    return dst

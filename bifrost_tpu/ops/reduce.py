@@ -1,0 +1,89 @@
+"""Axis reductions with factors (reference: src/reduce.cu:898-920,
+python/bifrost/reduce.py, src/bifrost/reduce.h:45-54).
+
+ops: sum / mean / min / max / stderr plus power-variants
+(pwrsum/pwrmean/...) that square-detect complex inputs first.
+A ``factor`` reduces an axis by that factor (reshape trick); omitted
+factor collapses the whole axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import as_jax, logical_dtype
+from .fft import _writeback
+
+__all__ = ['reduce']
+
+_OPS = ('sum', 'mean', 'min', 'max', 'stderr',
+        'pwrsum', 'pwrmean', 'pwrmin', 'pwrmax', 'pwrstderr')
+
+
+def _reduce_jax(x, axis, factor, op):
+    import jax.numpy as jnp
+    power = op.startswith('pwr')
+    base = op[3:] if power else op
+    if power:
+        x = jnp.real(x) ** 2 + jnp.imag(x) ** 2 \
+            if jnp.iscomplexobj(x) else x * x
+    n = x.shape[axis]
+    if factor is None or factor == n:
+        factor = n
+    if n % factor:
+        raise ValueError("Reduce factor %d does not divide axis length %d"
+                         % (factor, n))
+    newshape = x.shape[:axis] + (n // factor, factor) + x.shape[axis + 1:]
+    x = x.reshape(newshape)
+    rax = axis + 1
+    if base == 'sum':
+        y = jnp.sum(x, axis=rax)
+    elif base == 'mean':
+        y = jnp.mean(x, axis=rax)
+    elif base == 'min':
+        y = jnp.min(x, axis=rax)
+    elif base == 'max':
+        y = jnp.max(x, axis=rax)
+    elif base == 'stderr':
+        # standard error of the mean (reference: reduce.h stderr op)
+        y = jnp.std(x, axis=rax) / np.sqrt(factor)
+    else:
+        raise ValueError("Unknown reduce op %r" % op)
+    return y
+
+
+def reduce(idata, odata, op='sum'):
+    """Reduce ``idata`` into ``odata``; the reduced axis and factor are
+    inferred from the shapes (reference: python/bifrost/reduce.py)."""
+    import jax
+    x = as_jax(idata)
+    ishape = tuple(idata.shape)
+    oshape = tuple(odata.shape)
+    if len(ishape) != len(oshape):
+        raise ValueError("reduce requires equal ranks (use views to "
+                         "relabel axes): %s vs %s" % (ishape, oshape))
+    axes = [i for i, (a, b) in enumerate(zip(ishape, oshape)) if a != b]
+    if len(axes) == 0:
+        axis, factor = 0, 1 if ishape else None
+        axis, factor = 0, ishape[0] // oshape[0] if ishape else None
+    elif len(axes) != 1:
+        raise ValueError("reduce supports exactly one reduced axis; "
+                         "shapes %s vs %s" % (ishape, oshape))
+    if axes:
+        axis = axes[0]
+        if ishape[axis] % oshape[axis]:
+            raise ValueError("Output axis %d length %d does not divide "
+                             "input length %d"
+                             % (axis, oshape[axis], ishape[axis]))
+        factor = ishape[axis] // oshape[axis]
+    fn = jax.jit(_reduce_jax, static_argnames=('axis', 'factor', 'op'))
+    y = fn(x, axis=axis, factor=factor, op=op)
+    odt = logical_dtype(odata)
+    import jax.numpy as jnp
+    tgt = jnp.dtype(odt.as_jax_dtype())
+    if y.dtype != tgt:
+        if not np.issubdtype(tgt, np.complexfloating) and \
+                np.issubdtype(y.dtype, np.complexfloating):
+            y = y.real
+        y = y.astype(tgt)
+    return _writeback(y, odata)

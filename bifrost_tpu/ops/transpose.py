@@ -1,0 +1,23 @@
+"""Arbitrary-axis ND transpose (reference: src/transpose.cu:503-561,
+python/bifrost/transpose.py).
+
+The reference hand-tiles shared-memory kernels; XLA's layout engine does
+the equivalent for TPU, so this is a jitted jnp.transpose with a
+physical-copy materialization.
+"""
+
+from __future__ import annotations
+
+from .common import as_jax
+from .fft import _writeback
+
+__all__ = ['transpose']
+
+
+def transpose(dst, src, axes):
+    import jax
+    import jax.numpy as jnp
+    x = as_jax(src)
+    axes = tuple(int(a) for a in axes)
+    y = jax.jit(lambda v: jnp.transpose(v, axes))(x)
+    return _writeback(y, dst)

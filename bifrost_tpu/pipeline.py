@@ -1,0 +1,803 @@
+"""Pipeline runtime: scoped configuration, thread-per-block execution,
+gulp/overlap negotiation, and data-loss tolerance.
+
+Semantics follow the reference pipeline (reference:
+python/bifrost/pipeline.py:84-779): a Pipeline collects Blocks built under
+it; ``run()`` launches one OS thread per block; blocks communicate through
+rings; a two-phase init barrier aborts cleanly if any block fails to open
+its sequences; unguaranteed readers that fall behind zero-fill skipped
+frames and force-skip to catch up.
+
+TPU-first differences:
+
+- ``gpu=N`` becomes ``device=N`` (an index into ``jax.devices()``);
+  ``gpu=`` is still accepted as an alias.
+- Per-gulp synchronization is *lagged*: computed jax arrays are committed
+  immediately (readers force them on use) and a bounded queue of pending
+  outputs provides backpressure with ``sync_depth`` gulps of dispatch-ahead
+  — hiding dispatch latency the way the reference hides it with one
+  cudaStreamSynchronize per gulp (reference: pipeline.py:628).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+import time
+import traceback
+import warnings
+import queue as queue_mod
+from collections import defaultdict, deque
+from contextlib import ExitStack
+from copy import copy
+
+from . import affinity, device, memory
+from .ring import Ring, ring_view, EndOfDataStop
+from .ndarray import memset_array
+from .proclog import ProcLog
+from .temp_storage import TempStorage
+
+__all__ = ['Pipeline', 'BlockScope', 'Block', 'SourceBlock',
+           'MultiTransformBlock', 'TransformBlock', 'SinkBlock',
+           'get_default_pipeline', 'get_current_block_scope',
+           'block_scope', 'block_view', 'get_ring', 'izip',
+           'PipelineInitError', 'EndOfDataStop']
+
+
+def izip(*iterables):
+    """Zip generators, stopping cleanly at first end-of-data
+    (reference: pipeline.py:62-67)."""
+    while True:
+        try:
+            yield [next(it) for it in iterables]
+        except (EndOfDataStop, StopIteration):
+            return
+
+
+class _Stacks(threading.local):
+    def __init__(self):
+        self.pipelines = []
+        self.scopes = []
+
+
+_stacks = _Stacks()
+
+
+def get_default_pipeline():
+    if not _stacks.pipelines:
+        _stacks.pipelines.append(Pipeline())
+        _stacks.scopes.append(_stacks.pipelines[-1])
+    return _stacks.pipelines[-1]
+
+
+def get_current_block_scope():
+    if _stacks.scopes:
+        return _stacks.scopes[-1]
+    get_default_pipeline()
+    return _stacks.scopes[-1]
+
+
+def block_scope(*args, **kwargs):
+    return BlockScope(*args, **kwargs)
+
+
+class BlockScope(object):
+    """Nestable configuration scope; unset attributes inherit from the
+    enclosing scope (reference: pipeline.py:84-162).
+
+    Tunables: gulp_nframe, buffer_nframe, buffer_factor, core, device
+    (index into jax.devices(); 'gpu' accepted as alias), mesh (a
+    jax.sharding.Mesh for sharded ops within the scope), fuse,
+    share_temp_storage, sync_depth.
+    """
+
+    instance_count = 0
+
+    _TUNABLES = ('gulp_nframe', 'buffer_nframe', 'buffer_factor', 'core',
+                 'device', 'mesh', 'share_temp_storage', 'sync_depth')
+
+    def __init__(self, name=None, gulp_nframe=None, buffer_nframe=None,
+                 buffer_factor=None, core=None, gpu=None, device=None,
+                 mesh=None, share_temp_storage=False, fuse=False,
+                 sync_depth=None):
+        if name is None:
+            name = 'BlockScope_%i' % BlockScope.instance_count
+            BlockScope.instance_count += 1
+        self.name = name
+        self._gulp_nframe = gulp_nframe
+        self._buffer_nframe = buffer_nframe
+        self._buffer_factor = buffer_factor
+        self._core = core
+        self._device = device if device is not None else gpu
+        self._mesh = mesh
+        self._share_temp_storage = share_temp_storage
+        self._sync_depth = sync_depth
+        self._fused = fuse
+        self._temp_storage = {}
+        self._parent_scope = get_current_block_scope() \
+            if not isinstance(self, Pipeline) else None
+        if self._parent_scope is not None:
+            self._parent_scope._children.append(self)
+            self.name = self._parent_scope.name + '/' + self.name
+        self._children = []
+
+    def __enter__(self):
+        _stacks.scopes.append(self)
+        return self
+
+    def __exit__(self, typ, value, tb):
+        popped = _stacks.scopes.pop()
+        assert popped is self
+
+    def __getattr__(self, name):
+        # Inherit unset tunables from the parent scope.
+        if name.startswith('_') or name not in BlockScope._TUNABLES:
+            raise AttributeError(name)
+        value = self.__dict__.get('_' + name)
+        if value is not None:
+            return value
+        parent = self.__dict__.get('_parent_scope')
+        if parent is not None:
+            return getattr(parent, name)
+        return None
+
+    # alias for reference compatibility
+    @property
+    def gpu(self):
+        return self.device
+
+    # -- scope hierarchy ---------------------------------------------------
+    def _scope_hierarchy(self):
+        out, parent = [], self._parent_scope
+        while parent is not None:
+            out.append(parent)
+            parent = parent._parent_scope
+        return list(reversed(out))
+
+    def cache_scope_hierarchy(self):
+        self.scope_hierarchy = self._scope_hierarchy()
+        self.fused_ancestor = None
+        for ancestor in self.scope_hierarchy:
+            if ancestor._fused:
+                self.fused_ancestor = ancestor
+                break
+
+    def is_fused_with(self, other):
+        return (self.fused_ancestor is not None and
+                self.fused_ancestor is getattr(other, 'fused_ancestor', None))
+
+    # -- temp storage ------------------------------------------------------
+    def _own_temp_storage(self, space):
+        if space not in self._temp_storage:
+            self._temp_storage[space] = TempStorage(space)
+        return self._temp_storage[space]
+
+    def get_temp_storage(self, space):
+        for scope in getattr(self, 'scope_hierarchy', self._scope_hierarchy()):
+            if scope.share_temp_storage:
+                return scope._own_temp_storage(space)
+        return self._own_temp_storage(space)
+
+    # -- visualization -----------------------------------------------------
+    def dot_graph(self):
+        """Graphviz DOT source of the block/ring graph
+        (reference: pipeline.py:163-201)."""
+        lines = ['digraph "%s" {' % self.name]
+        space_colors = {'system': 'orange', 'tpu': 'limegreen',
+                        'tpu_host': 'deepskyblue'}
+
+        def walk(scope):
+            for child in scope._children:
+                if isinstance(child, Block):
+                    lines.append('  "%s" [shape=box,style=filled,'
+                                 'fillcolor=white];' % child.name)
+                    for oring in child.orings:
+                        lines.append('  "%s" [shape=ellipse,style=filled,'
+                                     'fillcolor=%s];'
+                                     % (oring.name,
+                                        space_colors.get(oring.space,
+                                                         'white')))
+                        lines.append('  "%s" -> "%s";'
+                                     % (child.name, oring.name))
+                    for iring in child.irings:
+                        lines.append('  "%s" -> "%s";'
+                                     % (iring.name, child.name))
+                else:
+                    walk(child)
+
+        walk(self)
+        lines.append('}')
+        return '\n'.join(lines)
+
+
+class PipelineInitError(Exception):
+    pass
+
+
+def _try_join(thread, timeout=0.):
+    thread.join(timeout)
+    return not thread.is_alive()
+
+
+def join_all(threads, timeout):
+    deadline = time.time() + timeout
+    alive = list(threads)
+    while True:
+        alive = [t for t in alive if not _try_join(t)]
+        remaining = max(deadline - time.time(), 0)
+        if not alive or remaining == 0:
+            return alive
+        alive[0].join(min(remaining, 0.5))
+
+
+class Pipeline(BlockScope):
+    """Collects blocks and runs each in its own thread
+    (reference: pipeline.py:221-293)."""
+
+    instance_count = 0
+
+    def __init__(self, name=None, **kwargs):
+        if name is None:
+            name = 'Pipeline_%i' % Pipeline.instance_count
+            Pipeline.instance_count += 1
+        super(Pipeline, self).__init__(name=name, **kwargs)
+        self.blocks = []
+        self.threads = []
+        self.shutdown_timeout = 5.
+        self.all_blocks_finished_initializing_event = threading.Event()
+        self.block_init_queue = queue_mod.Queue()
+
+    def as_default(self):
+        _stacks.pipelines.append(self)
+        _stacks.scopes.append(self)
+
+    def synchronize_block_initializations(self):
+        """Init barrier: every block must open its output sequences before
+        any block starts processing; a failed block aborts the pipeline
+        (reference: pipeline.py:236-248)."""
+        uninitialized = set(self.blocks)
+        while uninitialized:
+            block, ok = self.block_init_queue.get()
+            uninitialized.discard(block)
+            if not ok:
+                self.shutdown()
+                raise PipelineInitError(
+                    "The following block failed to initialize: %s"
+                    % block.name)
+        self.all_blocks_finished_initializing_event.set()
+
+    def run(self):
+        self.threads = [threading.Thread(target=block.run, name=block.name)
+                        for block in self.blocks]
+        for thread in self.threads:
+            thread.daemon = True
+            thread.start()
+        self.synchronize_block_initializations()
+        for thread in self.threads:
+            while thread.is_alive():
+                thread.join(timeout=2**30)
+
+    def shutdown(self):
+        for block in self.blocks:
+            block.shutdown()
+        self.all_blocks_finished_initializing_event.set()
+        join_all(self.threads, timeout=self.shutdown_timeout)
+        for thread in self.threads:
+            if thread.is_alive():
+                warnings.warn("Thread %s did not shut down in time"
+                              % thread.name, RuntimeWarning)
+
+    def shutdown_on_signals(self, signals=None):
+        if signals is None:
+            signals = [signal.SIGHUP, signal.SIGINT, signal.SIGQUIT,
+                       signal.SIGTERM, signal.SIGTSTP]
+        for sig in signals:
+            signal.signal(sig, self._handle_signal_shutdown)
+
+    def _handle_signal_shutdown(self, signum, frame):
+        warnings.warn("Received signal %d, shutting down pipeline" % signum,
+                      RuntimeWarning)
+        self.shutdown()
+
+    def __enter__(self):
+        _stacks.pipelines.append(self)
+        _stacks.scopes.append(self)
+        return self
+
+    def __exit__(self, typ, value, tb):
+        _stacks.scopes.pop()
+        popped = _stacks.pipelines.pop()
+        assert popped is self
+
+
+def get_ring(block_or_ring):
+    try:
+        return block_or_ring.orings[0]
+    except AttributeError:
+        return block_or_ring
+
+
+def block_view(block, header_transform):
+    """A view of ``block`` whose output headers are transformed on the fly
+    (reference: pipeline.py:305-322)."""
+    new_block = copy(block)
+    new_block.orings = [ring_view(oring, header_transform)
+                        for oring in new_block.orings]
+    return new_block
+
+
+class Block(BlockScope):
+    """Base class: ring ownership, thread entry, proclogs
+    (reference: pipeline.py:324-434)."""
+
+    instance_counts = defaultdict(lambda: 0)
+
+    def __init__(self, irings, name=None, type_=None, **kwargs):
+        self.type = type_ or self.__class__.__name__
+        self.name = name or ('%s_%i'
+                             % (self.type, Block.instance_counts[self.type]))
+        Block.instance_counts[self.type] += 1
+        super(Block, self).__init__(name=self.name, **kwargs)
+        self.pipeline = get_default_pipeline()
+        self.pipeline.blocks.append(self)
+
+        self.irings = [get_ring(iring) for iring in irings]
+        for i, (iring, valid) in enumerate(
+                zip(self.irings, self._define_valid_input_spaces())):
+            if not memory.space_accessible(iring.space, valid):
+                raise ValueError(
+                    "Block %s input %d's space (%s) must be accessible "
+                    "from one of: %s" % (self.name, i, iring.space, valid))
+        self.orings = []   # set by subclasses
+        self.shutdown_event = threading.Event()
+        self.bind_proclog = ProcLog(self.name + '/bind')
+        self.in_proclog = ProcLog(self.name + '/in')
+        rnames = {'nring': len(self.irings)}
+        for i, r in enumerate(self.irings):
+            rnames['ring%i' % i] = r.name
+        self.in_proclog.update(rnames)
+        self.init_trace = ''.join(traceback.format_stack()[:-1])
+
+    def shutdown(self):
+        self.shutdown_event.set()
+
+    def create_ring(self, *args, **kwargs):
+        return Ring(*args, owner=self, **kwargs)
+
+    def run(self):
+        if self.core is not None:
+            affinity.set_core(self.core if isinstance(self.core, int)
+                              else self.core[0])
+        self.bind_proclog.update({'ncore': 1, 'core0': affinity.get_core()})
+        if self.device is not None:
+            device.set_device(self.device)
+        self.cache_scope_hierarchy()
+        with ExitStack() as oring_stack:
+            active_orings = self.begin_writing(oring_stack, self.orings)
+            try:
+                self.main(active_orings)
+            except Exception:
+                self.pipeline.block_init_queue.put((self, False))
+                sys.stderr.write("From block instantiated here:\n")
+                sys.stderr.write(self.init_trace)
+                raise
+
+    def num_outputs(self):
+        return len(self.orings)
+
+    def begin_writing(self, exit_stack, orings):
+        return [exit_stack.enter_context(oring.begin_writing())
+                for oring in orings]
+
+    def begin_sequences(self, exit_stack, orings, oheaders,
+                        igulp_nframes, istride_nframes):
+        # The output header's gulp_nframe excludes overlap (stride-based;
+        # reference: pipeline.py:383-399).
+        ostride_nframes = self._define_output_nframes(istride_nframes)
+        for ohdr, ostride in zip(oheaders, ostride_nframes):
+            ohdr['gulp_nframe'] = ostride
+        ogulp_nframes = self._define_output_nframes(igulp_nframes)
+        # Writers only buffer one gulp; extra depth belongs to readers.
+        oseqs = [exit_stack.enter_context(
+                     oring.begin_sequence(ohdr, ogulp, 1 * ogulp))
+                 for oring, ohdr, ogulp
+                 in zip(orings, oheaders, ogulp_nframes)]
+        # Init barrier (reference: pipeline.py:401-403).
+        self.pipeline.block_init_queue.put((self, True))
+        self.pipeline.all_blocks_finished_initializing_event.wait()
+        ogulp_overlaps = [g - s for g, s
+                          in zip(ogulp_nframes, ostride_nframes)]
+        return oseqs, ogulp_overlaps
+
+    def reserve_spans(self, exit_stack, oseqs, igulp_nframes=()):
+        ogulp_nframes = self._define_output_nframes(list(igulp_nframes))
+        return [exit_stack.enter_context(oseq.reserve(onframe))
+                for oseq, onframe in zip(oseqs, ogulp_nframes)]
+
+    def commit_spans(self, ospans, ostrides_actual, ogulp_overlaps):
+        if ostrides_actual is None:
+            ostrides_actual = [None] * len(ospans)
+        ostrides = [ostride if ostride is not None
+                    else max(ospan.nframe - overlap, 0)
+                    for ostride, ospan, overlap
+                    in zip(ostrides_actual, ospans, ogulp_overlaps)]
+        for ospan, ostride in zip(ospans, ostrides):
+            ospan.commit(ostride)
+
+    # -- dispatch-ahead backpressure --------------------------------------
+    def _sync_gulp(self, ospans):
+        """Bound device run-ahead: enqueue this gulp's device arrays and
+        block on the gulp ``sync_depth`` iterations back."""
+        depth = self.sync_depth if self.sync_depth is not None else 1
+        pend = getattr(self, '_pending_outputs', None)
+        if pend is None:
+            pend = self._pending_outputs = deque()
+        arrays = [s._device_array for s in ospans
+                  if getattr(s, '_device_array', None) is not None]
+        if arrays:
+            pend.append(arrays)
+        while len(pend) > depth:
+            device.stream_synchronize(*pend.popleft())
+        if not arrays:
+            device.stream_synchronize()
+
+    # -- overridables ------------------------------------------------------
+    def _define_output_nframes(self, input_nframes):
+        return self.define_output_nframes(input_nframes)
+
+    def define_output_nframes(self, input_nframes):
+        raise NotImplementedError
+
+    def _define_valid_input_spaces(self):
+        return self.define_valid_input_spaces()
+
+    def define_valid_input_spaces(self):
+        return ['any'] * len(self.irings)
+
+
+class SourceBlock(Block):
+    """0-in/1-out block reading from named sources
+    (reference: pipeline.py:436-507)."""
+
+    def __init__(self, sourcenames, gulp_nframe, space=None, *args, **kwargs):
+        super(SourceBlock, self).__init__([], *args,
+                                          gulp_nframe=gulp_nframe, **kwargs)
+        self.sourcenames = sourcenames
+        if space is None:
+            space = 'system'
+        self.orings = [self.create_ring(space=space)]
+        self._seq_count = 0
+        self.perf_proclog = ProcLog(self.name + '/perf')
+        self.out_proclog = ProcLog(self.name + '/out')
+        rnames = {'nring': len(self.orings)}
+        for i, r in enumerate(self.orings):
+            rnames['ring%i' % i] = r.name
+        self.out_proclog.update(rnames)
+
+    def main(self, orings):
+        for sourcename in self.sourcenames:
+            if self.shutdown_event.is_set():
+                break
+            with self.create_reader(sourcename) as ireader:
+                oheaders = self.on_sequence(ireader, sourcename)
+                for ohdr in oheaders:
+                    ohdr.setdefault('time_tag', self._seq_count)
+                    ohdr.setdefault('name',
+                                    'unnamed-sequence-%i' % self._seq_count)
+                self._seq_count += 1
+                with ExitStack() as oseq_stack:
+                    oseqs, ogulp_overlaps = self.begin_sequences(
+                        oseq_stack, orings, oheaders,
+                        igulp_nframes=[], istride_nframes=[])
+                    while not self.shutdown_event.is_set():
+                        t0 = time.time()
+                        with ExitStack() as ospan_stack:
+                            ospans = self.reserve_spans(ospan_stack, oseqs)
+                            t1 = time.time()
+                            ostrides = self.on_data(ireader, ospans)
+                            self._sync_gulp(ospans)
+                            self.commit_spans(ospans, ostrides,
+                                              ogulp_overlaps)
+                            if any(o == 0 for o in ostrides):
+                                break
+                        t2 = time.time()
+                        self.perf_proclog.update({'acquire_time': -1,
+                                                  'reserve_time': t1 - t0,
+                                                  'process_time': t2 - t1})
+
+    def define_output_nframes(self, _):
+        return [self.gulp_nframe] * self.num_outputs()
+
+    def define_valid_input_spaces(self):
+        return []
+
+    def create_reader(self, sourcename):
+        raise NotImplementedError
+
+    def on_sequence(self, reader, sourcename):
+        """Return a list of output headers."""
+        raise NotImplementedError
+
+    def on_data(self, reader, ospans):
+        """Fill ospans; return frames committed per output."""
+        raise NotImplementedError
+
+
+class MultiTransformBlock(Block):
+    """N-in/N-out engine: zip-reads input rings, negotiates gulp/overlap,
+    handles skipped and overwritten frames
+    (reference: pipeline.py:517-688)."""
+
+    def __init__(self, irings_, guarantee=True, *args, **kwargs):
+        super(MultiTransformBlock, self).__init__(irings_, *args, **kwargs)
+        self.guarantee = guarantee
+        self.orings = [self.create_ring(space=iring.space)
+                       for iring in self.irings]
+        self._seq_count = 0
+        self.perf_proclog = ProcLog(self.name + '/perf')
+        self.sequence_proclogs = [ProcLog(self.name + '/sequence%i' % i)
+                                  for i in range(len(self.irings))]
+        self.out_proclog = ProcLog(self.name + '/out')
+        rnames = {'nring': len(self.orings)}
+        for i, r in enumerate(self.orings):
+            rnames['ring%i' % i] = r.name
+        self.out_proclog.update(rnames)
+
+    def main(self, orings):
+        for iseqs in izip(*[iring.read(guarantee=self.guarantee)
+                            for iring in self.irings]):
+            if self.shutdown_event.is_set():
+                break
+            for i, iseq in enumerate(iseqs):
+                self.sequence_proclogs[i].update(iseq.header)
+            oheaders = self._on_sequence(iseqs)
+            for ohdr in oheaders:
+                ohdr.setdefault('time_tag', self._seq_count)
+            self._seq_count += 1
+
+            igulp_nframes = [self.gulp_nframe or iseq.header['gulp_nframe']
+                             for iseq in iseqs]
+            igulp_overlaps = self._define_input_overlap_nframe(iseqs)
+            istride_nframes = igulp_nframes[:]
+            igulp_nframes = [g + o for g, o
+                             in zip(igulp_nframes, igulp_overlaps)]
+
+            for iseq, igulp_nframe in zip(iseqs, igulp_nframes):
+                if self.buffer_factor is None:
+                    src_block = iseq.ring.owner
+                    # Fused scopes share one gulp of buffering so that
+                    # producer and consumer alternate (reference:
+                    # pipeline.py:558-568).
+                    if src_block is not None and \
+                            self.is_fused_with(src_block):
+                        buffer_factor = 1
+                    else:
+                        buffer_factor = None
+                else:
+                    buffer_factor = self.buffer_factor
+                iseq.resize(gulp_nframe=igulp_nframe,
+                            buf_nframe=self.buffer_nframe,
+                            buffer_factor=buffer_factor)
+
+            iframe0s = [0 for _ in igulp_nframes]
+            force_skip = False
+
+            with ExitStack() as oseq_stack:
+                oseqs, ogulp_overlaps = self.begin_sequences(
+                    oseq_stack, orings, oheaders,
+                    igulp_nframes, istride_nframes)
+                if self.shutdown_event.is_set():
+                    break
+                prev_time = time.time()
+                for ispans in izip(*[iseq.read(igulp, istride, iframe0)
+                                     for iseq, igulp, istride, iframe0
+                                     in zip(iseqs, igulp_nframes,
+                                            istride_nframes, iframe0s)]):
+                    if self.shutdown_event.is_set():
+                        return
+
+                    if any(ispan.nframe_skipped for ispan in ispans):
+                        # Zero-fill frames lost to overwriting
+                        # (reference: pipeline.py:590-606).
+                        with ExitStack() as ospan_stack:
+                            iskip_slices = [
+                                slice(f0, f0 + ispan.nframe_skipped, istride)
+                                for f0, istride, ispan
+                                in zip(iframe0s, istride_nframes, ispans)]
+                            iskip_nframes = [ispan.nframe_skipped
+                                             for ispan in ispans]
+                            ospans = self.reserve_spans(
+                                ospan_stack, oseqs, iskip_nframes)
+                            ostrides = self._on_skip(iskip_slices, ospans)
+                            self._sync_gulp(ospans)
+                            self.commit_spans(ospans, ostrides,
+                                              ogulp_overlaps)
+
+                    if all(ispan.nframe == 0 for ispan in ispans):
+                        continue
+
+                    cur_time = time.time()
+                    acquire_time = cur_time - prev_time
+                    prev_time = cur_time
+
+                    with ExitStack() as ospan_stack:
+                        cur_igulps = [ispan.nframe for ispan in ispans]
+                        ospans = self.reserve_spans(ospan_stack, oseqs,
+                                                    cur_igulps)
+                        cur_time = time.time()
+                        reserve_time = cur_time - prev_time
+                        prev_time = cur_time
+
+                        if not force_skip:
+                            ostrides = self._on_data(ispans, ospans)
+                            self._sync_gulp(ospans)
+
+                        any_overwritten = any(ispan.nframe_overwritten
+                                              for ispan in ispans)
+                        if force_skip or any_overwritten:
+                            # Force-skip a gulp to let interrupted pipelines
+                            # catch up (reference: pipeline.py:630-644).
+                            force_skip = any_overwritten
+                            iskip_slices = [
+                                slice(ispan.frame_offset,
+                                      ispan.frame_offset +
+                                      ispan.nframe_overwritten,
+                                      istride)
+                                for ispan, istride
+                                in zip(ispans, istride_nframes)]
+                            ostrides = self._on_skip(iskip_slices, ospans)
+                            self._sync_gulp(ospans)
+
+                        self.commit_spans(ospans, ostrides, ogulp_overlaps)
+                    cur_time = time.time()
+                    process_time = cur_time - prev_time
+                    prev_time = cur_time
+                    self.perf_proclog.update({'acquire_time': acquire_time,
+                                              'reserve_time': reserve_time,
+                                              'process_time': process_time})
+            self._on_sequence_end(iseqs)
+
+    # -- dispatch shims ----------------------------------------------------
+    def _on_sequence(self, iseqs):
+        return self.on_sequence(iseqs)
+
+    def _on_sequence_end(self, iseqs):
+        return self.on_sequence_end(iseqs)
+
+    def _on_data(self, ispans, ospans):
+        return self.on_data(ispans, ospans)
+
+    def _on_skip(self, islices, ospans):
+        return self.on_skip(islices, ospans)
+
+    def _define_input_overlap_nframe(self, iseqs):
+        return self.define_input_overlap_nframe(iseqs)
+
+    # -- overridables ------------------------------------------------------
+    def define_input_overlap_nframe(self, iseqs):
+        """Frames of overlap between successive input spans (per input) —
+        used by FIR/FDMT for filter history."""
+        return [0] * len(self.irings)
+
+    def define_output_nframes(self, input_nframes):
+        return input_nframes
+
+    def on_sequence(self, iseqs):
+        """Return oheaders (one per output)."""
+        raise NotImplementedError
+
+    def on_sequence_end(self, iseqs):
+        pass
+
+    def on_data(self, ispans, ospans):
+        """Process ispans into ospans; return frames to commit per output
+        (or None to commit complete spans)."""
+        raise NotImplementedError
+
+    def on_skip(self, islices, ospans):
+        raise NotImplementedError
+
+
+class TransformBlock(MultiTransformBlock):
+    """1-in/1-out specialization (reference: pipeline.py:690-741)."""
+
+    def __init__(self, iring, *args, **kwargs):
+        super(TransformBlock, self).__init__([iring], *args, **kwargs)
+        self.iring = self.irings[0]
+
+    def _define_valid_input_spaces(self):
+        return [self.define_valid_input_spaces()]
+
+    def define_valid_input_spaces(self):
+        return 'any'
+
+    def _define_input_overlap_nframe(self, iseqs):
+        return [self.define_input_overlap_nframe(iseqs[0])]
+
+    def define_input_overlap_nframe(self, iseq):
+        return 0
+
+    def _define_output_nframes(self, input_nframes):
+        return [self.define_output_nframes(input_nframes[0])]
+
+    def define_output_nframes(self, input_nframe):
+        return input_nframe
+
+    def _on_sequence(self, iseqs):
+        return [self.on_sequence(iseqs[0])]
+
+    def on_sequence(self, iseq):
+        raise NotImplementedError
+
+    def _on_sequence_end(self, iseqs):
+        return [self.on_sequence_end(iseqs[0])]
+
+    def on_sequence_end(self, iseq):
+        pass
+
+    def _on_data(self, ispans, ospans):
+        return [self.on_data(ispans[0], ospans[0])]
+
+    def on_data(self, ispan, ospan):
+        raise NotImplementedError
+
+    def _on_skip(self, islices, ospans):
+        return [self.on_skip(islices[0], ospans[0])]
+
+    def on_skip(self, islice, ospan):
+        """Zero-fill the output gulp for skipped input frames."""
+        if ospan.ring.space == 'tpu':
+            from .devrep import device_rep_zeros
+            t = ospan.tensor
+            shape = (t['ringlet_shape'] + [ospan.nframe] + t['frame_shape'])
+            ospan.set(device_rep_zeros(shape, t['dtype']))
+        else:
+            memset_array(ospan.data, 0)
+
+
+class SinkBlock(MultiTransformBlock):
+    """1-in/0-out specialization (reference: pipeline.py:744-779)."""
+
+    def __init__(self, iring, *args, **kwargs):
+        super(SinkBlock, self).__init__([iring], *args, **kwargs)
+        self.orings = []
+        self.iring = self.irings[0]
+
+    def _define_valid_input_spaces(self):
+        return [self.define_valid_input_spaces()]
+
+    def define_valid_input_spaces(self):
+        return 'any'
+
+    def _define_input_overlap_nframe(self, iseqs):
+        return [self.define_input_overlap_nframe(iseqs[0])]
+
+    def define_input_overlap_nframe(self, iseq):
+        return 0
+
+    def _define_output_nframes(self, input_nframes):
+        return []
+
+    def _on_sequence(self, iseqs):
+        self.on_sequence(iseqs[0])
+        return []
+
+    def on_sequence(self, iseq):
+        raise NotImplementedError
+
+    def _on_sequence_end(self, iseqs):
+        return [self.on_sequence_end(iseqs[0])]
+
+    def on_sequence_end(self, iseq):
+        pass
+
+    def _on_data(self, ispans, ospans):
+        self.on_data(ispans[0])
+        return []
+
+    def on_data(self, ispan):
+        raise NotImplementedError
+
+    def _on_skip(self, islices, ospans):
+        return []

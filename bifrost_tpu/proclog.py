@@ -1,0 +1,128 @@
+"""ProcLog: filesystem-based runtime status publishing.
+
+Every block publishes small status files under ``$BF_PROCLOG_DIR``
+(default ``/dev/shm/bifrost_tpu``)``/<pid>/<block>/<log>``, which the CLI
+tools (like_top, pipeline2dot) render.  Mirrors the reference mechanism
+(reference: src/proclog.cpp:45-147, python/bifrost/proclog.py:40-143),
+including stale-PID garbage collection on startup.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+
+__all__ = ['ProcLog', 'load_by_pid', 'load_by_filename']
+
+_lock = threading.Lock()
+_gc_done = False
+
+
+def proclog_dir():
+    base = os.environ.get('BF_PROCLOG_DIR')
+    if base is None:
+        base = '/dev/shm/bifrost_tpu' if os.path.isdir('/dev/shm') \
+            else os.path.join(os.path.expanduser('~'), '.bifrost_tpu',
+                              'proclog')
+    return base
+
+
+def _pid_exists(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _gc_stale():
+    """Remove proclog trees of dead processes (reference: proclog.cpp
+    ProcLogMgr stale-PID cleanup)."""
+    base = proclog_dir()
+    if not os.path.isdir(base):
+        return
+    for entry in os.listdir(base):
+        if not entry.isdigit():
+            continue
+        if not _pid_exists(int(entry)):
+            shutil.rmtree(os.path.join(base, entry), ignore_errors=True)
+
+
+class ProcLog(object):
+    def __init__(self, name):
+        global _gc_done
+        self.name = name
+        self.path = os.path.join(proclog_dir(), str(os.getpid()), name)
+        with _lock:
+            if not _gc_done:
+                try:
+                    _gc_stale()
+                except OSError:
+                    pass
+                _gc_done = True
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        except OSError:
+            pass
+
+    def update(self, contents):
+        """Write ``key : value`` lines (dict) or a raw string."""
+        if isinstance(contents, dict):
+            text = ''.join('%s : %s\n' % (k, v) for k, v in contents.items())
+        else:
+            text = str(contents)
+        try:
+            tmp = self.path + '.tmp'
+            with open(tmp, 'w') as f:
+                f.write(text)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+    def close(self):
+        pass
+
+
+def _parse_value(v):
+    v = v.strip()
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            continue
+    return v
+
+
+def load_by_filename(path):
+    """Parse one proclog file into a dict
+    (reference: proclog.py:69-91)."""
+    out = {}
+    with open(path, 'r') as f:
+        for line in f:
+            if ':' not in line:
+                continue
+            k, _, v = line.partition(':')
+            out[k.strip()] = _parse_value(v)
+    return out
+
+
+def load_by_pid(pid, include_rings=False):
+    """Parse all proclogs of a process into
+    {block: {log: {key: value}}} (reference: proclog.py:93-143)."""
+    root = os.path.join(proclog_dir(), str(pid))
+    contents = {}
+    for dirpath, _, filenames in os.walk(root):
+        for fname in filenames:
+            if fname.endswith('.tmp'):
+                continue
+            path = os.path.join(dirpath, fname)
+            block = os.path.relpath(dirpath, root)
+            try:
+                parsed = load_by_filename(path)
+            except (OSError, ValueError):
+                continue
+            contents.setdefault(block, {})[fname] = parsed
+    return contents

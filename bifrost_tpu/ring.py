@@ -1,0 +1,976 @@
+"""Ring buffer runtime — the heart of the framework.
+
+This re-implements the semantics of the reference ring
+(reference: src/ring_impl.{hpp,cpp}, src/ring.cpp, python/bifrost/ring2.py)
+with a TPU-first storage model:
+
+- **Host rings** ('system' / 'tpu_host'): a numpy byte buffer of
+  ``nringlet`` lanes, each ``size + ghost`` bytes.  The ghost region makes
+  wrap-around spans contiguous (reference: ring_impl.cpp:249-288 ghost
+  copies); spans are zero-copy strided numpy views.
+
+- **Device rings** ('tpu'): HBM is owned by the XLA runtime, so instead of
+  a byte buffer the ring keeps a *chunk map* of committed ``jax.Array``
+  gulps keyed by absolute byte offset.  All flow-control/ordering/overwrite
+  bookkeeping is identical to the host path; only the payload differs.
+  Because jax arrays are async futures, committing a span does NOT
+  synchronize the device — readers force values only when they consume
+  them, which preserves bifrost's pipelined-gulp execution model without
+  an explicit stream_synchronize (reference: pipeline.py:628).
+
+Semantics preserved from the reference:
+
+- absolute monotonic byte offsets; buffer index = offset % size
+- sequences (named data units w/ JSON-able header, time_tag), linked in
+  order (reference: ring_impl.hpp:262-295)
+- guaranteed readers refcount-lock the tail; unguaranteed readers can have
+  data overwritten out from under them and observe ``nframe_skipped`` /
+  ``nframe_overwritten`` (reference: ring_impl.hpp:110-141, 444-452)
+- blocking acquire with partial final span at sequence end
+  (reference: ring_impl.cpp:633-704)
+- in-order commit barrier for multiple outstanding write spans
+  (reference: ring_impl.cpp:591-594)
+- live resize that preserves buffered data (reference: ring_impl.cpp:115-210)
+
+One deliberate improvement over the reference: skip offsets are rounded up
+to whole frames inside the core (the reference notes this as a latent bug,
+ring2.py:381-388).
+"""
+
+from __future__ import annotations
+
+import json
+import string
+import threading
+from copy import copy, deepcopy
+from functools import reduce
+
+import numpy as np
+
+from .dtype import DataType
+from .space import canonical
+from .ndarray import ndarray
+
+__all__ = ['Ring', 'RingWriter', 'WriteSequence', 'ReadSequence',
+           'WriteSpan', 'ReadSpan', 'EndOfDataStop', 'WouldBlock',
+           'split_shape', 'ring_view']
+
+_INF = float('inf')
+
+
+class EndOfDataStop(Exception):
+    """Raised when a read reaches the end of a ring's data
+    (reference: libbifrost.py:131-136 BF_STATUS_END_OF_DATA)."""
+
+
+class WouldBlock(Exception):
+    """Raised by nonblocking reserve when space is unavailable
+    (reference: BF_STATUS_WOULD_BLOCK)."""
+
+
+def split_shape(shape):
+    """Split a tensor shape at the time axis (-1) into
+    (ringlet_shape, frame_shape): (2,3,-1,4,5) -> ([2,3], [4,5])
+    (reference: ring2.py:60-70)."""
+    ringlet_shape = []
+    for i, dim in enumerate(shape):
+        if dim == -1:
+            return ringlet_shape, list(shape[i + 1:])
+        ringlet_shape.append(dim)
+    raise ValueError("No time dimension (-1) found in shape %s" % (shape,))
+
+
+def _slugify(name):
+    valid = frozenset("-_.() %s%s" % (string.ascii_letters, string.digits))
+    return ''.join(c for c in name if c in valid)
+
+
+def ring_view(ring, header_transform):
+    """A view of ``ring`` whose read sequences present transformed headers
+    (reference: ring2.py:75-82)."""
+    new_ring = ring.view()
+    old = ring.header_transform
+    if old is not None:
+        inner = header_transform
+        header_transform = lambda hdr: inner(old(hdr))
+    new_ring.header_transform = header_transform
+    return new_ring
+
+
+def _tensor_info(header):
+    """Compute per-frame layout from a sequence header's ``_tensor``
+    (reference: ring2.py:193-212)."""
+    t = header['_tensor']
+    ringlet_shape, frame_shape = split_shape(t['shape'])
+    dtype = DataType(t['dtype'])
+    nringlet = reduce(lambda x, y: x * y, ringlet_shape, 1)
+    frame_nelement = reduce(lambda x, y: x * y, frame_shape, 1)
+    frame_nbit = frame_nelement * dtype.itemsize_bits
+    if frame_nbit % 8:
+        raise ValueError("Frame of %s x %s does not span whole bytes"
+                         % (frame_shape, dtype))
+    return {
+        'dtype': dtype,
+        'ringlet_shape': ringlet_shape,
+        'nringlet': nringlet,
+        'frame_shape': frame_shape,
+        'frame_nbyte': frame_nbit // 8,
+        'dtype_nbyte': (dtype.itemsize_bits + 7) // 8,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Storage backends
+# ---------------------------------------------------------------------------
+
+class _HostStorage(object):
+    """Byte-buffer storage with ghost region (host spaces)."""
+
+    def __init__(self):
+        self.buf = None          # (nringlet, size + ghost) uint8
+        self.size = 0
+        self.ghost = 0
+        self.nringlet = 1
+
+    def allocate(self, size, ghost, nringlet, tail, head, old=None):
+        new = np.zeros((nringlet, size + ghost), dtype=np.uint8)
+        if old is not None and old.buf is not None and head > tail:
+            # preserve [tail, head) across the re-layout
+            n = head - tail
+            if n > size:
+                tail = head - size
+                n = size
+            o = tail
+            while o < head:
+                run = min(head - o, old.size - o % old.size,
+                          size - o % size)
+                new[:, o % size:o % size + run] = \
+                    old.buf[:, o % old.size:o % old.size + run]
+                o += run
+        self.buf, self.size, self.ghost, self.nringlet = \
+            new, size, ghost, nringlet
+
+    def write_view(self, offset, nbyte):
+        bo = offset % self.size
+        return self.buf[:, bo:bo + nbyte]
+
+    read_view = write_view
+
+    def commit_ghost(self, offset, nbyte):
+        """After a write that ran past the nominal end, mirror the overflow
+        back to the buffer start (reference: _ghost_write,
+        ring_impl.cpp:249-288)."""
+        bo = offset % self.size
+        over = bo + nbyte - self.size
+        if over > 0:
+            self.buf[:, :over] = self.buf[:, self.size:self.size + over]
+
+    def refresh_ghost(self, offset, nbyte):
+        """Before a read that runs past the nominal end, refresh the ghost
+        from the buffer start (reference: _ghost_read)."""
+        bo = offset % self.size
+        over = bo + nbyte - self.size
+        if over > 0:
+            self.buf[:, self.size:self.size + over] = self.buf[:, :over]
+
+    def discard_before(self, offset):
+        pass  # byte buffer reclaims implicitly
+
+
+class _DeviceStorage(object):
+    """Chunk-map storage for 'tpu' rings: committed gulps are jax arrays
+    keyed by absolute byte offset.  Logical shape of each chunk is
+    (*ringlet_shape, nframe, *frame_shape)."""
+
+    def __init__(self):
+        self.chunks = {}   # abs byte offset -> (nbyte, jax.Array, time_axis)
+        self.size = 0
+        self.ghost = 0
+        self.nringlet = 1
+
+    def allocate(self, size, ghost, nringlet, tail, head, old=None):
+        if old is not None and old is not self:
+            self.chunks = dict(old.chunks)
+        self.size, self.ghost, self.nringlet = size, ghost, nringlet
+
+    def put(self, offset, nbyte, array, time_axis):
+        self.chunks[offset] = (nbyte, array, time_axis)
+
+    def get(self, offset, nbyte, frame_nbyte, zeros_fn):
+        """Assemble the logical array covering [offset, offset+nbyte).
+        Fast path: a single committed chunk covers the request exactly."""
+        hit = self.chunks.get(offset)
+        if hit is not None and hit[0] == nbyte:
+            return hit[1]
+        # Slow path: stitch overlapping chunks along the time axis.
+        import jax.numpy as jnp
+        want_frames = nbyte // frame_nbyte
+        pieces, covered = [], offset
+        for o in sorted(self.chunks):
+            cn, arr, taxis = self.chunks[o]
+            if o + cn <= covered or o >= offset + nbyte:
+                continue
+            if o > covered:  # gap (overwritten / never written): zero fill
+                pieces.append(zeros_fn((o - covered) // frame_nbyte))
+                covered = o
+            f0 = (covered - o) // frame_nbyte
+            f1 = min(cn, offset + nbyte - o) // frame_nbyte
+            idx = [slice(None)] * arr.ndim
+            idx[taxis] = slice(f0, f1)
+            pieces.append(arr[tuple(idx)])
+            covered = o + f1 * frame_nbyte
+        if covered < offset + nbyte:
+            pieces.append(zeros_fn((offset + nbyte - covered) // frame_nbyte))
+        if not pieces:
+            return zeros_fn(want_frames)
+        if len(pieces) == 1:
+            return pieces[0]
+        taxis = next(iter(self.chunks.values()))[2] if self.chunks else 0
+        return jnp.concatenate(pieces, axis=taxis)
+
+    def discard_before(self, offset):
+        dead = [o for o, (cn, _, _) in self.chunks.items() if o + cn <= offset]
+        for o in dead:
+            del self.chunks[o]
+
+
+# ---------------------------------------------------------------------------
+# Sequence bookkeeping (internal)
+# ---------------------------------------------------------------------------
+
+class _Sequence(object):
+    __slots__ = ('name', 'time_tag', 'header', 'begin', 'end', 'next',
+                 'nringlet')
+
+    def __init__(self, name, time_tag, header, begin, nringlet):
+        self.name = name
+        self.time_tag = time_tag
+        self.header = header
+        self.begin = begin      # absolute byte offset of frame 0
+        self.end = None         # absolute byte offset one past last frame
+        self.next = None
+        self.nringlet = nringlet
+
+    @property
+    def finished(self):
+        return self.end is not None
+
+
+# ---------------------------------------------------------------------------
+# Ring
+# ---------------------------------------------------------------------------
+
+class Ring(object):
+    """A first-in-first-out multi-reader byte ring with named sequences.
+
+    API mirrors the reference Ring (reference: python/bifrost/ring2.py:84-148)
+    so pipelines written against bifrost run unmodified.
+    """
+
+    instance_count = 0
+
+    def __init__(self, space='system', name=None, owner=None, core=None):
+        self.space = canonical(space)
+        if name is None:
+            name = 'ring_%i' % Ring.instance_count
+            Ring.instance_count += 1
+        self.name = _slugify(name)
+        self.owner = owner
+        self.core = core
+        self.header_transform = None
+        self.base = None
+        self.is_view = False
+
+        self._lock = threading.RLock()
+        self._read_cond = threading.Condition(self._lock)
+        self._write_cond = threading.Condition(self._lock)
+        self._seq_cond = threading.Condition(self._lock)
+        self._span_cond = threading.Condition(self._lock)
+
+        self._storage = _DeviceStorage() if self.space == 'tpu' \
+            else _HostStorage()
+        self._size = 0
+        self._ghost = 0
+        self._nringlet = 1
+        self._tail = 0
+        self._head = 0
+        self._reserve_head = 0
+        self._sequences = []          # ordered
+        self._seq_by_name = {}
+        self._open_wspans = []        # in reserve order
+        self._guarantees = {}         # id(ReadSequence) -> abs offset
+        self._writing = False
+        self._eod = False
+        self._nwrite_open = 0
+        self._nread_open = 0
+
+    # -- views ------------------------------------------------------------
+    def view(self):
+        """A reader-side view of this ring.  Views share ALL ring state
+        (geometry, storage, synchronization) with the base ring and differ
+        only in their header transform (reference: ring2.py:108-112)."""
+        return RingView(self)
+
+    # -- geometry ---------------------------------------------------------
+    def resize(self, contiguous_bytes, total_bytes=None, nringlet=1):
+        """(Re)allocate the ring: max contiguous span + total capacity,
+        preserving live data (reference: bfRingResize / ring_impl.cpp:115-210).
+        """
+        with self._lock:
+            if total_bytes is None:
+                total_bytes = contiguous_bytes * 4
+            ghost = max(self._ghost, contiguous_bytes)
+            size = max(self._size, total_bytes)
+            nringlet = max(self._nringlet, nringlet)
+            if (size == self._size and ghost == self._ghost and
+                    nringlet == self._nringlet):
+                return
+            # Wait until no spans are open anywhere before re-laying-out
+            # (reference: RingReallocLock, ring_impl.cpp:60-84).
+            while self._nwrite_open or self._nread_open:
+                self._span_cond.wait()
+            old = copy(self._storage)
+            old.buf = getattr(self._storage, 'buf', None)
+            self._storage.allocate(size, ghost, nringlet,
+                                   self._tail, self._head, old=old)
+            self._size, self._ghost, self._nringlet = size, ghost, nringlet
+            self._write_cond.notify_all()
+            self._read_cond.notify_all()
+
+    @property
+    def total_span(self):
+        return self._size
+
+    @property
+    def nringlet(self):
+        return self._nringlet
+
+    # -- writer side ------------------------------------------------------
+    def begin_writing(self):
+        return RingWriter(self)
+
+    def _begin_writing(self):
+        with self._lock:
+            self._writing = True
+            self._eod = False
+
+    def end_writing(self):
+        with self._lock:
+            self._writing = False
+            self._eod = True
+            self._read_cond.notify_all()
+            self._seq_cond.notify_all()
+
+    @property
+    def writing_ended(self):
+        return self._eod
+
+    def _begin_sequence(self, name, time_tag, header, nringlet):
+        with self._lock:
+            seq = _Sequence(name, time_tag, header, self._head, nringlet)
+            if self._sequences:
+                prev = self._sequences[-1]
+                if not prev.finished:
+                    raise RuntimeError(
+                        "Cannot begin sequence %r: previous sequence %r "
+                        "is still open" % (name, prev.name))
+                prev.next = seq
+            self._sequences.append(seq)
+            self._seq_by_name[name] = seq
+            self._seq_cond.notify_all()
+            return seq
+
+    def _end_sequence(self, seq):
+        with self._lock:
+            seq.end = self._head
+            self._read_cond.notify_all()
+            self._seq_cond.notify_all()
+
+    def _min_guarantee(self):
+        return min(self._guarantees.values()) if self._guarantees else _INF
+
+    def _reserve_span(self, nbyte, nonblocking=False):
+        with self._lock:
+            if nbyte > self._ghost:
+                # Guaranteed-contiguous window too small; grow it.
+                self._lock.release()
+                try:
+                    self.resize(nbyte, max(self._size, nbyte * 4),
+                                self._nringlet)
+                finally:
+                    self._lock.acquire()
+            begin = self._reserve_head
+            new_reserve = begin + nbyte
+            while True:
+                new_tail = new_reserve - self._size
+                limit = min(self._head, self._min_guarantee())
+                if new_tail <= limit:
+                    break
+                if nonblocking:
+                    raise WouldBlock()
+                self._write_cond.wait()
+            self._reserve_head = new_reserve
+            if new_reserve - self._size > self._tail:
+                self._advance_tail(new_reserve - self._size)
+            return begin
+
+    def _advance_tail(self, new_tail):
+        # Overwrite: pull the tail forward past unguaranteed readers
+        # (reference: _advance_reserve_head tail-pull, ring_impl.cpp:509-555).
+        self._tail = new_tail
+        self._storage.discard_before(new_tail)
+        # GC fully-consumed finished sequences
+        while (len(self._sequences) > 1 and self._sequences[0].finished and
+               self._sequences[0].end <= new_tail and
+               self._sequences[0].next is not None):
+            dead = self._sequences.pop(0)
+            if self._seq_by_name.get(dead.name) is dead:
+                del self._seq_by_name[dead.name]
+
+    def _commit_span(self, wspan, commit_nbyte):
+        with self._lock:
+            wspan._commit_nbyte = commit_nbyte
+            wspan._closed = True
+            # In-order commit barrier (reference: ring_impl.cpp:591-594):
+            # apply commits only for the prefix of closed spans.
+            while self._open_wspans and self._open_wspans[0]._closed:
+                sp = self._open_wspans.pop(0)
+                cb = sp._commit_nbyte
+                if cb < sp._nbyte:
+                    if self._open_wspans:
+                        raise RuntimeError(
+                            "Partial commit with later spans outstanding")
+                    self._reserve_head = sp._begin + cb
+                self._head = sp._begin + cb
+                if cb > 0:
+                    sp._finalize_storage(cb)
+            self._nwrite_open -= 1
+            self._read_cond.notify_all()
+            self._span_cond.notify_all()
+
+    # -- reader side ------------------------------------------------------
+    def open_sequence(self, name, guarantee=True):
+        return ReadSequence(self, which='specific', name=name,
+                            guarantee=guarantee)
+
+    def open_sequence_at(self, time_tag, guarantee=True):
+        return ReadSequence(self, which='at', time_tag=time_tag,
+                            guarantee=guarantee)
+
+    def open_latest_sequence(self, guarantee=True):
+        return ReadSequence(self, which='latest', guarantee=guarantee)
+
+    def open_earliest_sequence(self, guarantee=True):
+        return ReadSequence(self, which='earliest', guarantee=guarantee)
+
+    def read(self, whence='earliest', guarantee=True):
+        """Generator over sequences as they appear
+        (reference: ring2.py:140-148)."""
+        with ReadSequence(self, which=whence, guarantee=guarantee,
+                          header_transform=self.header_transform) as cur_seq:
+            while True:
+                try:
+                    yield cur_seq
+                    cur_seq.increment()
+                except EndOfDataStop:
+                    return
+
+    def _open_seq(self, which, name=None, time_tag=None):
+        with self._lock:
+            while True:
+                if which == 'specific':
+                    if name in self._seq_by_name:
+                        return self._seq_by_name[name]
+                elif which == 'at':
+                    for seq in self._sequences:
+                        if seq.time_tag == time_tag:
+                            return seq
+                elif which == 'latest':
+                    if self._sequences:
+                        return self._sequences[-1]
+                elif which == 'earliest':
+                    # earliest sequence with any unconsumed data
+                    for seq in self._sequences:
+                        if not seq.finished or seq.end > self._tail:
+                            return seq
+                    if self._sequences:
+                        return self._sequences[-1]
+                else:
+                    raise ValueError("Invalid 'which': %r" % which)
+                if self._eod:
+                    raise EndOfDataStop("No sequence available")
+                self._seq_cond.wait()
+
+    def _next_seq(self, seq):
+        with self._lock:
+            while seq.next is None:
+                if self._eod and seq.finished:
+                    raise EndOfDataStop("No next sequence")
+                self._seq_cond.wait()
+            return seq.next
+
+    def _acquire_span(self, rseq, offset, nbyte, frame_nbyte):
+        """Block until [seq.begin+offset, +nbyte) is readable; returns
+        (abs_begin, actual_nbyte) with skip rounded up to whole frames
+        (reference: ring_impl.cpp:633-704)."""
+        seq = rseq._seq
+        with self._lock:
+            want_begin = seq.begin + offset
+            if rseq.guarantee:
+                self._guarantees[id(rseq)] = max(
+                    self._guarantees.get(id(rseq), want_begin),
+                    min(want_begin, self._head))
+            while True:
+                seq_end = seq.end if seq.finished else None
+                if seq_end is not None and want_begin >= seq_end:
+                    raise EndOfDataStop("Sequence consumed")
+                limit = seq_end if seq_end is not None else \
+                    (self._head if self._eod else None)
+                if self._eod and limit is not None and want_begin >= limit:
+                    raise EndOfDataStop("Ring consumed")
+                if want_begin + nbyte <= self._head:
+                    end = want_begin + nbyte
+                    break
+                if limit is not None and limit <= self._head:
+                    end = min(limit, want_begin + nbyte)
+                    break
+                self._read_cond.wait()
+            # Skip data already overwritten, rounding up to frames.
+            begin = want_begin
+            if begin < self._tail:
+                skip = self._tail - begin
+                skip = -(-skip // frame_nbyte) * frame_nbyte
+                begin = min(begin + skip, end)
+            if rseq.guarantee:
+                self._guarantees[id(rseq)] = begin
+                # (no overwrite possible beyond here until released)
+            self._nread_open += 1
+            return begin, max(end - begin, 0)
+
+    def _release_span(self, rseq, span_begin):
+        with self._lock:
+            if rseq.guarantee and id(rseq) in self._guarantees:
+                self._guarantees[id(rseq)] = max(
+                    self._guarantees[id(rseq)], span_begin)
+            self._nread_open -= 1
+            self._write_cond.notify_all()
+            self._span_cond.notify_all()
+
+    def _close_read_seq(self, rseq):
+        with self._lock:
+            self._guarantees.pop(id(rseq), None)
+            self._write_cond.notify_all()
+
+    def _overwritten_in(self, begin, nbyte):
+        with self._lock:
+            return max(0, min(self._tail - begin, nbyte))
+
+
+class RingView(object):
+    """Delegating reader-side view of a Ring: same buffer, same
+    synchronization, different header transform.  (The reference implements
+    this as a shallow copy over a shared C++ object, ring2.py:108-112;
+    here the Python Ring *is* the implementation, so the view must forward
+    every stateful operation to the base.)"""
+
+    def __init__(self, base, header_transform=None):
+        if isinstance(base, RingView):
+            base = base._base_ring
+        self._base_ring = base
+        self.header_transform = header_transform
+        self.is_view = True
+
+    @property
+    def base(self):
+        return self._base_ring
+
+    def view(self):
+        return RingView(self._base_ring, self.header_transform)
+
+    def __getattr__(self, name):
+        return getattr(self._base_ring, name)
+
+    def open_sequence(self, name, guarantee=True):
+        return ReadSequence(self._base_ring, which='specific', name=name,
+                            guarantee=guarantee,
+                            header_transform=self.header_transform)
+
+    def open_sequence_at(self, time_tag, guarantee=True):
+        return ReadSequence(self._base_ring, which='at', time_tag=time_tag,
+                            guarantee=guarantee,
+                            header_transform=self.header_transform)
+
+    def open_latest_sequence(self, guarantee=True):
+        return ReadSequence(self._base_ring, which='latest',
+                            guarantee=guarantee,
+                            header_transform=self.header_transform)
+
+    def open_earliest_sequence(self, guarantee=True):
+        return ReadSequence(self._base_ring, which='earliest',
+                            guarantee=guarantee,
+                            header_transform=self.header_transform)
+
+    def read(self, whence='earliest', guarantee=True):
+        with ReadSequence(self._base_ring, which=whence,
+                          guarantee=guarantee,
+                          header_transform=self.header_transform) as cur_seq:
+            while True:
+                try:
+                    yield cur_seq
+                    cur_seq.increment()
+                except EndOfDataStop:
+                    return
+
+
+class RingWriter(object):
+    """Writing session: ``with ring.begin_writing() as w:``
+    (reference: ring2.py:150-162)."""
+
+    def __init__(self, ring):
+        self.ring = ring
+        self.ring._begin_writing()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, typ, value, tb):
+        self.ring.end_writing()
+
+    def begin_sequence(self, header, gulp_nframe, buf_nframe):
+        return WriteSequence(self.ring, header, gulp_nframe, buf_nframe)
+
+
+class _SequenceAPI(object):
+    """Shared header/tensor helpers for read+write sequences
+    (reference: ring2.py:164-227)."""
+
+    @property
+    def ring(self):
+        return self._ring
+
+    @property
+    def name(self):
+        return self._seq.name
+
+    @property
+    def time_tag(self):
+        return self._seq.time_tag
+
+    @property
+    def nringlet(self):
+        return self._seq.nringlet
+
+    @property
+    def header(self):
+        return self._seq.header
+
+    @property
+    def tensor(self):
+        if self._tensor is None:
+            self._tensor = _tensor_info(self.header)
+        return self._tensor
+
+
+class WriteSequence(_SequenceAPI):
+    def __init__(self, ring, header, gulp_nframe, buf_nframe):
+        self._ring = ring
+        self._tensor = None
+        header['_tensor']['dtype'] = str(header['_tensor']['dtype'])
+        # Round-trip through JSON: enforces serializability and decouples
+        # the stored header from the caller's dict (reference stores the
+        # serialized header: ring2.py:235).
+        self._stored_header = json.loads(json.dumps(header))
+        tensor = _tensor_info(self._stored_header)
+        ring.resize(gulp_nframe * tensor['frame_nbyte'],
+                    buf_nframe * tensor['frame_nbyte'],
+                    tensor['nringlet'])
+        name = header.get('name', '')
+        time_tag = header.get('time_tag', -1)
+        self._seq = ring._begin_sequence(name, time_tag,
+                                         self._stored_header,
+                                         tensor['nringlet'])
+
+    @property
+    def header(self):
+        return self._stored_header
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, typ, value, tb):
+        self.end()
+
+    def end(self):
+        self._ring._end_sequence(self._seq)
+
+    def reserve(self, nframe, nonblocking=False):
+        return WriteSpan(self._ring, self, nframe, nonblocking)
+
+
+class ReadSequence(_SequenceAPI):
+    def __init__(self, ring, which='specific', name="", time_tag=None,
+                 guarantee=True, header_transform=None):
+        self._ring = ring
+        self._tensor = None
+        self.guarantee = guarantee
+        self.header_transform = header_transform
+        self._seq = ring._open_seq(which, name=name, time_tag=time_tag)
+        if guarantee:
+            with ring._lock:
+                ring._guarantees[id(self)] = max(self._seq.begin, ring._tail)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, typ, value, tb):
+        self.close()
+
+    def close(self):
+        self._ring._close_read_seq(self)
+
+    def increment(self):
+        """Move to the next sequence (reference: ring2.py:293-298)."""
+        nxt = self._ring._next_seq(self._seq)
+        self._seq = nxt
+        self._tensor = None
+        if self.guarantee:
+            with self._ring._lock:
+                self._ring._guarantees[id(self)] = max(nxt.begin,
+                                                       self._ring._tail)
+
+    @property
+    def header(self):
+        hdr = self._seq.header
+        if self.header_transform is not None:
+            hdr = self.header_transform(deepcopy(hdr))
+            if hdr is None:
+                raise ValueError("Header transform returned None")
+        return hdr
+
+    def acquire(self, frame_offset, nframe):
+        return ReadSpan(self, frame_offset, nframe)
+
+    def read(self, nframe, stride=None, begin=0):
+        """Generator of gulp-sized spans (reference: ring2.py:301-311)."""
+        if stride is None:
+            stride = nframe
+        offset = begin
+        while True:
+            try:
+                with self.acquire(offset, nframe) as ispan:
+                    yield ispan
+                    offset += stride
+            except EndOfDataStop:
+                return
+
+    def resize(self, gulp_nframe, buf_nframe=None, buffer_factor=None):
+        """Reader-side buffering request; default buffer_factor=3 gives the
+        double-buffered async depth (reference: ring2.py:312-319)."""
+        if buf_nframe is None:
+            if buffer_factor is None:
+                buffer_factor = 3
+            buf_nframe = int(np.ceil(gulp_nframe * buffer_factor))
+        tensor = self.tensor
+        return self._ring.resize(gulp_nframe * tensor['frame_nbyte'],
+                                 buf_nframe * tensor['frame_nbyte'])
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+class _SpanAPI(object):
+    @property
+    def ring(self):
+        return self._ring
+
+    @property
+    def sequence(self):
+        return self._sequence
+
+    @property
+    def tensor(self):
+        return self._sequence.tensor
+
+    @property
+    def frame_nbyte(self):
+        return self.tensor['frame_nbyte']
+
+    @property
+    def nframe(self):
+        return self._nbyte // self.frame_nbyte
+
+    @property
+    def frame_offset(self):
+        return (self._begin - self._sequence._seq.begin) // self.frame_nbyte
+
+    @property
+    def shape(self):
+        t = self.tensor
+        return t['ringlet_shape'] + [self.nframe] + t['frame_shape']
+
+    @property
+    def dtype(self):
+        return self.tensor['dtype']
+
+    def _host_view(self, writeable):
+        """Zero-copy strided numpy view over the ring buffer, shaped
+        (*ringlet_shape, nframe, *frame_shape)."""
+        t = self.tensor
+        storage = self._ring._storage
+        raw = storage.write_view(self._begin, self._nbyte)
+        dtype = t['dtype']
+        if dtype.is_packed or dtype.as_numpy_dtype().names is not None \
+                or not t['frame_shape']:
+            npdtype = np.uint8 if dtype.is_packed else dtype.as_numpy_dtype()
+        else:
+            npdtype = dtype.as_numpy_dtype()
+        if npdtype == np.uint8 and dtype.is_packed:
+            frame_shape = list(t['frame_shape'])
+            frame_shape[-1] = frame_shape[-1] * dtype.itemsize_bits // 8
+            typed = raw
+        else:
+            typed = raw.view(npdtype)
+            frame_shape = t['frame_shape']
+        shape = t['ringlet_shape'] + [self.nframe] + list(frame_shape)
+        if t['nringlet'] == 1:
+            view = typed.reshape(shape) if shape else typed[0, 0]
+        else:
+            view = typed.reshape([t['nringlet'], self.nframe] +
+                                 list(frame_shape))
+            view = view.reshape(shape)
+        view.flags['WRITEABLE'] = writeable
+        return ndarray(view, dtype=dtype, space=self._ring.space,
+                       shape=self.shape)
+
+
+class WriteSpan(_SpanAPI):
+    """Reserved output region (reference: ring2.py:451-476).
+
+    Host rings: ``.data`` is a writable zero-copy view.
+    Device rings: assign the computed jax array with ``span.data = arr``
+    or ``span.set(arr)``; nothing is copied and nothing synchronizes.
+    """
+
+    def __init__(self, ring, sequence, nframe, nonblocking=False):
+        self._ring = ring
+        self._sequence = sequence
+        self._nbyte = nframe * sequence.tensor['frame_nbyte']
+        self._closed = False
+        self._commit_nbyte = None
+        self._device_array = None
+        self._begin = ring._reserve_span(self._nbyte, nonblocking)
+        with ring._lock:
+            ring._open_wspans.append(self)
+            ring._nwrite_open += 1
+        # Default to committing 0 frames so an exception in on_data doesn't
+        # publish garbage (reference: ring2.py:463-464).
+        self.commit_nframe = 0
+        self._data = None
+
+    @property
+    def data(self):
+        if self._ring.space == 'tpu':
+            return self._device_array
+        if self._data is None:
+            self._data = self._host_view(writeable=True)
+        return self._data
+
+    @data.setter
+    def data(self, array):
+        self.set(array)
+
+    def set(self, array):
+        """Publish a computed gulp into this span."""
+        if self._ring.space == 'tpu':
+            if isinstance(array, ndarray):
+                array = array.as_jax()
+            self._device_array = array
+        else:
+            from .ndarray import copy_array
+            copy_array(self.data, array)
+        return self
+
+    def commit(self, nframe):
+        assert nframe <= self.nframe
+        self.commit_nframe = nframe
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, typ, value, tb):
+        self.close()
+
+    def close(self):
+        commit_nbyte = self.commit_nframe * self.frame_nbyte
+        if self._ring.space != 'tpu' and commit_nbyte:
+            self._ring._storage.commit_ghost(self._begin, commit_nbyte)
+        self._ring._commit_span(self, commit_nbyte)
+
+    def _finalize_storage(self, commit_nbyte):
+        # called under ring lock once this commit lands in order
+        if self._ring.space == 'tpu' and self._device_array is not None:
+            t = self._sequence.tensor
+            arr = self._device_array
+            taxis = len(t['ringlet_shape'])
+            nframe_c = commit_nbyte // t['frame_nbyte']
+            if nframe_c < self.nframe:
+                idx = [slice(None)] * arr.ndim
+                idx[taxis] = slice(0, nframe_c)
+                arr = arr[tuple(idx)]
+            self._ring._storage.put(self._begin, commit_nbyte, arr, taxis)
+
+
+class ReadSpan(_SpanAPI):
+    """Acquired input region (reference: ring2.py:478-503)."""
+
+    def __init__(self, sequence, frame_offset, nframe):
+        self._ring = sequence.ring
+        self._sequence = sequence
+        t = sequence.tensor
+        fb = t['frame_nbyte']
+        begin, nbyte = self._ring._acquire_span(
+            sequence, frame_offset * fb, nframe * fb, fb)
+        self._begin, self._nbyte = begin, nbyte
+        self.requested_frame_offset = frame_offset
+        self.nframe_skipped = min(self.frame_offset - frame_offset, nframe)
+        if self._ring.space != 'tpu' and nbyte:
+            self._ring._storage.refresh_ghost(begin, nbyte)
+        self._data = None
+
+    @property
+    def data(self):
+        if self._data is not None:
+            return self._data
+        if self._ring.space == 'tpu':
+            t = self.tensor
+
+            def zeros_fn(nframe):
+                from .devrep import device_rep_zeros
+                shape = (t['ringlet_shape'] + [nframe] + t['frame_shape'])
+                return device_rep_zeros(shape, t['dtype'])
+
+            self._data = self._ring._storage.get(
+                self._begin, self._nbyte, t['frame_nbyte'], zeros_fn)
+        else:
+            self._data = self._host_view(writeable=False)
+        return self._data
+
+    @property
+    def nframe_overwritten(self):
+        """Frames of this span overwritten while held — unguaranteed
+        readers use this to detect they fell behind
+        (reference: ring2.py:491-497)."""
+        if self._sequence.guarantee:
+            return 0
+        nbyte = self._ring._overwritten_in(self._begin, self._nbyte)
+        return -(-nbyte // self.frame_nbyte) if nbyte else 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, typ, value, tb):
+        self.release()
+
+    def release(self):
+        self._ring._release_span(self._sequence, self._begin)

@@ -1,0 +1,96 @@
+"""Memory spaces for the TPU-native bifrost framework.
+
+The reference framework (ledatelescope/bifrost) defines memory spaces
+{system, cuda, cuda_host, cuda_managed} (reference: src/memory.cpp:94-162,
+python/bifrost/Space.py:46, python/bifrost/memory.py:37-48).  On TPU the
+native spaces are:
+
+- ``system``   : ordinary host memory (numpy-backed)
+- ``tpu_host`` : host memory staged for fast async H2D/D2H (numpy-backed;
+                 kept distinct so pipelines can be explicit about staging,
+                 mirroring ``cuda_host`` pinned memory in the reference)
+- ``tpu``      : device HBM, held as ``jax.Array``
+- ``auto``     : resolve at first use
+
+CUDA space names are accepted as aliases so reference pipelines can run
+unmodified: ``cuda``/``cuda_managed`` -> ``tpu``, ``cuda_host`` -> ``tpu_host``.
+"""
+
+from __future__ import annotations
+
+SPACES = ('auto', 'system', 'tpu_host', 'tpu')
+
+_ALIASES = {
+    'cuda': 'tpu',
+    'cuda_managed': 'tpu',
+    'cuda_host': 'tpu_host',
+    'pinned': 'tpu_host',
+}
+
+
+class Space(object):
+    """Validated memory-space tag (reference: python/bifrost/Space.py:27-46)."""
+
+    def __init__(self, s):
+        if isinstance(s, Space):
+            s = s._space
+        s = _ALIASES.get(s, s)
+        if s not in SPACES:
+            raise ValueError("Invalid space: %r (valid: %s)" % (s, list(SPACES)))
+        self._space = s
+
+    def as_string(self):
+        return self._space
+
+    def __str__(self):
+        return self._space
+
+    def __repr__(self):
+        return "Space(%r)" % self._space
+
+    def __eq__(self, other):
+        return str(self) == str(Space(other))
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __hash__(self):
+        return hash(self._space)
+
+    @property
+    def is_device(self):
+        return self._space == 'tpu'
+
+    @property
+    def is_host(self):
+        return self._space in ('system', 'tpu_host')
+
+
+def canonical(space):
+    """Return the canonical space string for ``space`` (resolving aliases)."""
+    return Space(space).as_string()
+
+
+def space_accessible(space, from_spaces):
+    """True if memory in ``space`` is directly accessible from any of
+    ``from_spaces``.
+
+    Mirrors the accessibility lattice of the reference
+    (python/bifrost/memory.py:37-48): host spaces are mutually accessible;
+    device (HBM) memory is only accessible from 'tpu'.  Unlike
+    ``cuda_managed`` there is no unified-memory space on TPU, but jax arrays
+    committed to host-backed rings are transparently fetched, which covers
+    the same use cases.
+    """
+    if isinstance(from_spaces, str):
+        from_spaces = [from_spaces]
+    if 'any' in from_spaces:
+        return True
+    from_spaces = [canonical(s) for s in from_spaces]
+    space = canonical(space)
+    if space in from_spaces:
+        return True
+    host = ('system', 'tpu_host')
+    if space in host:
+        return any(f in host for f in from_spaces)
+    return False

@@ -1,0 +1,52 @@
+"""Per-scope reusable scratch storage (reference:
+python/bifrost/temp_storage.py:35-68).
+
+On TPU, XLA owns workspaces for fused kernels, so this is mostly used by
+host-side blocks; it also serves as a handle-cache for reusable device
+arrays when a block wants to keep state across gulps.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import ndarray as _nd
+
+__all__ = ['TempStorage']
+
+
+class TempStorage(object):
+    def __init__(self, space):
+        self.space = space
+        self._lock = threading.Lock()
+        self._buffers = {}   # key -> ndarray
+
+    def allocate(self, key, shape, dtype):
+        """Return a cached scratch array for (key, shape, dtype),
+        (re)allocating on shape change."""
+        with self._lock:
+            cur = self._buffers.get(key)
+            if (cur is None or tuple(cur.shape) != tuple(shape)
+                    or cur.dtype != dtype):
+                cur = _nd.empty(shape, dtype, self.space)
+                self._buffers[key] = cur
+            return cur
+
+    class _Alloc(object):
+        def __init__(self, parent, nbytes):
+            self.parent, self.nbytes = parent, nbytes
+
+        def __enter__(self):
+            with self.parent._lock:
+                buf = self.parent._buffers.get('__raw__')
+                if buf is None or buf.shape[0] < self.nbytes:
+                    buf = _nd.empty((self.nbytes,), 'u8', self.parent.space)
+                    self.parent._buffers['__raw__'] = buf
+                return buf
+
+        def __exit__(self, *exc):
+            return False
+
+    def allocate_raw(self, nbytes):
+        """Context manager yielding a raw byte scratch buffer."""
+        return TempStorage._Alloc(self, nbytes)

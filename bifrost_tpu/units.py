@@ -1,0 +1,43 @@
+"""Axis-unit bookkeeping (reference: python/bifrost/units.py:37-50, which
+uses pint).  Uses pint when available; otherwise a minimal reciprocal
+table covering the units that appear in radio-astronomy headers."""
+
+from __future__ import annotations
+
+__all__ = ['transform_units', 'convert_units']
+
+try:
+    import pint
+    _ureg = pint.UnitRegistry()
+except ImportError:   # pragma: no cover
+    pint = None
+    _ureg = None
+
+_RECIPROCALS = {
+    's': 'Hz', 'Hz': 's', 'ms': 'kHz', 'kHz': 'ms', 'us': 'MHz',
+    'MHz': 'us', 'ns': 'GHz', 'GHz': 'ns', '': '', None: None,
+}
+
+
+def transform_units(units, power):
+    """Units of a Fourier-conjugate axis: units**power (power=-1 for FFT)."""
+    if _ureg is not None:
+        try:
+            q = (1 * _ureg(units)) ** power
+            return '{:~}'.format(q.units)
+        except Exception:
+            pass
+    if power == -1:
+        return _RECIPROCALS.get(units, '1/%s' % units)
+    if power == 1:
+        return units
+    return '%s^%d' % (units, power)
+
+
+def convert_units(value, from_units, to_units):
+    if from_units == to_units:
+        return value
+    if _ureg is not None:
+        return (value * _ureg(from_units)).to(_ureg(to_units)).magnitude
+    raise ValueError("Cannot convert %r -> %r without pint"
+                     % (from_units, to_units))

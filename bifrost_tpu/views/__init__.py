@@ -1,0 +1,5 @@
+"""Header-only stream views (reference: python/bifrost/views/)."""
+
+from .basic_views import (custom, rename_axis, reinterpret_axis,
+                          reverse_scale, add_axis, delete_axis, astype,
+                          split_axis, merge_axes, expose_view)

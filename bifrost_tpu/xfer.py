@@ -1,0 +1,71 @@
+"""Host↔device transfer primitives.
+
+TPU runtimes do not implement complex-typed host transfers (the axon
+backend raises UNIMPLEMENTED for complex64 device_put/device_get, and
+complex is generally a software-decomposed type on TPU).  All transfers
+therefore move real-valued buffers; complex arrays are split into
+(re, im) float planes on one side and recombined under jit on the other.
+This is the moral equivalent of the reference's packed-type memcpy paths
+(reference: src/memory.cpp:163-230) — the wire format is always plain
+bytes/floats.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['to_device', 'to_host']
+
+_combine_fn = None
+_split_fn = None
+
+
+def _combine(re, im):
+    global _combine_fn
+    if _combine_fn is None:
+        import jax
+        _combine_fn = jax.jit(lambda r, i: r + 1j * i)
+    return _combine_fn(re, im)
+
+
+def _split(arr):
+    global _split_fn
+    if _split_fn is None:
+        import jax
+        import jax.numpy as jnp
+        _split_fn = jax.jit(lambda c: (jnp.real(c), jnp.imag(c)))
+    return _split_fn(arr)
+
+
+def to_device(arr, device=None):
+    """numpy -> jax.Array; complex is shipped as two float planes and
+    recombined on device."""
+    import jax
+    import jax.numpy as jnp
+    arr = np.asarray(arr)
+    if np.iscomplexobj(arr):
+        ft = np.float64 if arr.dtype == np.complex128 else np.float32
+        re = np.ascontiguousarray(arr.real, dtype=ft)
+        im = np.ascontiguousarray(arr.imag, dtype=ft)
+        if device is not None:
+            return _combine(jax.device_put(re, device),
+                            jax.device_put(im, device))
+        return _combine(jnp.asarray(re), jnp.asarray(im))
+    if device is not None:
+        return jax.device_put(arr, device)
+    return jnp.asarray(arr)
+
+
+def to_host(arr):
+    """jax.Array -> numpy; complex is split on device and shipped as two
+    float planes.  Blocks until the value is ready (the D2H sync point,
+    reference: cudaStreamSynchronize per gulp)."""
+    import jax.numpy as jnp
+    if hasattr(arr, 'dtype') and jnp.issubdtype(arr.dtype,
+                                                jnp.complexfloating):
+        re, im = _split(arr)
+        out = np.asarray(re).astype(
+            np.float64 if arr.dtype == jnp.complex128 else np.float32)
+        return (out + 1j * np.asarray(im)).astype(
+            np.complex128 if arr.dtype == jnp.complex128 else np.complex64)
+    return np.asarray(arr)

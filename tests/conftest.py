@@ -1,0 +1,19 @@
+"""Test configuration: run everything on the JAX CPU backend with 8
+virtual devices, so multi-chip sharding tests exercise a real Mesh without
+TPU hardware (the 'CPU-only matrix row' of the reference CI,
+reference: .github/workflows/main.yml:20-24)."""
+
+import os
+
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = \
+        (flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ.setdefault('BF_PROCLOG_DIR', '/tmp/bifrost_tpu_test_proclog')
+
+# The axon TPU plugin ignores JAX_PLATFORMS; force the CPU backend via
+# the config API before any computation runs.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')

@@ -1,0 +1,62 @@
+"""DataType system tests (reference analogue: test/test_ndarray.py dtype
+handling, python/bifrost/DataType.py semantics)."""
+
+import numpy as np
+import pytest
+
+from bifrost_tpu.dtype import DataType, ci8, ci16, cf16
+
+
+def test_parse_strings():
+    assert DataType('f32').kind == 'f'
+    assert DataType('f32').nbits == 32
+    assert DataType('ci8').is_complex
+    assert DataType('ci8').itemsize == 2
+    assert DataType('cf32').as_numpy_dtype() == np.complex64
+    assert DataType('i8').as_numpy_dtype() == np.int8
+    assert str(DataType('u16')) == 'u16'
+
+
+def test_from_numpy():
+    assert DataType(np.float32) == DataType('f32')
+    assert DataType(np.dtype(np.complex64)) == 'cf32'
+    assert DataType(ci8) == 'ci8'
+    assert DataType(ci16) == 'ci16'
+    assert DataType(cf16) == 'cf16'
+    assert DataType(np.int64) == 'i64'
+
+
+def test_packed():
+    ci4 = DataType('ci4')
+    assert ci4.is_packed is False  # 4+4 = 8 bits = 1 byte
+    assert ci4.itemsize == 1
+    i4 = DataType('i4')
+    assert i4.is_packed
+    assert i4.itemsize_bits == 4
+    with pytest.raises(ValueError):
+        i4.itemsize
+    assert DataType('i2').is_packed
+    assert DataType('u1').itemsize_bits == 1
+
+
+def test_conversions():
+    assert DataType('ci8').as_floating_point() == 'cf32'
+    assert DataType('i8').as_floating_point() == 'f32'
+    assert DataType('f64').as_floating_point() == 'f64'
+    assert DataType('cf32').as_real() == 'f32'
+    assert DataType('f32').as_complex() == 'cf32'
+    assert DataType('ci16').as_real() == 'i16'
+    assert DataType('i32').as_nbit(8) == 'i8'
+
+
+def test_vector():
+    v = DataType('f32').as_vector(2)
+    assert str(v) == 'f32_x2'
+    assert v.itemsize == 8
+    assert DataType('f32_x2') == v
+
+
+def test_jax_dtypes():
+    assert DataType('ci8').as_jax_dtype() == np.complex64
+    assert DataType('f16').as_jax_dtype() == np.float16
+    assert DataType('u8').as_jax_dtype() == np.uint8

@@ -1,0 +1,171 @@
+"""bf.map expression-language tests (reference analogue: test/test_map.py,
+which defines the language contract)."""
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+
+
+def run_simple(x, funcstr, func):
+    a = bf.asarray(np.asarray(x), space='tpu')
+    y = bf.empty_like(a)
+    bf.map(funcstr, {'x': a, 'y': y})
+    np.testing.assert_allclose(np.asarray(y.data), func(np.asarray(x)),
+                               rtol=1e-6)
+
+
+def test_simple_elementwise():
+    np.random.seed(1234)
+    x = np.random.randint(256, size=100).astype(np.int32)
+    run_simple(x, "y = x+1", lambda x: x + 1)
+    run_simple(x, "y = x*3", lambda x: x * 3)
+    run_simple(x, "auto tmp = x; y = tmp*tmp", lambda x: x * x)
+    run_simple(x, "y = x; y += x", lambda x: x + x)
+
+
+def test_simple_2d_3d():
+    np.random.seed(0)
+    for shape in [(9, 9), (5, 6, 7)]:
+        x = np.random.randint(256, size=shape).astype(np.float32)
+        run_simple(x, "y = x+1", lambda x: x + 1)
+
+
+def test_rint_pow():
+    x = np.arange(10).astype(np.float32)
+    run_simple(x, "y = rint(pow(x, 2.f))", lambda x: x ** 2)
+
+
+def test_broadcast():
+    n = 89
+    a = np.arange(n).astype(np.float32)
+    c = bf.empty((n, n), 'f32', 'tpu')
+    bf.map("c = a*b", data={'a': a, 'b': a[:, None], 'c': c})
+    np.testing.assert_allclose(np.asarray(c.data), a[None, :] * a[:, None])
+
+
+def test_scalar_int_division():
+    # C semantics: integer division truncates toward zero
+    x = np.random.RandomState(3).randint(1, 256, size=100)
+    a = bf.asarray(x.astype(np.int32), space='tpu')
+    y = bf.empty_like(a)
+    bf.map("y = (x-m)/s", data={'x': a, 'y': y, 'm': 1, 's': 3})
+    np.testing.assert_array_equal(np.asarray(y.data),
+                                  np.trunc((x - 1) / 3).astype(np.int32))
+
+
+def test_fftshift_index_vector():
+    shape = (16, 10, 12)
+    a = np.random.RandomState(1).randint(1 << 16, size=shape)
+    a = a.astype(np.int32)
+    aa = bf.asarray(a, space='tpu')
+    b = bf.empty_like(aa)
+    bf.map("b = a(_-a.shape()/2)", data={'a': aa, 'b': b})
+    np.testing.assert_array_equal(np.asarray(b.data), np.fft.fftshift(a))
+
+
+def test_complex_float():
+    n = 32
+    rng = np.random.RandomState(5)
+    x = (rng.randint(-127, 128, size=(n, n)) +
+         1j * rng.randint(-127, 128, size=(n, n))).astype(np.complex64)
+    run_simple(x, "y.assign(x.imag, x.real)",
+               lambda x: x.imag + 1j * x.real)
+    run_simple(x, "y = x*x.conj()", lambda x: x * x.conj())
+    run_simple(x, "y = x.mag2()", lambda x: (x * x.conj()))
+    run_simple(x, "y = 3*x", lambda x: 3 * x)
+
+
+def test_explicit_indexing_transpose():
+    shape = (5, 6, 7)
+    a = np.random.RandomState(2).randint(100, size=shape).astype(np.int32)
+    aa = bf.asarray(a, space='tpu')
+    b = bf.empty((7, 5, 6), 'i32', 'tpu')
+    bf.map("b(i,j,k) = a(j,k,i)", shape=b.shape, axis_names=('i', 'j', 'k'),
+           data={'a': aa, 'b': b})
+    np.testing.assert_array_equal(np.asarray(b.data), a.transpose([2, 0, 1]))
+
+
+def test_custom_shape_fixed_index():
+    shape = (5, 6, 7)
+    a = np.random.RandomState(2).randint(100, size=shape).astype(np.int32)
+    aa = bf.asarray(a, space='tpu')
+    b = bf.empty((5, 7), 'i32', 'tpu')
+    bf.map("b(i,k) = a(i,j,k)", shape=b.shape, axis_names=('i', 'k'),
+           data={'a': aa, 'b': b, 'j': 3})
+    np.testing.assert_array_equal(np.asarray(b.data), a[:, 3, :])
+
+
+def test_polarisation_products():
+    n = 16
+    rng = np.random.RandomState(7)
+    a = (rng.randint(-127, 128, size=(n, 2)) +
+         1j * rng.randint(-127, 128, size=(n, 2))).astype(np.complex64)
+    aa = bf.asarray(a, space='tpu')
+    b = bf.empty_like(aa)
+    bf.map('''
+    auto x = a(_,0);
+    auto y = a(_,1);
+    b(_,0).assign(x.mag2(), y.mag2());
+    b(_,1) = x*y.conj();
+    ''', shape=(n,), data={'a': aa, 'b': b})
+    out = np.asarray(b.data)
+
+    def mag2(x):
+        return x.real ** 2 + x.imag ** 2
+    np.testing.assert_allclose(out[:, 0],
+                               mag2(a[:, 0]) + 1j * mag2(a[:, 1]))
+    np.testing.assert_allclose(out[:, 1], a[:, 0] * a[:, 1].conj())
+
+
+def test_vectorized_if():
+    n = 8
+    a = np.arange(n * n, dtype=np.float32).reshape(n, n)
+    aa = bf.asarray(a, space='tpu')
+    b = bf.zeros((n, n), 'f32', 'tpu')
+    bf.map('''
+    if( i > j ) {
+        b(i,j) = a(i,j);
+    } else {
+        b(i,j) = -a(j,i);
+    }
+    ''', shape=(n, n), axis_names=('i', 'j'), data={'a': aa, 'b': b})
+    out = np.asarray(b.data)
+    expect = np.where(np.arange(n)[:, None] > np.arange(n)[None, :],
+                      a, -a.T)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_ternary_and_bool():
+    x = np.arange(10).astype(np.float32)
+    run_simple(x, "y = x > 5 ? x : -x", lambda x: np.where(x > 5, x, -x))
+    run_simple(x, "y = (x > 2 && x < 7) ? 1.f : 0.f",
+               lambda x: ((x > 2) & (x < 7)).astype(np.float32))
+
+
+def test_define_macro():
+    x = np.arange(1, 11).astype(np.int32)
+    run_simple(x, """
+    #define square(v) ((v)*(v))
+    y = square(x);
+    """, lambda x: x * x)
+
+
+def test_complex_integer_ci8():
+    n = 64
+    rng = np.random.RandomState(11)
+    a = bf.empty((n,), 'ci8', 'system')
+    buf = a.as_numpy()
+    buf['re'] = rng.randint(-128, 128, size=n)
+    buf['im'] = rng.randint(-128, 128, size=n)
+    b = bf.empty((n,), 'cf32', 'system')
+    bf.map('b(i) = a(i)', {'a': a, 'b': b}, shape=a.shape, axis_names=('i',))
+    np.testing.assert_array_equal(
+        b.as_numpy(), buf['re'].astype(np.float32) + 1j * buf['im'])
+
+
+def test_host_writeback():
+    x = np.arange(10, dtype=np.float32)
+    y = np.zeros(10, dtype=np.float32)
+    bf.map("y = x*2", {'x': x, 'y': y})
+    np.testing.assert_array_equal(y, x * 2)

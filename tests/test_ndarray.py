@@ -1,0 +1,69 @@
+"""ndarray/space tests (reference analogue: test/test_ndarray.py)."""
+
+import numpy as np
+
+import bifrost_tpu as bf
+
+
+def test_asarray_roundtrip():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = bf.asarray(x)
+    assert a.space == 'system'
+    assert a.shape == (3, 4)
+    np.testing.assert_array_equal(a.as_numpy(), x)
+
+
+def test_copy_to_device_and_back():
+    x = np.arange(10, dtype=np.float32)
+    a = bf.asarray(x, space='tpu')
+    assert a.space == 'tpu'
+    b = a.copy('system')
+    np.testing.assert_array_equal(b.as_numpy(), x)
+
+
+def test_cuda_space_alias():
+    x = np.arange(4, dtype=np.float32)
+    a = bf.asarray(x, space='cuda')
+    assert a.space == 'tpu'
+
+
+def test_empty_zeros():
+    a = bf.zeros((5, 3), 'cf32', 'system')
+    assert a.as_numpy().dtype == np.complex64
+    assert np.all(a.as_numpy() == 0)
+    d = bf.zeros((5, 3), 'f32', 'tpu')
+    assert d.space == 'tpu'
+    assert np.all(np.asarray(d.data) == 0)
+
+
+def test_structured_ci8():
+    a = bf.empty((8,), 'ci8', 'system')
+    buf = a.as_numpy()
+    buf['re'] = np.arange(8)
+    buf['im'] = -np.arange(8)
+    j = a.as_jax()
+    assert j.shape == (8, 2)
+    np.testing.assert_array_equal(np.asarray(j)[:, 0], np.arange(8))
+
+
+def test_packed_i4():
+    a = bf.empty((2, 8), 'i4', 'system')
+    assert a.as_numpy().shape == (2, 4)   # bytes
+    assert a.shape == (2, 8)              # logical
+    assert a.nbytes == 8
+
+
+def test_copy_array_h2d():
+    src = bf.asarray(np.arange(6, dtype=np.float32))
+    dst = bf.empty((6,), 'f32', 'tpu')
+    bf.copy_array(dst, src)
+    np.testing.assert_array_equal(np.asarray(dst.data),
+                                  np.arange(6, dtype=np.float32))
+
+
+def test_space_accessible():
+    from bifrost_tpu.memory import space_accessible
+    assert space_accessible('system', ['tpu_host'])
+    assert not space_accessible('tpu', ['system'])
+    assert space_accessible('tpu', ['any'])
+    assert space_accessible('cuda', ['tpu'])

@@ -1,0 +1,103 @@
+"""CPU-only pipeline integration tests — the minimum end-to-end slice
+(reference analogue: test/test_pipeline_cpu.py; BASELINE config 1)."""
+
+import numpy as np
+
+import bifrost_tpu as bf
+from tests.util import NumpySourceBlock, GatherSink, simple_header
+
+
+def _run(pipeline):
+    pipeline.run()
+
+
+def test_source_to_sink():
+    with bf.Pipeline() as p:
+        gulps = [np.full((4, 3), float(k), dtype=np.float32)
+                 for k in range(5)]
+        hdr = simple_header([-1, 3], 'f32')
+        src = NumpySourceBlock(gulps, hdr, gulp_nframe=4)
+        sink = GatherSink(src)
+        _run(p)
+    out = sink.result()
+    assert out.shape == (20, 3)
+    np.testing.assert_array_equal(out[4:8], 1.0)
+
+
+def test_copy_transpose_reduce_chain():
+    """read -> copy -> transpose -> reduce('freq',4) -> sink, all host."""
+    rng = np.random.RandomState(0)
+    data = rng.rand(16, 8).astype(np.float32)
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 8], 'f32', labels=['time', 'freq'])
+        src = NumpySourceBlock([data[i * 4:(i + 1) * 4] for i in range(4)],
+                               hdr, gulp_nframe=4)
+        b = bf.blocks.copy(src, space='system')
+        b = bf.blocks.reduce(b, 'freq', 4)
+        sink = GatherSink(b)
+        _run(p)
+    out = sink.result()
+    expect = data.reshape(16, 2, 4).sum(axis=2)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_block_chainer():
+    data = np.arange(32, dtype=np.float32).reshape(8, 4)
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 4], 'f32', labels=['time', 'freq'])
+        bc = bf.BlockChainer()
+        bc.last_block = NumpySourceBlock([data[:4], data[4:]], hdr,
+                                         gulp_nframe=4)
+        bc.blocks.copy('system')
+        sink = GatherSink(bc.last_block)
+        _run(p)
+    np.testing.assert_array_equal(sink.result(), data)
+
+
+def test_views_split_merge():
+    data = np.arange(64, dtype=np.float32).reshape(8, 8)
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 8], 'f32', labels=['time', 'freq'])
+        src = NumpySourceBlock([data[:4], data[4:]], hdr, gulp_nframe=4)
+        b = bf.views.split_axis(src, 'freq', 4, label='fine_freq')
+        headers = []
+        sink = GatherSink(b)
+        _run(p)
+    hdr = sink.headers[0]
+    assert hdr['_tensor']['shape'] == [-1, 2, 4]
+    assert hdr['_tensor']['labels'] == ['time', 'freq', 'fine_freq']
+    out = sink.result()
+    np.testing.assert_array_equal(out.reshape(8, 8), data)
+
+
+def test_pipeline_init_error():
+    class BadBlock(bf.TransformBlock):
+        def on_sequence(self, iseq):
+            raise RuntimeError("boom")
+
+        def on_data(self, ispan, ospan):
+            pass
+
+    import pytest
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 4], 'f32')
+        src = NumpySourceBlock([np.zeros((4, 4), np.float32)], hdr,
+                               gulp_nframe=4)
+        bad = BadBlock(src)
+        import sys, io, contextlib
+        with contextlib.redirect_stderr(io.StringIO()):
+            with pytest.raises(bf.PipelineInitError):
+                p.run()
+
+
+def test_scrunch_and_accumulate():
+    data = np.ones((8, 4), dtype=np.float32)
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 4], 'f32')
+        src = NumpySourceBlock([data[:4], data[4:]], hdr, gulp_nframe=4)
+        b = bf.blocks.scrunch(src, 2)
+        sink = GatherSink(b)
+        _run(p)
+    out = sink.result()
+    assert out.shape == (4, 4)
+    np.testing.assert_array_equal(out, 1.0)

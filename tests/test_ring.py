@@ -1,0 +1,222 @@
+"""Ring buffer semantics tests (reference analogues: test/test_resizing.py,
+ring behavior described in SURVEY.md §2.1)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu.ring import Ring, EndOfDataStop
+from tests.util import simple_header
+
+
+def _hdr(frame_shape=(4,), dtype='f32', **kw):
+    return simple_header([-1] + list(frame_shape), dtype, **kw)
+
+
+def test_write_read_simple():
+    ring = Ring(space='system')
+    hdr = _hdr()
+    received = []
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=8, buf_nframe=24) as seq:
+                for k in range(4):
+                    with seq.reserve(8) as span:
+                        data = span.data.as_numpy()
+                        data[...] = np.arange(8 * 4).reshape(8, 4) + 100 * k
+                        span.commit(8)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    for seq in ring.read(guarantee=True):
+        seq.resize(gulp_nframe=8)
+        for span in seq.read(8):
+            received.append(np.array(span.data.as_numpy(), copy=True))
+    t.join()
+    assert len(received) == 4
+    np.testing.assert_array_equal(received[2],
+                                  np.arange(32).reshape(8, 4) + 200)
+
+
+def test_partial_final_span():
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(2,))
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=8, buf_nframe=24) as seq:
+                with seq.reserve(8) as span:
+                    span.data.as_numpy()[...] = 1.0
+                    span.commit(8)
+                with seq.reserve(8) as span:
+                    span.data.as_numpy()[:3] = 2.0
+                    span.commit(3)   # partial final gulp
+
+    t = threading.Thread(target=writer)
+    t.start()
+    sizes = []
+    for seq in ring.read():
+        seq.resize(gulp_nframe=8)
+        for span in seq.read(8):
+            sizes.append(span.nframe)
+    t.join()
+    assert sizes == [8, 3]
+
+
+def test_multiple_sequences():
+    ring = Ring(space='system')
+
+    def writer():
+        with ring.begin_writing() as wr:
+            for s in range(3):
+                hdr = _hdr(name='seq%d' % s)
+                hdr['time_tag'] = s
+                with wr.begin_sequence(hdr, gulp_nframe=4,
+                                       buf_nframe=12) as seq:
+                    with seq.reserve(4) as span:
+                        span.data.as_numpy()[...] = s
+                        span.commit(4)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    names = []
+    for seq in ring.read():
+        seq.resize(gulp_nframe=4)
+        for span in seq.read(4):
+            names.append((seq.header['name'], float(
+                span.data.as_numpy().ravel()[0])))
+    t.join()
+    assert names == [('seq0', 0.0), ('seq1', 1.0), ('seq2', 2.0)]
+
+
+def test_overlap_read():
+    """Overlapped gulps (stride < nframe), as used by FIR/FDMT."""
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(1,))
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=6, buf_nframe=32) as seq:
+                for k in range(3):
+                    with seq.reserve(6) as span:
+                        span.data.as_numpy()[:, 0] = np.arange(6) + 6 * k
+                        span.commit(6)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    got = []
+    for seq in ring.read():
+        seq.resize(gulp_nframe=8, buffer_factor=4)
+        for span in seq.read(8, stride=6):
+            got.append(np.array(span.data.as_numpy()[:, 0], copy=True))
+    t.join()
+    np.testing.assert_array_equal(got[0], np.arange(8))
+    np.testing.assert_array_equal(got[1], np.arange(6, 14))
+
+
+def test_device_ring_roundtrip():
+    import jax.numpy as jnp
+    ring = Ring(space='tpu')
+    hdr = _hdr(frame_shape=(4,))
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=8, buf_nframe=24) as seq:
+                for k in range(3):
+                    with seq.reserve(8) as span:
+                        span.set(jnp.full((8, 4), float(k)))
+                        span.commit(8)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    vals = []
+    for seq in ring.read():
+        seq.resize(gulp_nframe=8)
+        for span in seq.read(8):
+            vals.append(float(np.asarray(span.data)[0, 0]))
+    t.join()
+    assert vals == [0.0, 1.0, 2.0]
+
+
+def test_ringlets():
+    ring = Ring(space='system')
+    hdr = simple_header([2, -1, 3], 'f32', labels=['beam', 'time', 'chan'])
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=4, buf_nframe=12) as seq:
+                with seq.reserve(4) as span:
+                    d = span.data.as_numpy()
+                    assert d.shape == (2, 4, 3)
+                    d[0] = 1.0
+                    d[1] = 2.0
+                    span.commit(4)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    for seq in ring.read():
+        seq.resize(gulp_nframe=4)
+        for span in seq.read(4):
+            d = span.data.as_numpy()
+            assert d.shape == (2, 4, 3)
+            assert np.all(d[0] == 1.0)
+            assert np.all(d[1] == 2.0)
+    t.join()
+
+
+def test_unguaranteed_overwrite_skip():
+    """A slow unguaranteed reader gets frames skipped, not a deadlock."""
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(1,))
+    start_reading = threading.Event()
+    wrote_all = threading.Event()
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=4, buf_nframe=8) as seq:
+                for k in range(16):
+                    with seq.reserve(4) as span:
+                        span.data.as_numpy()[:, 0] = k
+                        span.commit(4)
+                    if k == 0:
+                        start_reading.set()
+        wrote_all.set()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    start_reading.wait()
+    wrote_all.wait()   # let the writer lap the reader completely
+    skipped_total = 0
+    frames = 0
+    for seq in ring.read(guarantee=False):
+        seq.resize(gulp_nframe=4, buffer_factor=2)
+        for span in seq.read(4):
+            skipped_total += span.nframe_skipped
+            frames += span.nframe
+    t.join()
+    assert skipped_total > 0
+    assert frames + skipped_total == 64
+
+
+def test_resize_while_data_buffered():
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(2,))
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=4, buf_nframe=12) as seq:
+            with seq.reserve(4) as span:
+                span.data.as_numpy()[...] = 7.0
+                span.commit(4)
+            # grow the ring while data is buffered
+            ring.resize(4 * 8, 64 * 8)
+            with seq.reserve(4) as span:
+                span.data.as_numpy()[...] = 9.0
+                span.commit(4)
+    # read it back after resize preserved the buffered bytes
+    vals = []
+    for seq in ring.read():
+        for span in seq.read(4):
+            vals.append(float(span.data.as_numpy().ravel()[0]))
+    assert vals == [7.0, 9.0]
