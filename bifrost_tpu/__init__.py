@@ -43,3 +43,5 @@ from .ops.map import map  # noqa: A001  (shadows builtin by design, like bf.map)
 from . import ops
 from . import blocks
 from . import views
+from . import stages
+from . import parallel

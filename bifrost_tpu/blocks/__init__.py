@@ -16,3 +16,4 @@ from .reverse import ReverseBlock, reverse
 from .quantize import QuantizeBlock, quantize
 from .unpack import UnpackBlock, unpack
 from .print_header import PrintHeaderBlock, print_header
+from .fused import FusedBlock, fused

@@ -1,118 +1,19 @@
 """Polarization detection block: scalar / jones / stokes / stokes_i /
 coherence (reference: python/bifrost/blocks/detect.py:40-159).
-
-The reference generates bf.map CUDA snippets per mode; here each mode is
-a small jitted jnp function — same math, XLA-fused.
-"""
+Math lives in stages.DetectStage (fusable)."""
 
 from __future__ import annotations
 
-from copy import deepcopy
-
-from ..pipeline import TransformBlock
-from ..dtype import DataType
-from ..ops.common import complexify
-from .copy import to_device_rep
+from ..stages import DetectStage
+from .fft import _StageBlock
 
 __all__ = ['DetectBlock', 'detect']
 
 
-def _mag2(x):
-    import jax.numpy as jnp
-    return jnp.real(x) ** 2 + jnp.imag(x) ** 2
-
-
-class DetectBlock(TransformBlock):
+class DetectBlock(_StageBlock):
     def __init__(self, iring, mode, axis=None, *args, **kwargs):
-        super(DetectBlock, self).__init__(iring, *args, **kwargs)
-        self.mode = mode.lower()
-        self.axis = axis
-        if self.mode not in ('scalar', 'jones', 'stokes', 'stokes_i',
-                             'coherence'):
-            raise ValueError("Invalid detect mode: %r" % mode)
-        self._fn = None
-        self._fn_key = None
-
-    def define_valid_input_spaces(self):
-        return ('tpu',)
-
-    def on_sequence(self, iseq):
-        ihdr = iseq.header
-        itensor = ihdr['_tensor']
-        itype = DataType(itensor['dtype'])
-        if not itype.is_complex:
-            raise TypeError("detect requires complex input")
-        if self.axis is None and self.mode != 'scalar':
-            self.axis = 'pol'
-        axis = self.axis
-        if isinstance(axis, str):
-            axis = itensor['labels'].index(axis)
-        self.axis_index = axis
-        ohdr = deepcopy(ihdr)
-        otensor = ohdr['_tensor']
-        if axis is not None:
-            self.npol = otensor['shape'][axis]
-            if self.npol not in (1, 2):
-                raise ValueError("Polarization axis must have length 1 or 2")
-            if self.mode in ('stokes', 'coherence') and self.npol == 2:
-                otensor['shape'][axis] = 4
-            if self.mode == 'stokes_i' and self.npol == 2:
-                otensor['shape'][axis] = 1
-            if 'labels' in otensor:
-                otensor['labels'][axis] = 'pol'
-        else:
-            self.npol = 1
-        if self.mode == 'jones' and self.npol == 2:
-            otype = itype
-        else:
-            otype = itype.as_real()
-        otensor['dtype'] = str(otype.as_floating_point())
-        self.otype = DataType(otensor['dtype'])
-        return ohdr
-
-    def _build(self, ndim):
-        import jax
-        import jax.numpy as jnp
-        mode, axis, npol = self.mode, self.axis_index, self.npol
-        odt = self.otype.as_jax_dtype()
-
-        def take(x, p):
-            idx = [slice(None)] * ndim
-            idx[axis] = p
-            return x[tuple(idx)]
-
-        def fn(x):
-            if npol == 1:
-                return _mag2(x).astype(odt)
-            xp, yp = take(x, 0), take(x, 1)
-            xx, yy = _mag2(xp), _mag2(yp)
-            if mode == 'stokes_i':
-                out = (xx + yy)[None]
-            elif mode == 'stokes':
-                xy = xp * jnp.conj(yp)
-                out = jnp.stack([xx + yy, xx - yy,
-                                 2 * jnp.real(xy), -2 * jnp.imag(xy)])
-            elif mode == 'coherence':
-                xy = jnp.conj(xp) * yp
-                out = jnp.stack([xx, yy, jnp.real(xy), jnp.imag(xy)])
-            elif mode == 'jones':
-                out = jnp.stack([xx + 1j * yy, xp * jnp.conj(yp)])
-            else:
-                raise ValueError(mode)
-            return jnp.moveaxis(out, 0, axis).astype(odt)
-
-        return jax.jit(fn)
-
-    def on_data(self, ispan, ospan):
-        arr = ispan.data
-        if ispan.ring.space != 'tpu':
-            arr = to_device_rep(arr.as_numpy(), ispan.dtype)
-        arr = complexify(arr, ispan.dtype)
-        key = (arr.ndim,)
-        if self._fn_key != key:
-            self._fn = self._build(arr.ndim)
-            self._fn_key = key
-        ospan.set(self._fn(arr))
+        super(DetectBlock, self).__init__(iring, DetectStage(mode, axis),
+                                          *args, **kwargs)
 
 
 def detect(iring, mode, axis=None, *args, **kwargs):

@@ -1,37 +1,24 @@
 """FFT block (reference: python/bifrost/blocks/fft.py:39-146).
 
-Plans are re-generated whenever the gulp shape changes; XLA's compilation
-cache plays the role of the reference's plan cache + TempStorage
-workspace (reference: blocks/fft.py:118-137).
+The math/metadata lives in stages.FftStage so the same code runs
+standalone here or fused into a chain (blocks.fused).
 """
 
 from __future__ import annotations
 
-from copy import deepcopy
-
 from ..pipeline import TransformBlock
 from ..dtype import DataType
-from ..units import transform_units
-from ..ops.fft import Fft
-from ..ops.common import complexify
-from .copy import to_device_rep
+from ..stages import FftStage
 
 __all__ = ['FftBlock', 'fft']
 
 
-class FftBlock(TransformBlock):
-    def __init__(self, iring, axes, inverse=False, real_output=False,
-                 axis_labels=None, apply_fftshift=False, *args, **kwargs):
-        super(FftBlock, self).__init__(iring, *args, **kwargs)
-        if not isinstance(axes, (list, tuple)):
-            axes = [axes]
-        if not isinstance(axis_labels, (list, tuple)):
-            axis_labels = [axis_labels]
-        self.specified_axes = axes
-        self.real_output = real_output
-        self.inverse = inverse
-        self.axis_labels = axis_labels
-        self.apply_fftshift = apply_fftshift
+class _StageBlock(TransformBlock):
+    """TransformBlock driven by a single Stage."""
+
+    def __init__(self, iring, stage, *args, **kwargs):
+        super(_StageBlock, self).__init__(iring, *args, **kwargs)
+        self._stage = stage
         self._plan = None
         self._plan_key = None
 
@@ -39,96 +26,32 @@ class FftBlock(TransformBlock):
         return ('tpu',)
 
     def on_sequence(self, iseq):
-        ihdr = iseq.header
-        itensor = ihdr['_tensor']
-        itype = DataType(itensor['dtype']).as_floating_point()
-        self.axes = [itensor['labels'].index(ax) if isinstance(ax, str)
-                     else ax for ax in self.specified_axes]
-        axes = self.axes
-        shape = [itensor['shape'][ax] for ax in axes]
-        otype = itype.as_real() if self.real_output else itype.as_complex()
-        ohdr = deepcopy(ihdr)
-        otensor = ohdr['_tensor']
-        otensor['dtype'] = str(otype)
-        self.itype, self.otype = itype, otype
-        if itype.is_real and otype.is_complex:
-            self.mode = 'r2c'
-        elif itype.is_complex and otype.is_real:
-            self.mode = 'c2r'
-        else:
-            self.mode = 'c2c'
-        frame_axis = itensor['shape'].index(-1)
-        if frame_axis in axes:
-            raise KeyError("Cannot transform the frame axis; reshape the "
-                           "stream first (views.split_axis)")
-        if self.mode == 'r2c':
-            otensor['shape'][axes[-1]] = \
-                otensor['shape'][axes[-1]] // 2 + 1
-        elif self.mode == 'c2r':
-            otensor['shape'][axes[-1]] = \
-                (otensor['shape'][axes[-1]] - 1) * 2
-            shape[-1] = (shape[-1] - 1) * 2
-        for i, (ax, length) in enumerate(zip(axes, shape)):
-            if 'units' in otensor:
-                otensor['units'][ax] = transform_units(
-                    otensor['units'][ax], -1)
-            if 'scales' in otensor:
-                otensor['scales'][ax][0] = 0
-                scale = otensor['scales'][ax][1]
-                otensor['scales'][ax][1] = 1. / (scale * length)
-            if 'labels' in otensor and self.axis_labels != [None]:
-                otensor['labels'][ax] = self.axis_labels[i]
-        return ohdr
+        self._ihdr = iseq.header
+        self._plan_key = None
+        return self._stage.transform_header(iseq.header)
+
+    def define_output_nframes(self, input_nframe):
+        return self._stage.output_nframe(input_nframe)
 
     def on_data(self, ispan, ospan):
         import jax
-        import jax.numpy as jnp
-        arr = ispan.data
-        if ispan.ring.space != 'tpu':
-            arr = to_device_rep(arr.as_numpy(), ispan.dtype)
-        arr = complexify(arr, ispan.dtype)
-        key = (arr.shape, str(arr.dtype), tuple(self.axes), self.inverse)
+        x = ispan.data
+        key = (tuple(x.shape), str(x.dtype))
         if self._plan_key != key:
-            axes = list(self.axes)
-            mode, shift = self.mode, self.apply_fftshift
-            odt = self.otype.as_jax_dtype()
-            oshape = ospan.shape
-
-            def plan(x):
-                if mode == 'r2c':
-                    x = jnp.real(x).astype(
-                        jnp.float64 if self.itype.nbits > 32
-                        else jnp.float32)
-                    y = jnp.fft.rfftn(x, axes=axes)
-                elif mode == 'c2r':
-                    if shift:
-                        x = jnp.fft.ifftshift(x, axes=axes)
-                    sizes = [oshape[a] for a in axes]
-                    y = jnp.fft.irfftn(x, s=sizes, axes=axes)
-                    n = 1
-                    for a in axes:
-                        n *= oshape[a]
-                    y = y * n   # cuFFT-style unnormalized inverse
-                else:
-                    if self.inverse:
-                        if shift:
-                            x = jnp.fft.ifftshift(x, axes=axes)
-                        y = jnp.fft.ifftn(x, axes=axes)
-                        n = 1
-                        for a in axes:
-                            n *= x.shape[a]
-                        y = y * n
-                    else:
-                        y = jnp.fft.fftn(x, axes=axes)
-                        if shift:
-                            y = jnp.fft.fftshift(y, axes=axes)
-                if mode == 'r2c' and shift:
-                    y = jnp.fft.fftshift(y, axes=axes)
-                return y.astype(odt)
-
-            self._plan = jax.jit(plan)
+            idt = DataType(self._ihdr['_tensor']['dtype'])
+            meta = {'shape': list(x.shape), 'dtype': idt,
+                    'reim': idt.kind == 'ci'}
+            self._plan = jax.jit(self._stage.build(meta))
             self._plan_key = key
-        ospan.set(self._plan(arr))
+        ospan.set(self._plan(x))
+
+
+class FftBlock(_StageBlock):
+    def __init__(self, iring, axes, inverse=False, real_output=False,
+                 axis_labels=None, apply_fftshift=False, *args, **kwargs):
+        super(FftBlock, self).__init__(
+            iring, FftStage(axes, inverse, real_output, axis_labels,
+                            apply_fftshift), *args, **kwargs)
 
 
 def fft(iring, axes, inverse=False, real_output=False, axis_labels=None,

@@ -1,0 +1,73 @@
+"""FusedBlock: run a chain of device stages as ONE jitted computation.
+
+Where the reference executes one CUDA kernel (or cuFFT/cuBLAS call) per
+block per gulp (reference: pipeline.py:627-628), a FusedBlock composes
+the stage functions and jits the composition — XLA fuses elementwise
+stages into the FFT/GEMM epilogues and the whole chain costs one
+dispatch and no intermediate ring traffic.  This is the intended
+operating mode for hot paths (the Guppi spectroscopy chain runs
+FFT→detect→reduce fused).
+"""
+
+from __future__ import annotations
+
+from functools import reduce as _reduce
+
+from ..pipeline import TransformBlock
+from ..dtype import DataType
+
+__all__ = ['FusedBlock', 'fused']
+
+
+class FusedBlock(TransformBlock):
+    def __init__(self, iring, stages, *args, **kwargs):
+        super(FusedBlock, self).__init__(iring, *args, **kwargs)
+        self.stages = list(stages)
+        self._plan = None
+        self._plan_key = None
+
+    def define_valid_input_spaces(self):
+        return ('tpu',)
+
+    def on_sequence(self, iseq):
+        hdr = iseq.header
+        self._headers = [hdr]
+        for stage in self.stages:
+            hdr = stage.transform_header(hdr)
+            self._headers.append(hdr)
+        self._plan = None
+        self._plan_key = None
+        return hdr
+
+    def define_output_nframes(self, input_nframe):
+        n = input_nframe
+        for stage in self.stages:
+            n = stage.output_nframe(n)
+        return n
+
+    def _build_plan(self, shape, dtype):
+        import jax
+        fns = []
+        cur = jax.ShapeDtypeStruct(tuple(shape), dtype)
+        for stage, ihdr in zip(self.stages, self._headers[:-1]):
+            idt = DataType(ihdr['_tensor']['dtype'])
+            meta = {'shape': list(cur.shape), 'dtype': idt,
+                    'reim': idt.kind == 'ci'}
+            fn = stage.build(meta)
+            fns.append(fn)
+            cur = jax.eval_shape(fn, cur)
+        return jax.jit(lambda x: _reduce(lambda v, f: f(v), fns, x))
+
+    def on_data(self, ispan, ospan):
+        x = ispan.data
+        key = (tuple(x.shape), str(x.dtype))
+        if self._plan_key != key:
+            self._plan = self._build_plan(x.shape, x.dtype)
+            self._plan_key = key
+        ospan.set(self._plan(x))
+
+
+def fused(iring, stages, *args, **kwargs):
+    """Block: run ``stages`` (see bifrost_tpu.stages) as one fused jitted
+    computation per gulp."""
+    return FusedBlock(iring, stages, *args, **kwargs)

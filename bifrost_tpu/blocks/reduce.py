@@ -1,107 +1,48 @@
 """Axis-reduction block, incl. frame-axis factors (reference:
-python/bifrost/blocks/reduce.py:39-126)."""
+python/bifrost/blocks/reduce.py:39-126).  Device math lives in
+stages.ReduceStage (fusable); host rings use a numpy path."""
 
 from __future__ import annotations
 
-from copy import deepcopy
-
 import numpy as np
 
-from ..pipeline import TransformBlock
-from .. import ops
+from ..stages import ReduceStage
+from .fft import _StageBlock
 
 __all__ = ['ReduceBlock', 'reduce']
 
 
-class ReduceBlock(TransformBlock):
+class ReduceBlock(_StageBlock):
     def __init__(self, iring, axis, factor=None, op='sum', *args, **kwargs):
-        super(ReduceBlock, self).__init__(iring, *args, **kwargs)
-        self.specified_axis = axis
-        self.specified_factor = factor
-        self.op = op
+        super(ReduceBlock, self).__init__(
+            iring, ReduceStage(axis, factor, op), *args, **kwargs)
 
     def define_valid_input_spaces(self):
         return ('tpu', 'system')
 
-    def on_sequence(self, iseq):
-        ihdr = iseq.header
-        itensor = ihdr['_tensor']
-        ohdr = deepcopy(ihdr)
-        otensor = ohdr['_tensor']
-        otensor['dtype'] = 'f32'
-        if itensor['dtype'] in ('cf32', 'cf64') and \
-                not self.op.startswith('pwr'):
-            otensor['dtype'] = 'cf32'
-        if 'labels' in itensor and isinstance(self.specified_axis, str):
-            self.axis = itensor['labels'].index(self.specified_axis)
-        else:
-            self.axis = self.specified_axis
-        self.frame_axis = itensor['shape'].index(-1)
-        self.factor = self.specified_factor
-        if self.axis == self.frame_axis:
-            if self.specified_factor is None:
-                raise ValueError(
-                    "Reduce factor must be specified for frame axis")
-        else:
-            if self.specified_factor is None:
-                self.factor = otensor['shape'][self.axis]
-            elif otensor['shape'][self.axis] % self.factor != 0:
-                raise ValueError("Reduce factor does not divide axis length")
-            otensor['shape'][self.axis] //= self.factor
-        otensor['scales'][self.axis][1] *= self.factor
-        return ohdr
-
-    def define_output_nframes(self, input_nframe):
-        if self.axis == self.frame_axis:
-            if input_nframe % self.factor != 0:
-                raise ValueError("Reduce factor does not divide gulp size")
-            return input_nframe // self.factor
-        return input_nframe
-
     def on_data(self, ispan, ospan):
         if ispan.ring.space == 'tpu':
-            import jax
-            from ..ops.reduce import _reduce_jax
-            from ..dtype import DataType
-            odt = DataType(ospan.dtype)
-            key = (tuple(ispan.data.shape), str(ispan.data.dtype))
-            if getattr(self, '_fn_key', None) != key:
-                axis, factor, op = self.axis, self.factor, self.op
-                tgt = odt.as_jax_dtype()
-
-                def fn(x):
-                    import jax.numpy as jnp
-                    n = x.shape[axis]
-                    y = _reduce_jax(x, axis, factor if factor is not None
-                                    else n, op)
-                    if jnp.issubdtype(y.dtype, jnp.complexfloating) and \
-                            not jnp.issubdtype(jnp.dtype(tgt),
-                                               jnp.complexfloating):
-                        y = jnp.real(y)
-                    return y.astype(tgt)
-
-                self._fn = jax.jit(fn)
-                self._fn_key = key
-            ospan.set(self._fn(ispan.data))
-        else:
-            x = ispan.data.as_numpy()
-            axis, factor = self.axis, self.factor
-            n = x.shape[axis]
-            f = factor if factor is not None else n
-            newshape = x.shape[:axis] + (n // f, f) + x.shape[axis + 1:]
-            xr = x.reshape(newshape)
-            op = self.op
-            if op.startswith('pwr'):
-                xr = np.abs(xr.astype(np.complex64)) ** 2 \
-                    if np.iscomplexobj(xr) else xr.astype(np.float32) ** 2
-                op = op[3:]
-            fn = {'sum': np.sum, 'mean': np.mean, 'min': np.min,
-                  'max': np.max,
-                  'stderr': lambda a, axis: np.std(a, axis=axis) /
-                  np.sqrt(f)}[op]
-            out = ospan.data.as_numpy()
-            out[...] = fn(xr, axis=axis + 1).astype(out.dtype) \
-                if out.dtype.names is None else fn(xr, axis=axis + 1)
+            return super(ReduceBlock, self).on_data(ispan, ospan)
+        st = self._stage
+        x = ispan.data.as_numpy()
+        axis = st.axis
+        f = st.factor if st.factor is not None else x.shape[axis]
+        n = x.shape[axis]
+        newshape = x.shape[:axis] + (n // f, f) + x.shape[axis + 1:]
+        xr = x.reshape(newshape)
+        op = st.op
+        if op.startswith('pwr'):
+            xr = np.abs(xr.astype(np.complex64)) ** 2 \
+                if np.iscomplexobj(xr) else xr.astype(np.float32) ** 2
+            op = op[3:]
+        fn = {'sum': np.sum, 'mean': np.mean, 'min': np.min, 'max': np.max,
+              'stderr': lambda a, axis: np.std(a, axis=axis) / np.sqrt(f)
+              }[op]
+        out = ospan.data.as_numpy()
+        res = fn(xr, axis=axis + 1)
+        out[...] = res.real.astype(out.dtype) \
+            if np.iscomplexobj(res) and out.dtype.kind != 'c' \
+            else res.astype(out.dtype)
 
 
 def reduce(iring, axis, factor=None, op='sum', *args, **kwargs):

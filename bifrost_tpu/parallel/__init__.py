@@ -1,0 +1,17 @@
+"""Multi-chip scale-out over a jax device Mesh.
+
+The reference scales out with per-block `gpu=N` device placement plus
+UDP/RDMA point-to-point streams between nodes (reference:
+SURVEY.md §2.9; src/rdma.cpp, python/bifrost/rdma.py:99-203).  The
+TPU-native model is stronger: the heavy ops of a block are *sharded*
+over an ICI mesh with XLA collectives, so one logical block can span a
+pod slice.  This package provides:
+
+- mesh construction + scope integration (`BlockScope(mesh=...)`)
+- sharded versions of the hot ops (spectrometer, beamform, correlate,
+  FIR with halo exchange — the sequence-parallel pattern)
+"""
+
+from .mesh import create_mesh, mesh_axes, local_mesh
+from .ops import (sharded_spectrometer, sharded_beamform,
+                  sharded_correlate, sharded_fir, spectrometer_step)

@@ -1,0 +1,54 @@
+"""Device-mesh helpers.
+
+A bifrost_tpu pipeline block scales out by attaching a
+``jax.sharding.Mesh`` to its scope (``BlockScope(mesh=...)``); the
+block's jitted op then uses shard_map / sharding annotations over that
+mesh, with XLA inserting ICI collectives (the replacement for the
+reference's per-block `gpu=N` + explicit transports; SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+__all__ = ['create_mesh', 'mesh_axes', 'local_mesh']
+
+
+def create_mesh(axis_sizes=None, devices=None):
+    """Build a Mesh.
+
+    ``axis_sizes``: dict axis-name -> size, e.g. {'dp': 2, 'tp': 4};
+    or an int N for a 1-D ('dp',) mesh of N devices; or None for all
+    devices on a 1-D mesh.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+    if axis_sizes is None:
+        axis_sizes = {'dp': len(devices)}
+    elif isinstance(axis_sizes, int):
+        axis_sizes = {'dp': axis_sizes}
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(axis_sizes.values())
+    n = 1
+    for s in sizes:
+        n *= s
+    if n > len(devices):
+        raise ValueError("Mesh wants %d devices; %d available"
+                         % (n, len(devices)))
+    dev_array = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def mesh_axes(mesh):
+    return tuple(mesh.axis_names)
+
+
+def local_mesh(n=None, axis_sizes=None):
+    """Mesh over the first n local devices (testing convenience)."""
+    import jax
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return create_mesh(axis_sizes if axis_sizes is not None else len(devs),
+                       devices=devs)

@@ -101,3 +101,49 @@ def test_scrunch_and_accumulate():
     out = sink.result()
     assert out.shape == (4, 4)
     np.testing.assert_array_equal(out, 1.0)
+
+
+def test_fused_ci8_detect():
+    """Regression: ci8 (int-pair device rep) through a fused FFT->detect
+    chain — the pair axis must not count toward the logical rank."""
+    from bifrost_tpu.stages import FftStage, DetectStage
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    rng = np.random.RandomState(0)
+    raw = np.zeros((8, 2, 16), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-16, 16, size=(8, 2, 16))
+    raw['im'] = rng.randint(-16, 16, size=(8, 2, 16))
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 2, 16], 'ci8',
+                            labels=['time', 'pol', 'fine_time'])
+        src = NumpySourceBlock([raw], hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fused(b, [FftStage('fine_time', axis_labels='freq'),
+                                DetectStage('stokes', axis='pol')])
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    out = sink.result()
+    v = raw['re'].astype(np.float32) + 1j * raw['im']
+    s = np.fft.fft(v, axis=-1)
+    x, y = s[:, 0], s[:, 1]
+    xy = x * np.conj(y)
+    expect = np.stack([np.abs(x)**2 + np.abs(y)**2,
+                       np.abs(x)**2 - np.abs(y)**2,
+                       2 * xy.real, -2 * xy.imag], axis=1)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-3)
+
+
+def test_map_stage_complex_atype():
+    """Regression: MapStage a_type must be the input's logical dtype."""
+    from bifrost_tpu.stages import MapStage
+    rng = np.random.RandomState(1)
+    data = (rng.randn(8, 4) + 1j * rng.randn(8, 4)).astype(np.complex64)
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 4], 'cf32')
+        src = NumpySourceBlock([data], hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fused(b, [MapStage("b = (a_type)a * (a_type)2")])
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    np.testing.assert_allclose(sink.result(), data * 2, rtol=1e-5)
