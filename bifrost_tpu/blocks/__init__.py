@@ -17,3 +17,6 @@ from .quantize import QuantizeBlock, quantize
 from .unpack import UnpackBlock, unpack
 from .print_header import PrintHeaderBlock, print_header
 from .fused import FusedBlock, fused
+from .fdmt import FdmtBlock, fdmt
+from .correlate import CorrelateBlock, correlate
+from .fir import FirBlock, fir

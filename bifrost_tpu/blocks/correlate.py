@@ -1,0 +1,118 @@
+"""FX-correlator X step: cross-multiply stations, integrate in time
+(reference: python/bifrost/blocks/correlate.py:36-108, backed by the
+xGPU-style cherk kernel in src/linalg.cu:210-226).
+
+On TPU the per-channel a·a^H rides the MXU; ci8 voltages stay int8 and
+use three int8 matmuls with int32 accumulation (see ops.linalg).  The
+output matrix is fully filled (header ``matrix_fill_mode='full'``; the
+reference fills the lower triangle only, a CUDA-kernel economy that a
+systolic matmul does not need).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+from ..pipeline import TransformBlock
+
+__all__ = ['CorrelateBlock', 'correlate']
+
+
+class CorrelateBlock(TransformBlock):
+    def __init__(self, iring, nframe_per_integration, *args, **kwargs):
+        super(CorrelateBlock, self).__init__(iring, *args, **kwargs)
+        self.nframe_per_integration = nframe_per_integration
+        self._fn = {}
+
+    def define_valid_input_spaces(self):
+        return ('tpu',)
+
+    def define_output_nframes(self, input_nframe):
+        return 1
+
+    def on_sequence(self, iseq):
+        self.nframe_integrated = 0
+        self._acc = None
+        ihdr = iseq.header
+        itensor = ihdr['_tensor']
+        assert itensor['labels'] == ['time', 'freq', 'station', 'pol']
+        ohdr = deepcopy(ihdr)
+        otensor = ohdr['_tensor']
+        otensor['dtype'] = 'cf32'
+        for key in ('shape', 'labels', 'scales', 'units'):
+            # deep-copy the per-axis entries so the doubled station/pol
+            # axes don't alias each other or the input header
+            tv, fv, sv, pv = (deepcopy(v) for v in itensor[key])
+            otensor[key] = [tv, fv, sv, pv,
+                            deepcopy(sv) if key != 'labels' else sv + '_j',
+                            deepcopy(pv) if key != 'labels' else pv + '_j']
+        otensor['labels'][2] += '_i'
+        otensor['labels'][3] += '_i'
+        otensor['scales'][0][1] *= self.nframe_per_integration
+        ohdr['matrix_fill_mode'] = 'full'
+        # The engine reads gulps of the *input* header's gulp_nframe (or
+        # this block's override); that is what must divide the integration.
+        gulp_actual = self.gulp_nframe or ihdr['gulp_nframe']
+        if self.nframe_per_integration % gulp_actual != 0:
+            raise ValueError(
+                "gulp_nframe (%d) does not divide nframe_per_integration "
+                "(%d)" % (gulp_actual, self.nframe_per_integration))
+        ohdr['gulp_nframe'] = min(ihdr['gulp_nframe'],
+                                  self.nframe_per_integration)
+        return ohdr
+
+    def _build(self, shape, dtype, reim):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(x, acc):
+            if reim:
+                # int8 MXU path: x (T, F, S, P, 2)
+                t, f, s, p = x.shape[:4]
+                re = x[..., 0].reshape(t, f, s * p)
+                im = x[..., 1].reshape(t, f, s * p)
+                rr = jnp.einsum('tfi,tfj->fij', re, re,
+                                preferred_element_type=jnp.int32)
+                ii = jnp.einsum('tfi,tfj->fij', im, im,
+                                preferred_element_type=jnp.int32)
+                k = jnp.einsum('tfi,tfj->fij', im, re,
+                               preferred_element_type=jnp.int32)
+                vis = (rr + ii).astype(jnp.float32) + \
+                    1j * (k - jnp.swapaxes(k, -1, -2)).astype(jnp.float32)
+                vis = vis.reshape(f, s, p, s, p)
+            else:
+                t, f, s, p = x.shape
+                xm = x.reshape(t, f, s * p)
+                vis = jnp.einsum('tfi,tfj->fij', xm, jnp.conj(xm),
+                                 preferred_element_type=jnp.complex64)
+                vis = vis.reshape(f, s, p, s, p)
+            return vis if acc is None else acc + vis
+
+        return jax.jit(fn)
+
+    def on_data(self, ispan, ospan):
+        import jax.numpy as jnp
+        x = ispan.data
+        reim = ispan.tensor['dtype'].kind == 'ci' and \
+            not jnp.issubdtype(x.dtype, jnp.complexfloating)
+        key = (tuple(x.shape), str(x.dtype), self._acc is None)
+        fn = self._fn.get(key)
+        if fn is None:
+            fn = self._build(x.shape, x.dtype, reim)
+            self._fn[key] = fn
+        self._acc = fn(x, self._acc)
+        self.nframe_integrated += ispan.nframe
+        assert self.nframe_integrated <= self.nframe_per_integration
+        if self.nframe_integrated == self.nframe_per_integration:
+            self.nframe_integrated = 0
+            out = self._acc[None]    # add the time axis
+            self._acc = None
+            ospan.set(out.astype(jnp.complex64))
+            return 1
+        return 0
+
+
+def correlate(iring, nframe_per_integration, *args, **kwargs):
+    """Block: the X step of an FX correlator (reference docstring:
+    blocks/correlate.py:106-136; xGPU reference arXiv:1107.4264)."""
+    return CorrelateBlock(iring, nframe_per_integration, *args, **kwargs)

@@ -8,3 +8,6 @@ from .linalg import LinAlg, matmul
 from .reduce import reduce
 from .transpose import transpose
 from .quantize import quantize, unpack
+from .fdmt import Fdmt
+from .fir import Fir
+from .romein import Romein

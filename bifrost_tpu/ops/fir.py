@@ -1,0 +1,90 @@
+"""FIR filter with decimation and inter-gulp state.
+
+Reference: src/fir.cu:53-416 (multi-tap FIR across ant-pols, carrying
+state0/state1 between gulps); python/bifrost/fir.py.
+
+The filter runs along the leading (time) axis.  Coefficients have shape
+(ntap,) — shared across channels — or (ntap, *tail_shape) matching the
+per-sample tail dims for per-antpol filters (reference semantics).
+State (the last ntap-1 input frames) is carried in the plan object, so
+streaming gulps are seamless; ``reset_state`` zeroes it
+(reference: bfFirResetState).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import as_jax
+from .fft import _writeback
+
+__all__ = ['Fir']
+
+
+class Fir(object):
+    def __init__(self):
+        self._coeffs = None
+        self._decim = 1
+        self._state = None
+        self._fn = {}
+
+    def init(self, coeffs, decim=1, space='tpu'):
+        import jax.numpy as jnp
+        self._coeffs = as_jax(coeffs)
+        self._decim = int(decim)
+        self._state = None
+        self._fn = {}
+        return self
+
+    def set_coeffs(self, coeffs):
+        self._coeffs = as_jax(coeffs)
+        self._fn = {}
+        return self
+
+    def reset_state(self):
+        self._state = None
+        return self
+
+    @property
+    def ntap(self):
+        return self._coeffs.shape[0]
+
+    def _build(self, in_shape, in_dtype):
+        import jax
+        import jax.numpy as jnp
+        coeffs = self._coeffs
+        ntap, decim = self.ntap, self._decim
+
+        def fn(x, state):
+            # x: (T, ...), state: (ntap-1, ...)
+            xp = jnp.concatenate([state, x], axis=0) if ntap > 1 else x
+            acc = None
+            for t in range(ntap):
+                c = coeffs[t]
+                sl = xp[ntap - 1 - t: xp.shape[0] - t]
+                term = c * sl
+                acc = term if acc is None else acc + term
+            if decim > 1:
+                acc = acc[::decim]
+            new_state = xp[-(ntap - 1):] if ntap > 1 else state
+            return acc, new_state
+
+        return jax.jit(fn)
+
+    def execute(self, idata, odata=None):
+        import jax.numpy as jnp
+        x = as_jax(idata)
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            x = x.astype(jnp.float32)
+        if self._state is None or self._state.shape[1:] != x.shape[1:]:
+            self._state = jnp.zeros((max(self.ntap - 1, 1),) + x.shape[1:],
+                                    x.dtype)
+        key = (x.shape, str(x.dtype))
+        fn = self._fn.get(key)
+        if fn is None:
+            fn = self._build(x.shape, x.dtype)
+            self._fn[key] = fn
+        y, self._state = fn(x, self._state)
+        if odata is not None:
+            return _writeback(y, odata)
+        return y

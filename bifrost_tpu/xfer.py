@@ -39,7 +39,14 @@ def _split(arr):
 
 def to_device(arr, device=None):
     """numpy -> jax.Array; complex is shipped as two float planes and
-    recombined on device."""
+    recombined on device.
+
+    IMPORTANT: the input is copied defensively.  On the CPU backend,
+    device_put of an aligned numpy array is ZERO-COPY — the 'device'
+    array would alias ring-buffer memory that the writer recycles,
+    corrupting in-flight gulps (on TPU the transfer itself copies, so
+    the bug only bites in CPU-backend tests — the worst kind).
+    """
     import jax
     import jax.numpy as jnp
     arr = np.asarray(arr)
@@ -51,18 +58,26 @@ def to_device(arr, device=None):
             return _combine(jax.device_put(re, device),
                             jax.device_put(im, device))
         return _combine(jnp.asarray(re), jnp.asarray(im))
+    if jax.default_backend() == 'cpu' and isinstance(arr, np.ndarray):
+        arr = np.array(arr, copy=True)
     if device is not None:
         return jax.device_put(arr, device)
     return jnp.asarray(arr)
 
 
 def to_host(arr):
-    """jax.Array -> numpy; complex is split on device and shipped as two
-    float planes.  Blocks until the value is ready (the D2H sync point,
-    reference: cudaStreamSynchronize per gulp)."""
+    """array -> numpy; complex jax arrays are split on device and shipped
+    as two float planes.  Blocks until the value is ready (the D2H sync
+    point, reference: cudaStreamSynchronize per gulp).  Accepts jax
+    arrays, numpy arrays, and bifrost_tpu ndarrays."""
+    import jax
     import jax.numpy as jnp
-    if hasattr(arr, 'dtype') and jnp.issubdtype(arr.dtype,
-                                                jnp.complexfloating):
+    if hasattr(arr, 'as_numpy'):       # bifrost_tpu.ndarray
+        return arr.as_numpy()
+    if isinstance(arr, np.ndarray):
+        return arr
+    if isinstance(arr, jax.Array) and jnp.issubdtype(arr.dtype,
+                                                     jnp.complexfloating):
         re, im = _split(arr)
         out = np.asarray(re).astype(
             np.float64 if arr.dtype == jnp.complex128 else np.float32)

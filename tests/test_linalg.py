@@ -1,0 +1,54 @@
+"""LinAlg tests vs numpy einsum (reference analogue: test/test_linalg.py)."""
+
+import numpy as np
+
+import bifrost_tpu as bf
+from bifrost_tpu.ops import LinAlg
+
+
+def test_matmul_ab():
+    rng = np.random.RandomState(0)
+    a = (rng.randn(4, 8, 16) + 1j * rng.randn(4, 8, 16)).astype(np.complex64)
+    b = (rng.randn(4, 16, 8) + 1j * rng.randn(4, 16, 8)).astype(np.complex64)
+    la = LinAlg()
+    y = np.asarray(la.matmul(1.0, a, b, 0.0, None))
+    np.testing.assert_allclose(y, a @ b, rtol=1e-4)
+
+
+def test_matmul_aah():
+    rng = np.random.RandomState(1)
+    a = (rng.randn(3, 8, 16) + 1j * rng.randn(3, 8, 16)).astype(np.complex64)
+    la = LinAlg()
+    y = np.asarray(la.matmul(1.0, a, None, 0.0, None))
+    expect = a @ np.conj(a.transpose(0, 2, 1))
+    np.testing.assert_allclose(y, expect, rtol=1e-4)
+
+
+def test_matmul_aah_int8_mxu_path():
+    """ci8 correlation: exact integer arithmetic through the 3-matmul
+    path (reference: Cherk3mEx, src/linalg.cu:130-148)."""
+    rng = np.random.RandomState(2)
+    n, k = 16, 32
+    re = rng.randint(-64, 64, size=(n, k)).astype(np.int8)
+    im = rng.randint(-64, 64, size=(n, k)).astype(np.int8)
+    a = bf.empty((n, k), 'ci8', 'system')
+    buf = a.as_numpy()
+    buf['re'], buf['im'] = re, im
+    ad = a.copy('tpu')
+    la = LinAlg()
+    y = np.asarray(la.matmul(1.0, ad, None, 0.0, None))
+    c = re.astype(np.float64) + 1j * im
+    expect = c @ np.conj(c.T)
+    np.testing.assert_array_equal(y, expect.astype(np.complex64))
+
+
+def test_matmul_beta_accumulate():
+    rng = np.random.RandomState(3)
+    a = rng.randn(8, 4).astype(np.float32)
+    b = rng.randn(4, 8).astype(np.float32)
+    c = bf.asarray(rng.randn(8, 8).astype(np.float32), space='tpu')
+    c0 = np.asarray(c.data).copy()
+    la = LinAlg()
+    la.matmul(2.0, a, b, 3.0, c)
+    np.testing.assert_allclose(np.asarray(c.data), 2 * (a @ b) + 3 * c0,
+                               rtol=1e-4)
