@@ -20,3 +20,10 @@ from .fused import FusedBlock, fused
 from .fdmt import FdmtBlock, fdmt
 from .correlate import CorrelateBlock, correlate
 from .fir import FirBlock, fir
+from .sigproc import (SigprocSourceBlock, SigprocSinkBlock, read_sigproc,
+                      write_sigproc)
+from .guppi_raw import GuppiRawSourceBlock, read_guppi_raw
+from .binary_io import (BinaryFileReadBlock, BinaryFileWriteBlock,
+                        binary_read, binary_write)
+from .serialize import (SerializeBlock, DeserializeBlock, serialize,
+                        deserialize)

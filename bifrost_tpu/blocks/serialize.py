@@ -1,0 +1,149 @@
+"""Serialize/deserialize a ring stream to disk — the checkpoint/replay
+mechanism (reference: python/bifrost/blocks/serialize.py:45-279).
+
+On-disk layout per sequence:
+  <name>.bf.json              — the sequence header (JSON)
+  <name>.bf.<ringlet>.dat     — raw frame data (one file per ringlet,
+                                single file '0' when nringlet == 1)
+
+A serialized stream can be re-ingested with DeserializeBlock, giving
+pipeline checkpoint/resume of buffered data (SURVEY.md §5
+checkpoint/resume notes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..pipeline import SourceBlock, SinkBlock
+from ..ring import split_shape
+from ..dtype import DataType
+
+__all__ = ['SerializeBlock', 'DeserializeBlock', 'serialize', 'deserialize']
+
+
+def _slug(name):
+    return str(name).replace('/', '_')
+
+
+class SerializeBlock(SinkBlock):
+    def __init__(self, iring, path=None, max_file_size=None,
+                 *args, **kwargs):
+        super(SerializeBlock, self).__init__(iring, *args, **kwargs)
+        if max_file_size is not None:
+            raise NotImplementedError(
+                "max_file_size (file splitting) is not implemented yet")
+        self.path = path or ''
+        self._files = None
+
+    def define_valid_input_spaces(self):
+        return ('system',)
+
+    def on_sequence(self, iseq):
+        hdr = iseq.header
+        basename = _slug(hdr.get('name', 'sequence'))
+        base = os.path.join(self.path, basename)
+        with open(base + '.bf.json', 'w') as f:
+            json.dump(hdr, f)
+        tensor = hdr['_tensor']
+        ringlet_shape, _ = split_shape(tensor['shape'])
+        nringlet = int(np.prod(ringlet_shape)) if ringlet_shape else 1
+        self._nringlet = nringlet
+        self._files = [open('%s.bf.%02i.dat' % (base, r), 'wb')
+                       for r in range(nringlet)]
+
+    def on_data(self, ispan):
+        buf = np.ascontiguousarray(ispan.data.as_numpy())
+        if self._nringlet == 1:
+            self._files[0].write(buf.tobytes())
+        else:
+            flat = buf.reshape(self._nringlet, -1)
+            for r, f in enumerate(self._files):
+                f.write(flat[r].tobytes())
+
+    def on_sequence_end(self, iseq):
+        if self._files:
+            for f in self._files:
+                f.close()
+            self._files = None
+
+
+class _DeserializeReader(object):
+    def __init__(self, basename):
+        self.basename = basename
+        with open(basename + '.bf.json') as f:
+            self.header = json.load(f)
+        tensor = self.header['_tensor']
+        ringlet_shape, frame_shape = split_shape(tensor['shape'])
+        self.nringlet = int(np.prod(ringlet_shape)) if ringlet_shape else 1
+        dtype = DataType(tensor['dtype'])
+        nelem = int(np.prod(frame_shape)) if frame_shape else 1
+        self.frame_nbyte = nelem * dtype.itemsize_bits // 8
+        self.files = []
+        r = 0
+        while True:
+            path = '%s.bf.%02i.dat' % (basename, r)
+            if not os.path.exists(path):
+                break
+            self.files.append(open(path, 'rb'))
+            r += 1
+        if not self.files:
+            raise IOError("No .dat files found for %s" % basename)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        for f in self.files:
+            f.close()
+        return False
+
+    def read_frames(self, nframe):
+        chunks = [f.read(nframe * self.frame_nbyte) for f in self.files]
+        n = min(len(c) for c in chunks) // self.frame_nbyte
+        return [c[:n * self.frame_nbyte] for c in chunks], n
+
+
+class DeserializeBlock(SourceBlock):
+    def __init__(self, filenames, gulp_nframe, *args, **kwargs):
+        names = [f[:-len('.bf.json')] if f.endswith('.bf.json') else f
+                 for f in filenames]
+        super(DeserializeBlock, self).__init__(names, gulp_nframe,
+                                               *args, **kwargs)
+
+    def create_reader(self, sourcename):
+        return _DeserializeReader(sourcename)
+
+    def on_sequence(self, reader, sourcename):
+        return [dict(reader.header)]
+
+    def on_data(self, reader, ospans):
+        ospan = ospans[0]
+        chunks, nframe = reader.read_frames(ospan.nframe)
+        if nframe == 0:
+            return [0]
+        buf = ospan.data.as_numpy()
+        flat = buf.view(np.uint8)
+        if reader.nringlet == 1:
+            tgt = flat.reshape(-1)
+            raw = np.frombuffer(chunks[0], np.uint8)
+            tgt[:len(raw)] = raw
+        else:
+            lanes = flat.reshape(reader.nringlet, -1)
+            per = nframe * reader.frame_nbyte
+            for r, c in enumerate(chunks):
+                lanes[r, :per] = np.frombuffer(c, np.uint8)
+        return [nframe]
+
+
+def serialize(iring, path=None, max_file_size=None, *args, **kwargs):
+    """Block: dump a stream to .bf.json + .bf.*.dat files."""
+    return SerializeBlock(iring, path, max_file_size, *args, **kwargs)
+
+
+def deserialize(filenames, gulp_nframe, *args, **kwargs):
+    """Block: replay a serialized stream."""
+    return DeserializeBlock(filenames, gulp_nframe, *args, **kwargs)

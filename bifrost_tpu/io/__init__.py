@@ -1,0 +1,8 @@
+"""File-format readers/writers (host side).
+
+Reference equivalents: python/bifrost/sigproc.py, guppi_raw.py,
+blocks/binary_io.py, blocks/serialize.py.
+"""
+
+from . import sigproc
+from . import guppi

@@ -34,9 +34,23 @@ def transform_units(units, power):
     return '%s^%d' % (units, power)
 
 
+_SCALES = {
+    'Hz': 1.0, 'kHz': 1e3, 'MHz': 1e6, 'GHz': 1e9, 'THz': 1e12,
+    's': 1.0, 'ms': 1e-3, 'us': 1e-6, 'ns': 1e-9, 'ps': 1e-12,
+    'm': 1.0, 'km': 1e3, 'cm': 1e-2, 'mm': 1e-3,
+}
+
+_FAMILY = {'Hz': 'f', 'kHz': 'f', 'MHz': 'f', 'GHz': 'f', 'THz': 'f',
+           's': 't', 'ms': 't', 'us': 't', 'ns': 't', 'ps': 't',
+           'm': 'l', 'km': 'l', 'cm': 'l', 'mm': 'l'}
+
+
 def convert_units(value, from_units, to_units):
-    if from_units == to_units:
+    if from_units == to_units or from_units is None or to_units is None:
         return value
+    if from_units in _SCALES and to_units in _SCALES and \
+            _FAMILY[from_units] == _FAMILY[to_units]:
+        return value * _SCALES[from_units] / _SCALES[to_units]
     if _ureg is not None:
         return (value * _ureg(from_units)).to(_ureg(to_units)).magnitude
     raise ValueError("Cannot convert %r -> %r without pint"

@@ -1,0 +1,48 @@
+"""High-resolution spectroscopy of GUPPI RAW data — the north-star
+pipeline (reference: testbench/gpuspec_simple.py:44-58).
+
+  read_guppi_raw -> copy('tpu') -> FUSED[ FFT(fine_time) ->
+  detect('stokes') -> reduce(freq x4) ] -> copy('system')
+  -> write_sigproc
+
+Usage: python gpuspec_simple.py <file.raw> [outdir]
+"""
+
+import sys
+
+import bifrost_tpu as bf
+from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+
+
+def build(filenames, outdir='.', gulp_nframe=1, rfactor=4):
+    bc = bf.BlockChainer()
+    bc.blocks.read_guppi_raw(filenames, gulp_nframe=gulp_nframe)
+    bc.blocks.copy(space='tpu')
+    bc.blocks.fused([
+        FftStage('fine_time', axis_labels='fine_freq'),
+        DetectStage('stokes', axis='pol'),
+        ReduceStage('fine_freq', rfactor),
+    ])
+    bc.blocks.copy(space='system')
+    # merge (freq, fine_freq) into one spectral axis and relabel for
+    # filterbank output: ['time', 'pol', 'freq']
+    bc.views.merge_axes('freq', 'fine_freq', label='freq')
+    bc.blocks.transpose(['time', 'pol', 'freq'])
+    bc.blocks.write_sigproc(path=outdir)
+    return bc
+
+
+def main(argv):
+    if len(argv) < 2:
+        print(__doc__)
+        return 1
+    outdir = argv[2] if len(argv) > 2 else '.'
+    build([argv[1]], outdir)
+    pipeline = bf.get_default_pipeline()
+    pipeline.shutdown_on_signals()
+    pipeline.run()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv))

@@ -1,0 +1,132 @@
+"""File I/O tests: sigproc round trip, guppi raw read, binary io,
+serialize/deserialize (reference analogues: test/test_sigproc.py,
+test_binary_io.py, test_serialize.py)."""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu.io import sigproc as sp_io
+from bifrost_tpu.io import guppi as guppi_io
+from tests.util import NumpySourceBlock, GatherSink, simple_header
+
+
+def _make_filterbank(path, data, fch1=1400., foff=-1., tsamp=1e-3):
+    """data: (T, nifs, nchans) uint8/int8/float32"""
+    nbits = data.dtype.itemsize * 8
+    if data.dtype == np.float32:
+        nbits = 32
+    hdr = {'telescope_id': 6, 'machine_id': 0, 'data_type': 1,
+           'nchans': data.shape[2], 'nifs': data.shape[1], 'nbits': nbits,
+           'fch1': fch1, 'foff': foff, 'tstart': 58000.0, 'tsamp': tsamp,
+           'source_name': 'TEST'}
+    if data.dtype == np.int8:
+        hdr['signed'] = 1
+    with open(path, 'wb') as f:
+        sp_io.write_header(f, hdr)
+        f.write(data.tobytes())
+
+
+def test_sigproc_file_reader(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 255, size=(32, 1, 16)).astype(np.uint8)
+    path = str(tmp_path / 'test.fil')
+    _make_filterbank(path, data)
+    sf = sp_io.SigprocFile(path)
+    assert sf.header['nchans'] == 16
+    assert sf.header['source_name'] == 'TEST'
+    assert sf.nframe() == 32
+    out = sf.read(32)
+    np.testing.assert_array_equal(out, data)
+    sf.close()
+
+
+def test_sigproc_pipeline_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, 255, size=(32, 1, 8)).astype(np.uint8)
+    src_path = str(tmp_path / 'in.fil')
+    _make_filterbank(src_path, data)
+    outdir = str(tmp_path)
+    with bf.Pipeline() as p:
+        b = bf.blocks.read_sigproc([src_path], gulp_nframe=8)
+        sink = GatherSink(b)
+        b2 = bf.blocks.copy(b)
+        bf.blocks.write_sigproc(b2, path=outdir)
+        p.run()
+    np.testing.assert_array_equal(sink.result(), data)
+    # the sink writes <name>.fil where name = source path basename
+    out_path = os.path.join(outdir, 'in.fil')
+    assert os.path.exists(out_path)
+    sf = sp_io.SigprocFile(out_path)
+    np.testing.assert_array_equal(sf.read(32), data)
+    assert sf.header['fch1'] == 1400.
+    sf.close()
+
+
+def test_guppi_raw_reader(tmp_path):
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    nchan, ntime, npol, nblock = 4, 16, 2, 3
+    blocsize = nchan * ntime * npol * 2
+    rng = np.random.RandomState(2)
+    blocks_data = []
+    path = str(tmp_path / 'test.raw')
+    with open(path, 'wb') as f:
+        for b in range(nblock):
+            guppi_io.write_header(f, {
+                'OBSNCHAN': nchan, 'NPOL': npol, 'NBITS': 8,
+                'BLOCSIZE': blocsize, 'OBSFREQ': 1500.0, 'OBSBW': 4.0,
+                'STT_IMJD': 58000, 'STT_SMJD': 0, 'PKTIDX': b,
+                'PKTSIZE': 8192, 'TELESCOP': 'GBT', 'BACKEND': 'GUPPI',
+                'SRC_NAME': 'B0329+54'})
+            raw = rng.randint(-128, 128, size=blocsize).astype(np.int8)
+            blocks_data.append(raw.copy())
+            f.write(raw.tobytes())
+    with bf.Pipeline() as p:
+        b = bf.blocks.read_guppi_raw([path])
+        sink = GatherSink(b)
+        p.run()
+    hdr = sink.headers[0]
+    assert hdr['_tensor']['dtype'] == 'ci8'
+    assert hdr['_tensor']['shape'] == [-1, nchan, ntime, npol]
+    assert hdr['_tensor']['labels'] == ['time', 'freq', 'fine_time', 'pol']
+    assert hdr['source_name'] == 'B0329+54'
+    out = sink.result()
+    assert out.shape == (nblock, nchan, ntime, npol)
+    got = out.view(np.int8).reshape(nblock, -1)
+    np.testing.assert_array_equal(got, np.stack(blocks_data))
+
+
+def test_binary_io_roundtrip(tmp_path):
+    rng = np.random.RandomState(3)
+    data = rng.randn(64 * 16).astype(np.float32)
+    path = str(tmp_path / 'raw.bin')
+    data.tofile(path)
+    with bf.Pipeline() as p:
+        b = bf.blocks.binary_read([path], gulp_size=16, gulp_nframe=8,
+                                  dtype='f32')
+        sink = GatherSink(b)
+        p.run()
+    np.testing.assert_array_equal(sink.result().ravel(), data)
+
+
+def test_serialize_deserialize_roundtrip(tmp_path):
+    rng = np.random.RandomState(4)
+    data = rng.randn(16, 4).astype(np.float32)
+    hdr = simple_header([-1, 4], 'f32', name='stream0')
+    os.chdir(str(tmp_path))
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock([data[:8], data[8:]], hdr, gulp_nframe=8)
+        bf.blocks.serialize(src, path=str(tmp_path))
+        p.run()
+    assert os.path.exists(str(tmp_path / 'stream0.bf.json'))
+    with bf.Pipeline() as p:
+        b = bf.blocks.deserialize([str(tmp_path / 'stream0')],
+                                  gulp_nframe=8)
+        sink = GatherSink(b)
+        p.run()
+    np.testing.assert_array_equal(sink.result(), data)
+    assert sink.headers[0]['_tensor']['labels'] == ['time', 'dim1']
