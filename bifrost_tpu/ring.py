@@ -269,6 +269,17 @@ class Ring(object):
 
     instance_count = 0
 
+    def __new__(cls, space='system', name=None, owner=None, core=None):
+        # Host-space rings use the native C++ core when available
+        # (native/ring.cpp); device rings keep the Python chunk-map core
+        # because their payloads are jax Arrays.
+        if cls is Ring and canonical(space) != 'tpu':
+            from .native import available
+            if available():
+                from .ring_native import NativeRing
+                return super(Ring, cls).__new__(NativeRing)
+        return super(Ring, cls).__new__(cls)
+
     def __init__(self, space='system', name=None, owner=None, core=None):
         self.space = canonical(space)
         if name is None:
@@ -389,7 +400,19 @@ class Ring(object):
     def _min_guarantee(self):
         return min(self._guarantees.values()) if self._guarantees else _INF
 
-    def _reserve_span(self, nbyte, nonblocking=False):
+    # -- reader registration hooks (overridden by NativeRing) -------------
+    def _register_reader(self, rseq):
+        if rseq.guarantee:
+            with self._lock:
+                self._guarantees[id(rseq)] = max(rseq._seq.begin,
+                                                 self._tail)
+
+    def _reader_moved(self, rseq, new_seq):
+        if rseq.guarantee:
+            with self._lock:
+                self._guarantees[id(rseq)] = max(new_seq.begin, self._tail)
+
+    def _reserve_span(self, nbyte, nonblocking=False, span=None):
         with self._lock:
             if nbyte > self._ghost:
                 # Guaranteed-contiguous window too small; grow it.
@@ -715,9 +738,7 @@ class ReadSequence(_SequenceAPI):
         self.guarantee = guarantee
         self.header_transform = header_transform
         self._seq = ring._open_seq(which, name=name, time_tag=time_tag)
-        if guarantee:
-            with ring._lock:
-                ring._guarantees[id(self)] = max(self._seq.begin, ring._tail)
+        ring._register_reader(self)
 
     def __enter__(self):
         return self
@@ -733,10 +754,7 @@ class ReadSequence(_SequenceAPI):
         nxt = self._ring._next_seq(self._seq)
         self._seq = nxt
         self._tensor = None
-        if self.guarantee:
-            with self._ring._lock:
-                self._ring._guarantees[id(self)] = max(nxt.begin,
-                                                       self._ring._tail)
+        self._ring._reader_moved(self, nxt)
 
     @property
     def header(self):
@@ -859,7 +877,9 @@ class WriteSpan(_SpanAPI):
         self._closed = False
         self._commit_nbyte = None
         self._device_array = None
-        self._begin = ring._reserve_span(self._nbyte, nonblocking)
+        self._native_id = None
+        self._begin = ring._reserve_span(self._nbyte, nonblocking,
+                                         span=self)
         with ring._lock:
             ring._open_wspans.append(self)
             ring._nwrite_open += 1

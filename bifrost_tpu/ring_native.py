@@ -1,0 +1,275 @@
+"""NativeRing: host-space Ring backed by the C++ core (native/ring.cpp).
+
+Implements the same internal protocol as the Python Ring — the
+WriteSequence/ReadSequence/WriteSpan/ReadSpan wrappers in ring.py are
+shared, so behavior-visible semantics are identical; only the locked
+state machine and the byte buffer live in C++.  Flow control (blocking
+reserve/acquire, guarantees, the in-order commit barrier, ghost copies,
+live resize) all run native, releasing the GIL while blocked.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import threading
+
+import numpy as np
+
+from . import native
+from .ring import Ring, EndOfDataStop, WouldBlock
+
+__all__ = ['NativeRing']
+
+_WHICH = {'specific': 0, 'at': 1, 'latest': 2, 'earliest': 3}
+
+
+class _NativeSeq(object):
+    """Sequence facade over a native handle (attributes match the Python
+    core's _Sequence)."""
+
+    __slots__ = ('_lib', '_handle', 'name', 'time_tag', 'header', 'begin',
+                 'nringlet')
+
+    def __init__(self, lib, handle):
+        self._lib = lib
+        self._handle = handle
+        name = ctypes.c_char_p()
+        ttag = ctypes.c_longlong()
+        hdr = ctypes.c_char_p()
+        hlen = ctypes.c_longlong()
+        begin = ctypes.c_longlong()
+        nrl = ctypes.c_longlong()
+        native.check(lib.bft_seq_info(
+            handle, ctypes.byref(name), ctypes.byref(ttag),
+            ctypes.byref(hdr), ctypes.byref(hlen), ctypes.byref(begin),
+            ctypes.byref(nrl)), 'seq_info')
+        self.name = (name.value or b'').decode()
+        self.time_tag = ttag.value
+        raw = ctypes.string_at(hdr, hlen.value) if hlen.value else b'{}'
+        self.header = json.loads(raw.decode())
+        self.begin = begin.value
+        self.nringlet = nrl.value
+
+    @property
+    def end(self):
+        e = ctypes.c_longlong()
+        native.check(self._lib.bft_seq_end_offset(self._handle,
+                                                  ctypes.byref(e)))
+        return None if e.value < 0 else e.value
+
+    @property
+    def finished(self):
+        return self.end is not None
+
+
+class _NativeStorage(object):
+    """Zero-copy numpy views over the native buffer.  Ghost maintenance
+    happens inside the C core (commit/acquire), so the hook methods are
+    no-ops here."""
+
+    def __init__(self, ring):
+        self._ring = ring
+
+    def _view(self, offset, nbyte):
+        lib = self._ring._lib
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        size = ctypes.c_longlong()
+        ghost = ctypes.c_longlong()
+        nrl = ctypes.c_longlong()
+        native.check(lib.bft_ring_geometry(
+            self._ring._handle, ctypes.byref(buf), ctypes.byref(size),
+            ctypes.byref(ghost), ctypes.byref(nrl)), 'geometry')
+        lane = size.value + ghost.value
+        total = nrl.value * lane
+        base = np.ctypeslib.as_array(buf, shape=(total,))
+        bo = offset % size.value
+        lanes = np.lib.stride_tricks.as_strided(
+            base[bo:], shape=(nrl.value, nbyte), strides=(lane, 1))
+        return lanes
+
+    def write_view(self, offset, nbyte):
+        return self._view(offset, nbyte)
+
+    read_view = write_view
+
+    def commit_ghost(self, offset, nbyte):
+        pass   # done by bft_ring_commit
+
+    def refresh_ghost(self, offset, nbyte):
+        pass   # done by bft_reader_acquire
+
+    def discard_before(self, offset):
+        pass
+
+
+class NativeRing(Ring):
+    def __init__(self, space='system', name=None, owner=None, core=None):
+        super(NativeRing, self).__init__(space=space, name=name,
+                                         owner=owner, core=core)
+        self._lib = native.load()
+        if self._lib is None:
+            raise native.NativeError("native library unavailable")
+        handle = ctypes.c_void_p()
+        native.check(self._lib.bft_ring_create(
+            ctypes.byref(handle), self.name.encode()), 'create')
+        self._handle = handle
+        self._storage = _NativeStorage(self)
+        self._seq_cache = {}    # native ptr -> _NativeSeq
+        self._cache_lock = threading.Lock()
+
+    def __del__(self):
+        try:
+            if getattr(self, '_handle', None) is not None and \
+                    not getattr(self, 'is_view', False):
+                self._lib.bft_ring_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+    def _wrap_seq(self, handle_value):
+        with self._cache_lock:
+            seq = self._seq_cache.get(handle_value)
+            if seq is None:
+                seq = _NativeSeq(self._lib, ctypes.c_void_p(handle_value))
+                self._seq_cache[handle_value] = seq
+            return seq
+
+    # -- geometry ---------------------------------------------------------
+    def resize(self, contiguous_bytes, total_bytes=None, nringlet=1):
+        native.check(self._lib.bft_ring_resize(
+            self._handle, contiguous_bytes,
+            -1 if total_bytes is None else total_bytes, nringlet),
+            'resize')
+
+    @property
+    def total_span(self):
+        size = ctypes.c_longlong()
+        native.check(self._lib.bft_ring_geometry(
+            self._handle, None, ctypes.byref(size), None, None))
+        return size.value
+
+    @property
+    def nringlet(self):
+        nrl = ctypes.c_longlong()
+        native.check(self._lib.bft_ring_geometry(
+            self._handle, None, None, None, ctypes.byref(nrl)))
+        return nrl.value
+
+    # -- writer side ------------------------------------------------------
+    def _begin_writing(self):
+        with self._lock:
+            self._writing = True
+            self._eod = False
+        native.check(self._lib.bft_ring_begin_writing(self._handle))
+
+    def end_writing(self):
+        with self._lock:
+            self._writing = False
+            self._eod = True
+        native.check(self._lib.bft_ring_end_writing(self._handle))
+
+    def _begin_sequence(self, name, time_tag, header, nringlet):
+        hdr = json.dumps(header).encode()
+        out = ctypes.c_void_p()
+        rc = self._lib.bft_ring_begin_sequence(
+            self._handle, name.encode(), int(time_tag), hdr, len(hdr),
+            int(nringlet), ctypes.byref(out))
+        if rc == -2:
+            raise RuntimeError(
+                "Cannot begin sequence %r: previous sequence is still "
+                "open" % name)
+        native.check(rc, 'begin_sequence')
+        return self._wrap_seq(out.value)
+
+    def _end_sequence(self, seq):
+        native.check(self._lib.bft_ring_end_sequence(self._handle,
+                                                     seq._handle))
+
+    def _reserve_span(self, nbyte, nonblocking=False, span=None):
+        if span is None:
+            raise RuntimeError("NativeRing reserve requires a span object")
+        begin = ctypes.c_longlong()
+        sid = ctypes.c_longlong()
+        rc = self._lib.bft_ring_reserve(
+            self._handle, nbyte, 1 if nonblocking else 0,
+            ctypes.byref(begin), ctypes.byref(sid))
+        if rc == native.BFT_WOULD_BLOCK:
+            raise WouldBlock()
+        native.check(rc, 'reserve')
+        span._native_id = sid.value
+        return begin.value
+
+    def _commit_span(self, wspan, commit_nbyte):
+        native.check(self._lib.bft_ring_commit(
+            self._handle, wspan._native_id, commit_nbyte), 'commit')
+        with self._lock:
+            if wspan in self._open_wspans:
+                self._open_wspans.remove(wspan)
+                self._nwrite_open -= 1
+
+    # -- reader side ------------------------------------------------------
+    def _register_reader(self, rseq):
+        rid = ctypes.c_longlong()
+        native.check(self._lib.bft_reader_create(
+            self._handle, 1 if rseq.guarantee else 0, ctypes.byref(rid)),
+            'reader_create')
+        rseq._native_reader_id = rid.value
+        if rseq.guarantee:
+            # clamp-forward-only: bft_reader_create seeded the guarantee
+            # at the current tail; never move it backward below the tail
+            # (would deadlock the writer against unreadable space)
+            native.check(self._lib.bft_reader_set_guarantee(
+                self._handle, rid.value, rseq._seq.begin, 1))
+
+    def _reader_moved(self, rseq, new_seq):
+        if rseq.guarantee:
+            native.check(self._lib.bft_reader_set_guarantee(
+                self._handle, rseq._native_reader_id, new_seq.begin, 1))
+
+    def _open_seq(self, which, name=None, time_tag=None):
+        out = ctypes.c_void_p()
+        rc = self._lib.bft_ring_open_sequence(
+            self._handle, _WHICH[which], (name or '').encode(),
+            int(time_tag or 0), ctypes.byref(out))
+        if rc == native.BFT_END_OF_DATA:
+            raise EndOfDataStop("No sequence available")
+        native.check(rc, 'open_sequence')
+        return self._wrap_seq(out.value)
+
+    def _next_seq(self, seq):
+        out = ctypes.c_void_p()
+        rc = self._lib.bft_seq_next(self._handle, seq._handle,
+                                    ctypes.byref(out))
+        if rc == native.BFT_END_OF_DATA:
+            raise EndOfDataStop("No next sequence")
+        native.check(rc, 'seq_next')
+        return self._wrap_seq(out.value)
+
+    def _acquire_span(self, rseq, offset, nbyte, frame_nbyte):
+        begin = ctypes.c_longlong()
+        got = ctypes.c_longlong()
+        rc = self._lib.bft_reader_acquire(
+            self._handle, rseq._native_reader_id, rseq._seq._handle,
+            offset, nbyte, frame_nbyte, ctypes.byref(begin),
+            ctypes.byref(got))
+        if rc == native.BFT_END_OF_DATA:
+            raise EndOfDataStop("Sequence consumed")
+        native.check(rc, 'acquire')
+        return begin.value, got.value
+
+    def _release_span(self, rseq, span_begin):
+        native.check(self._lib.bft_reader_release(
+            self._handle, rseq._native_reader_id, span_begin), 'release')
+
+    def _close_read_seq(self, rseq):
+        rid = getattr(rseq, '_native_reader_id', None)
+        if rid is not None:
+            native.check(self._lib.bft_reader_destroy(self._handle, rid))
+            rseq._native_reader_id = None
+
+    def _overwritten_in(self, begin, nbyte):
+        out = ctypes.c_longlong()
+        native.check(self._lib.bft_ring_overwritten_in(
+            self._handle, begin, nbyte, ctypes.byref(out)))
+        return out.value

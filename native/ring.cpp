@@ -1,0 +1,590 @@
+// Native ring buffer runtime for bifrost_tpu.
+//
+// Re-implements the semantics of the reference ring
+// (reference: src/ring_impl.{hpp,cpp} — ghost region, guarantees,
+// tail-pull overwrite, in-order commit barrier, blocking acquire with
+// partial final span, live resize preserving buffered data) as a small
+// C++17 library with a pure-C ABI consumed from Python via ctypes
+// (replacing the reference's ctypesgen-generated bindings,
+// python/Makefile.in:23-30).
+//
+// Concurrency model matches the reference: one mutex per ring plus
+// condition variables for readers (data committed), writers (space
+// freed), sequences (new sequence / sequence ended), and span-close
+// (resize waits for quiescence).
+//
+// Memory spaces: this core manages HOST memory (posix_memalign, 512-byte
+// aligned like BF_ALIGNMENT, reference: src/memory.cpp:334-351).  Device
+// ('tpu') rings keep their payloads as jax Arrays on the Python side;
+// only host rings route here.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <limits>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define BFT_OK 0
+#define BFT_END_OF_DATA 1
+#define BFT_WOULD_BLOCK 2
+#define BFT_ERR_INVALID (-1)
+#define BFT_ERR_STATE (-2)
+#define BFT_ERR_ALLOC (-3)
+
+namespace {
+
+constexpr int64_t ALIGNMENT = 512;
+constexpr int64_t NO_END = std::numeric_limits<int64_t>::max();
+
+struct Sequence {
+    std::string name;
+    long long time_tag = -1;
+    std::string header;
+    int64_t begin = 0;
+    int64_t end = NO_END;     // NO_END while open
+    int64_t nringlet = 1;
+    Sequence* next = nullptr;
+
+    bool finished() const { return end != NO_END; }
+};
+
+struct WSpan {
+    int64_t id = 0;
+    int64_t begin = 0;
+    int64_t nbyte = 0;
+    int64_t commit_nbyte = -1;   // -1 = still open
+};
+
+struct Reader {
+    int64_t id = 0;
+    bool guarantee = true;
+    int64_t guarantee_offset = 0;   // only meaningful if guarantee
+};
+
+struct Ring {
+    std::mutex mtx;
+    std::condition_variable read_cv;     // data committed / seq ended
+    std::condition_variable write_cv;    // space freed
+    std::condition_variable seq_cv;      // sequence list changed
+    std::condition_variable span_cv;     // span closed (resize gate)
+
+    std::string name;
+
+    uint8_t* buf = nullptr;
+    int64_t size = 0;        // per-lane capacity
+    int64_t ghost = 0;       // per-lane ghost span
+    int64_t nringlet = 1;
+
+    int64_t tail = 0;
+    int64_t head = 0;
+    int64_t reserve_head = 0;
+
+    // Sequences are kept for the lifetime of the ring (registry) but the
+    // *live* window is [live_begin, end of deque).
+    std::deque<std::unique_ptr<Sequence>> sequences;
+    size_t live_begin = 0;
+
+    std::deque<WSpan> open_wspans;       // reserve order
+    int64_t next_wspan_id = 1;
+
+    std::map<int64_t, std::unique_ptr<Reader>> readers;
+    int64_t next_reader_id = 1;
+
+    int nwrite_open = 0;
+    int nread_open = 0;
+    bool writing = false;
+    bool eod = false;
+    std::atomic<long long> total_written{0};
+
+    int64_t lane_nbyte() const { return size + ghost; }
+
+    int64_t min_guarantee_locked() const {
+        int64_t g = NO_END;
+        for (auto& kv : readers) {
+            if (kv.second->guarantee && kv.second->guarantee_offset < g)
+                g = kv.second->guarantee_offset;
+        }
+        return g;
+    }
+
+    void gc_sequences_locked() {
+        // drop fully-consumed finished sequences from the live window;
+        // the Sequence objects themselves stay valid (Python may hold
+        // pointers) but their header payloads are released
+        while (sequences.size() - live_begin > 1) {
+            Sequence* s = sequences[live_begin].get();
+            if (s->finished() && s->end <= tail && s->next != nullptr) {
+                std::string().swap(s->header);
+                ++live_begin;
+            } else {
+                break;
+            }
+        }
+    }
+
+    int realloc_locked(int64_t new_size, int64_t new_ghost,
+                       int64_t new_nringlet) {
+        uint8_t* nb = nullptr;
+        size_t total = (size_t)new_nringlet * (new_size + new_ghost);
+        if (posix_memalign(reinterpret_cast<void**>(&nb), ALIGNMENT,
+                           total ? total : ALIGNMENT) != 0)
+            return BFT_ERR_ALLOC;
+        std::memset(nb, 0, total);
+        if (buf && head > tail) {
+            // preserve [tail, head) across the re-layout, per lane
+            int64_t t = tail, h = head;
+            if (h - t > new_size) t = h - new_size;
+            for (int64_t o = t; o < h;) {
+                int64_t run = h - o;
+                run = std::min(run, size - (o % size));
+                run = std::min(run, new_size - (o % new_size));
+                for (int64_t lane = 0;
+                     lane < std::min(nringlet, new_nringlet); ++lane) {
+                    std::memcpy(nb + lane * (new_size + new_ghost)
+                                   + (o % new_size),
+                                buf + lane * lane_nbyte() + (o % size),
+                                (size_t)run);
+                }
+                o += run;
+            }
+        }
+        std::free(buf);
+        buf = nb;
+        size = new_size;
+        ghost = new_ghost;
+        nringlet = new_nringlet;
+        return BFT_OK;
+    }
+
+    void ghost_write_locked(int64_t begin, int64_t nbyte) {
+        // mirror overflow past the nominal end back to the start
+        int64_t bo = begin % size;
+        int64_t over = bo + nbyte - size;
+        if (over > 0) {
+            for (int64_t lane = 0; lane < nringlet; ++lane) {
+                uint8_t* base = buf + lane * lane_nbyte();
+                std::memcpy(base, base + size, (size_t)over);
+            }
+        }
+    }
+
+    void ghost_read_locked(int64_t begin, int64_t nbyte) {
+        // refresh the ghost from the start before a wrapped read
+        int64_t bo = begin % size;
+        int64_t over = bo + nbyte - size;
+        if (over > 0) {
+            for (int64_t lane = 0; lane < nringlet; ++lane) {
+                uint8_t* base = buf + lane * lane_nbyte();
+                std::memcpy(base + size, base, (size_t)over);
+            }
+        }
+    }
+
+    ~Ring() { std::free(buf); }
+};
+
+}  // namespace
+
+extern "C" {
+
+int bft_ring_create(void** out, const char* name) {
+    if (!out) return BFT_ERR_INVALID;
+    Ring* r = new (std::nothrow) Ring();
+    if (!r) return BFT_ERR_ALLOC;
+    r->name = name ? name : "";
+    *out = r;
+    return BFT_OK;
+}
+
+int bft_ring_destroy(void* ring) {
+    delete static_cast<Ring*>(ring);
+    return BFT_OK;
+}
+
+int bft_ring_resize(void* ring_, long long contig, long long total,
+                    long long nringlet) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::unique_lock<std::mutex> lk(r->mtx);
+    if (total < 0) total = contig * 4;
+    int64_t ghost = std::max<int64_t>(r->ghost, contig);
+    int64_t size = std::max<int64_t>(r->size, total);
+    int64_t nrl = std::max<int64_t>(r->nringlet, nringlet);
+    if (size == r->size && ghost == r->ghost && nrl == r->nringlet)
+        return BFT_OK;
+    // wait for quiescence (reference: RingReallocLock)
+    r->span_cv.wait(lk, [&] {
+        return r->nwrite_open == 0 && r->nread_open == 0;
+    });
+    int rc = r->realloc_locked(size, ghost, nrl);
+    if (rc != BFT_OK) return rc;
+    r->write_cv.notify_all();
+    r->read_cv.notify_all();
+    return BFT_OK;
+}
+
+int bft_ring_geometry(void* ring_, unsigned char** buf, long long* size,
+                      long long* ghost, long long* nringlet) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    if (buf) *buf = r->buf;
+    if (size) *size = r->size;
+    if (ghost) *ghost = r->ghost;
+    if (nringlet) *nringlet = r->nringlet;
+    return BFT_OK;
+}
+
+int bft_ring_begin_writing(void* ring_) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    r->writing = true;
+    r->eod = false;
+    return BFT_OK;
+}
+
+int bft_ring_end_writing(void* ring_) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    r->writing = false;
+    r->eod = true;
+    r->read_cv.notify_all();
+    r->seq_cv.notify_all();
+    return BFT_OK;
+}
+
+int bft_ring_begin_sequence(void* ring_, const char* name,
+                            long long time_tag, const char* header,
+                            long long header_len, long long nringlet,
+                            void** seq_out) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r || !seq_out) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    if (!r->sequences.empty()) {
+        Sequence* prev = r->sequences.back().get();
+        if (!prev->finished()) return BFT_ERR_STATE;
+    }
+    auto seq = std::make_unique<Sequence>();
+    seq->name = name ? name : "";
+    seq->time_tag = time_tag;
+    seq->header.assign(header ? header : "", (size_t)header_len);
+    seq->begin = r->head;
+    seq->nringlet = nringlet;
+    Sequence* sp = seq.get();
+    if (!r->sequences.empty())
+        r->sequences.back()->next = sp;
+    r->sequences.push_back(std::move(seq));
+    r->seq_cv.notify_all();
+    *seq_out = sp;
+    return BFT_OK;
+}
+
+int bft_ring_end_sequence(void* ring_, void* seq_) {
+    Ring* r = static_cast<Ring*>(ring_);
+    Sequence* s = static_cast<Sequence*>(seq_);
+    if (!r || !s) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    s->end = r->head;
+    r->read_cv.notify_all();
+    r->seq_cv.notify_all();
+    return BFT_OK;
+}
+
+int bft_seq_info(void* seq_, const char** name, long long* time_tag,
+                 const char** header, long long* header_len,
+                 long long* begin, long long* nringlet) {
+    Sequence* s = static_cast<Sequence*>(seq_);
+    if (!s) return BFT_ERR_INVALID;
+    if (name) *name = s->name.c_str();
+    if (time_tag) *time_tag = s->time_tag;
+    if (header) *header = s->header.data();
+    if (header_len) *header_len = (long long)s->header.size();
+    if (begin) *begin = s->begin;
+    if (nringlet) *nringlet = s->nringlet;
+    return BFT_OK;
+}
+
+int bft_seq_end_offset(void* seq_, long long* end) {
+    Sequence* s = static_cast<Sequence*>(seq_);
+    if (!s || !end) return BFT_ERR_INVALID;
+    *end = s->finished() ? s->end : -1;
+    return BFT_OK;
+}
+
+// ---- writer spans ---------------------------------------------------------
+
+int bft_ring_reserve(void* ring_, long long nbyte, int nonblocking,
+                     long long* begin_out, long long* span_id_out) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r || !begin_out || !span_id_out || nbyte < 0)
+        return BFT_ERR_INVALID;
+    std::unique_lock<std::mutex> lk(r->mtx);
+    if (nbyte > r->ghost) {
+        // guaranteed-contiguous window too small; grow it
+        r->span_cv.wait(lk, [&] {
+            return r->nwrite_open == 0 && r->nread_open == 0;
+        });
+        int rc = r->realloc_locked(
+            std::max<int64_t>(r->size, nbyte * 4),
+            std::max<int64_t>(r->ghost, nbyte), r->nringlet);
+        if (rc != BFT_OK) return rc;
+    }
+    int64_t begin = r->reserve_head;
+    int64_t new_reserve = begin + nbyte;
+    for (;;) {
+        int64_t new_tail = new_reserve - r->size;
+        int64_t limit = std::min<int64_t>(r->head,
+                                          r->min_guarantee_locked());
+        if (new_tail <= limit) break;
+        if (nonblocking) return BFT_WOULD_BLOCK;
+        r->write_cv.wait(lk);
+    }
+    r->reserve_head = new_reserve;
+    int64_t new_tail = new_reserve - r->size;
+    if (new_tail > r->tail) {
+        r->tail = new_tail;     // overwrite: pull the tail forward
+        r->gc_sequences_locked();
+    }
+    WSpan ws;
+    ws.id = r->next_wspan_id++;
+    ws.begin = begin;
+    ws.nbyte = nbyte;
+    r->open_wspans.push_back(ws);
+    r->nwrite_open += 1;
+    *begin_out = begin;
+    *span_id_out = ws.id;
+    return BFT_OK;
+}
+
+int bft_ring_commit(void* ring_, long long span_id, long long commit_nbyte) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    bool found = false;
+    for (auto& ws : r->open_wspans) {
+        if (ws.id == span_id) {
+            if (ws.commit_nbyte >= 0) return BFT_ERR_STATE;
+            if (commit_nbyte > ws.nbyte) return BFT_ERR_INVALID;
+            ws.commit_nbyte = commit_nbyte;
+            found = true;
+            break;
+        }
+    }
+    if (!found) return BFT_ERR_INVALID;
+    // in-order commit barrier (reference: ring_impl.cpp:591-594)
+    while (!r->open_wspans.empty() &&
+           r->open_wspans.front().commit_nbyte >= 0) {
+        WSpan ws = r->open_wspans.front();
+        r->open_wspans.pop_front();
+        if (ws.commit_nbyte > 0)
+            r->ghost_write_locked(ws.begin, ws.commit_nbyte);
+        if (ws.commit_nbyte < ws.nbyte) {
+            if (!r->open_wspans.empty()) return BFT_ERR_STATE;
+            r->reserve_head = ws.begin + ws.commit_nbyte;
+        }
+        r->head = ws.begin + ws.commit_nbyte;
+        r->total_written += ws.commit_nbyte;
+        r->nwrite_open -= 1;
+    }
+    r->read_cv.notify_all();
+    r->span_cv.notify_all();
+    return BFT_OK;
+}
+
+// ---- readers --------------------------------------------------------------
+
+int bft_reader_create(void* ring_, int guarantee, long long* reader_id) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r || !reader_id) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    auto rd = std::make_unique<Reader>();
+    rd->id = r->next_reader_id++;
+    rd->guarantee = guarantee != 0;
+    rd->guarantee_offset = r->tail;
+    *reader_id = rd->id;
+    r->readers[rd->id] = std::move(rd);
+    return BFT_OK;
+}
+
+int bft_reader_destroy(void* ring_, long long reader_id) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    r->readers.erase(reader_id);
+    r->write_cv.notify_all();
+    return BFT_OK;
+}
+
+int bft_reader_set_guarantee(void* ring_, long long reader_id,
+                             long long offset, int clamp_forward_only) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    auto it = r->readers.find(reader_id);
+    if (it == r->readers.end()) return BFT_ERR_INVALID;
+    Reader* rd = it->second.get();
+    if (clamp_forward_only && offset < rd->guarantee_offset)
+        return BFT_OK;
+    rd->guarantee_offset = std::max<int64_t>(offset, 0);
+    r->write_cv.notify_all();
+    return BFT_OK;
+}
+
+// which: 0=specific(name), 1=at(time_tag), 2=latest, 3=earliest
+int bft_ring_open_sequence(void* ring_, int which, const char* name,
+                           long long time_tag, void** seq_out) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r || !seq_out) return BFT_ERR_INVALID;
+    std::unique_lock<std::mutex> lk(r->mtx);
+    for (;;) {
+        for (size_t i = r->live_begin; i < r->sequences.size(); ++i) {
+            Sequence* s = r->sequences[i].get();
+            switch (which) {
+                case 0:
+                    if (s->name == (name ? name : "")) {
+                        *seq_out = s;
+                        return BFT_OK;
+                    }
+                    break;
+                case 1:
+                    if (s->time_tag == time_tag) {
+                        *seq_out = s;
+                        return BFT_OK;
+                    }
+                    break;
+                case 3:
+                    if (!s->finished() || s->end > r->tail) {
+                        *seq_out = s;
+                        return BFT_OK;
+                    }
+                    break;
+                default:
+                    break;
+            }
+        }
+        if (which == 2 && r->live_begin < r->sequences.size()) {
+            *seq_out = r->sequences.back().get();
+            return BFT_OK;
+        }
+        if (which == 3 && r->live_begin < r->sequences.size()) {
+            *seq_out = r->sequences.back().get();
+            return BFT_OK;
+        }
+        if (r->eod) return BFT_END_OF_DATA;
+        r->seq_cv.wait(lk);
+    }
+}
+
+int bft_seq_next(void* ring_, void* seq_, void** next_out) {
+    Ring* r = static_cast<Ring*>(ring_);
+    Sequence* s = static_cast<Sequence*>(seq_);
+    if (!r || !s || !next_out) return BFT_ERR_INVALID;
+    std::unique_lock<std::mutex> lk(r->mtx);
+    for (;;) {
+        if (s->next) {
+            *next_out = s->next;
+            return BFT_OK;
+        }
+        if (r->eod && s->finished()) return BFT_END_OF_DATA;
+        r->seq_cv.wait(lk);
+    }
+}
+
+int bft_reader_acquire(void* ring_, long long reader_id, void* seq_,
+                       long long offset, long long nbyte,
+                       long long frame_nbyte, long long* begin_out,
+                       long long* nbyte_out) {
+    Ring* r = static_cast<Ring*>(ring_);
+    Sequence* s = static_cast<Sequence*>(seq_);
+    if (!r || !s || !begin_out || !nbyte_out || frame_nbyte <= 0)
+        return BFT_ERR_INVALID;
+    std::unique_lock<std::mutex> lk(r->mtx);
+    int64_t want_begin = s->begin + offset;
+    auto it = r->readers.find(reader_id);
+    Reader* rd = (it == r->readers.end()) ? nullptr : it->second.get();
+    if (rd && rd->guarantee) {
+        int64_t g = std::min<int64_t>(want_begin, r->head);
+        if (g > rd->guarantee_offset) rd->guarantee_offset = g;
+    }
+    int64_t end;
+    for (;;) {
+        int64_t seq_end = s->finished() ? s->end : NO_END;
+        if (seq_end != NO_END && want_begin >= seq_end)
+            return BFT_END_OF_DATA;
+        int64_t limit = (seq_end != NO_END) ? seq_end
+                        : (r->eod ? r->head : NO_END);
+        if (r->eod && limit != NO_END && want_begin >= limit)
+            return BFT_END_OF_DATA;
+        if (want_begin + nbyte <= r->head) {
+            end = want_begin + nbyte;
+            break;
+        }
+        if (limit != NO_END && limit <= r->head) {
+            end = std::min<int64_t>(limit, want_begin + nbyte);
+            break;
+        }
+        r->read_cv.wait(lk);
+    }
+    int64_t begin = want_begin;
+    if (begin < r->tail) {
+        int64_t skip = r->tail - begin;
+        skip = ((skip + frame_nbyte - 1) / frame_nbyte) * frame_nbyte;
+        begin = std::min<int64_t>(begin + skip, end);
+    }
+    if (rd && rd->guarantee) rd->guarantee_offset = begin;
+    int64_t got = std::max<int64_t>(end - begin, 0);
+    if (got > 0) r->ghost_read_locked(begin, got);
+    r->nread_open += 1;
+    *begin_out = begin;
+    *nbyte_out = got;
+    return BFT_OK;
+}
+
+int bft_reader_release(void* ring_, long long reader_id,
+                       long long span_begin) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    auto it = r->readers.find(reader_id);
+    if (it != r->readers.end()) {
+        Reader* rd = it->second.get();
+        if (rd->guarantee && span_begin > rd->guarantee_offset)
+            rd->guarantee_offset = span_begin;
+    }
+    r->nread_open -= 1;
+    r->write_cv.notify_all();
+    r->span_cv.notify_all();
+    return BFT_OK;
+}
+
+int bft_ring_overwritten_in(void* ring_, long long begin, long long nbyte,
+                            long long* out) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r || !out) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    int64_t ov = std::min<int64_t>(r->tail - begin, nbyte);
+    *out = std::max<int64_t>(ov, 0);
+    return BFT_OK;
+}
+
+int bft_ring_tail_head(void* ring_, long long* tail, long long* head) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    if (tail) *tail = r->tail;
+    if (head) *head = r->head;
+    return BFT_OK;
+}
+
+int bft_version(void) { return 1; }
+
+}  // extern "C"

@@ -220,3 +220,41 @@ def test_resize_while_data_buffered():
         for span in seq.read(4):
             vals.append(float(span.data.as_numpy().ravel()[0]))
     assert vals == [7.0, 9.0]
+
+
+def test_stress_concurrent_churn():
+    """Many small gulps through a small ring with a guaranteed reader:
+    exercises wrap-around, ghost copies, and flow control under real
+    thread contention (native or Python core, whichever is active)."""
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(16,))
+    NGULP, GULP = 200, 8
+    import hashlib
+    write_hash = hashlib.sha256()
+    read_hash = hashlib.sha256()
+
+    def writer():
+        rng = np.random.RandomState(42)
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=GULP,
+                                   buf_nframe=GULP * 3) as seq:
+                for k in range(NGULP):
+                    with seq.reserve(GULP) as span:
+                        data = rng.randint(
+                            0, 255, size=(GULP, 16)).astype(np.float32)
+                        span.data.as_numpy()[...] = data
+                        write_hash.update(data.tobytes())
+                        span.commit(GULP)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    nframes = 0
+    for seq in ring.read(guarantee=True):
+        seq.resize(gulp_nframe=GULP)
+        for span in seq.read(GULP):
+            read_hash.update(
+                np.ascontiguousarray(span.data.as_numpy()).tobytes())
+            nframes += span.nframe
+    t.join()
+    assert nframes == NGULP * GULP
+    assert write_hash.hexdigest() == read_hash.hexdigest()
