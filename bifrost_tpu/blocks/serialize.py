@@ -78,6 +78,7 @@ class _DeserializeReader(object):
             self.header = json.load(f)
         tensor = self.header['_tensor']
         ringlet_shape, frame_shape = split_shape(tensor['shape'])
+        self.ringlet_shape = ringlet_shape
         self.nringlet = int(np.prod(ringlet_shape)) if ringlet_shape else 1
         dtype = DataType(tensor['dtype'])
         nelem = int(np.prod(frame_shape)) if frame_shape else 1
@@ -126,16 +127,17 @@ class DeserializeBlock(SourceBlock):
         if nframe == 0:
             return [0]
         buf = ospan.data.as_numpy()
-        flat = buf.view(np.uint8)
         if reader.nringlet == 1:
-            tgt = flat.reshape(-1)
             raw = np.frombuffer(chunks[0], np.uint8)
-            tgt[:len(raw)] = raw
+            buf.view(np.uint8).reshape(-1)[:len(raw)] = raw
         else:
-            lanes = flat.reshape(reader.nringlet, -1)
-            per = nframe * reader.frame_nbyte
-            for r, c in enumerate(chunks):
-                lanes[r, :per] = np.frombuffer(c, np.uint8)
+            # one .dat file per ringlet lane; lanes are individually
+            # contiguous even though the span view is strided
+            nring_dims = len(reader.ringlet_shape)
+            for r, idx in enumerate(np.ndindex(*buf.shape[:nring_dims])):
+                raw = np.frombuffer(chunks[r], np.uint8)
+                sub = buf[idx]
+                sub.view(np.uint8).reshape(-1)[:len(raw)] = raw
         return [nframe]
 
 

@@ -6,3 +6,8 @@ blocks/binary_io.py, blocks/serialize.py.
 
 from . import sigproc
 from . import guppi
+from . import packet_formats
+from . import udp_socket
+from . import packet_capture
+from . import packet_writer
+from . import bridge

@@ -1,0 +1,205 @@
+"""Wire formats for packet capture/transmit.
+
+The reference implements per-telescope formats as C++ decoder/processor
+pairs (reference: src/formats/*.hpp — chips, tbn, drx, pbeam, ibeam,
+vdif, ...; base classes formats/base.hpp:91-155).  Here each format is a
+small codec object with
+
+- ``header_size`` / ``pack(desc) -> bytes`` / ``unpack(buf) -> desc``
+- ``frame_layout(desc)``: how one time-step (all sources) lays out in
+  the ring, used by the capture engine's scatter
+
+'simple' matches the reference wire format exactly (u64 big-endian
+sequence number + raw payload, reference: src/formats/simple.hpp:33-35).
+'chips', 'tbn', 'drx' and 'pbeam' carry the same header fields as their
+reference namesakes (seq/timestamp, source id, channel info) in a
+documented big-endian layout.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+__all__ = ['PacketDesc', 'get_format', 'register_format', 'FORMATS']
+
+
+class PacketDesc(object):
+    """Decoded packet metadata (reference: formats/base.hpp PacketDesc)."""
+
+    __slots__ = ('seq', 'src', 'nsrc', 'chan0', 'nchan', 'time_tag',
+                 'tuning', 'gain', 'decimation', 'payload', 'payload_size')
+
+    def __init__(self, seq=0, src=0, nsrc=1, chan0=0, nchan=1, time_tag=0,
+                 tuning=0, gain=0, decimation=1, payload=b''):
+        self.seq = seq
+        self.src = src
+        self.nsrc = nsrc
+        self.chan0 = chan0
+        self.nchan = nchan
+        self.time_tag = time_tag
+        self.tuning = tuning
+        self.gain = gain
+        self.decimation = decimation
+        self.payload = payload
+        self.payload_size = len(payload)
+
+
+class _FormatBase(object):
+    name = None
+    header_struct = None
+
+    @property
+    def header_size(self):
+        return self.header_struct.size
+
+    def pack(self, desc):
+        raise NotImplementedError
+
+    def unpack(self, buf):
+        raise NotImplementedError
+
+
+class SimpleFormat(_FormatBase):
+    """u64be seq + payload (reference: src/formats/simple.hpp:33-62)."""
+
+    name = 'simple'
+    header_struct = struct.Struct('>Q')
+
+    def pack(self, desc):
+        return self.header_struct.pack(desc.seq) + bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        (seq,) = self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=seq, src=0, nsrc=1, nchan=1,
+                          payload=buf[self.header_size:])
+
+
+class ChipsFormat(_FormatBase):
+    """F-engine channelized voltages: one packet per (seq, roach).
+    Header: u64be seq, u8 src, u8 nsrc, u16be nchan, u16be chan0, u16be
+    pad (fields of reference src/formats/chips.hpp's chips_hdr_type)."""
+
+    name = 'chips'
+    header_struct = struct.Struct('>QBBHHH')
+
+    def pack(self, desc):
+        return self.header_struct.pack(desc.seq, desc.src, desc.nsrc,
+                                       desc.nchan, desc.chan0, 0) + \
+            bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        seq, src, nsrc, nchan, chan0, _ = \
+            self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=seq, src=src, nsrc=nsrc, nchan=nchan,
+                          chan0=chan0, payload=buf[self.header_size:])
+
+
+class PBeamFormat(_FormatBase):
+    """Power-beam spectra. Header: u64be timestamp (=seq), u8 beam (src),
+    u8 nbeam, u16be nchan, u16be chan0, u16be navg (fields of reference
+    src/formats/pbeam.hpp)."""
+
+    name = 'pbeam'
+    header_struct = struct.Struct('>QBBHHH')
+
+    def pack(self, desc):
+        return self.header_struct.pack(desc.seq, desc.src, desc.nsrc,
+                                       desc.nchan, desc.chan0,
+                                       desc.decimation) + \
+            bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        seq, src, nsrc, nchan, chan0, navg = \
+            self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=seq, src=src, nsrc=nsrc, nchan=nchan,
+                          chan0=chan0, decimation=navg,
+                          payload=buf[self.header_size:])
+
+
+class TbnFormat(_FormatBase):
+    """LWA TBN-style raw voltages: u64be time_tag, u32be tuning, u16be
+    id (src+flags), u16be gain (fields of reference
+    src/formats/tbn.hpp:35-41).  seq = time_tag // (512 * decimation)."""
+
+    name = 'tbn'
+    header_struct = struct.Struct('>QIHH')
+    seq_quantum = 512   # samples per packet timestamp step
+
+    def __init__(self, decimation=1):
+        self.decimation = decimation
+
+    def pack(self, desc):
+        time_tag = desc.seq * self.seq_quantum * self.decimation
+        return self.header_struct.pack(time_tag, desc.tuning,
+                                       (desc.src + 1) & 0x3FFF,
+                                       desc.gain) + bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        time_tag, tuning, tbn_id, gain = \
+            self.header_struct.unpack_from(buf)
+        return PacketDesc(
+            seq=time_tag // (self.seq_quantum * self.decimation),
+            src=(tbn_id & 1023) - 1, time_tag=time_tag, tuning=tuning,
+            gain=gain, nchan=1, payload=buf[self.header_size:])
+
+
+class DrxFormat(_FormatBase):
+    """LWA DRX-style beam voltages: u64be time_tag, u32be tuning, u16be
+    id (beam/tuning/pol), u16be decimation (fields of reference
+    src/formats/drx.hpp)."""
+
+    name = 'drx'
+    header_struct = struct.Struct('>QIHH')
+    seq_quantum = 4096
+
+    def pack(self, desc):
+        time_tag = desc.seq * self.seq_quantum
+        return self.header_struct.pack(time_tag, desc.tuning,
+                                       desc.src & 0xFFFF,
+                                       desc.decimation) + \
+            bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        time_tag, tuning, drx_id, decim = \
+            self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=time_tag // self.seq_quantum,
+                          src=drx_id & 0x7, time_tag=time_tag,
+                          tuning=tuning, decimation=decim, nchan=1,
+                          payload=buf[self.header_size:])
+
+
+FORMATS = {}
+
+
+def register_format(cls_or_obj):
+    obj = cls_or_obj() if isinstance(cls_or_obj, type) else cls_or_obj
+    FORMATS[obj.name] = obj
+    return cls_or_obj
+
+
+for _f in (SimpleFormat, ChipsFormat, PBeamFormat, TbnFormat, DrxFormat):
+    register_format(_f)
+
+
+def get_format(fmt):
+    """Look up a format; accepts 'chips', 'chips_64' (with a parameter
+    suffix, ignored here), or a format object."""
+    if not isinstance(fmt, str):
+        return fmt
+    base = fmt.split('_')[0]
+    if base not in FORMATS:
+        raise KeyError("Unknown packet format: %r (known: %s)"
+                       % (fmt, sorted(FORMATS)))
+    return FORMATS[base]

@@ -1,0 +1,132 @@
+"""Packet transmit: ring/array data -> UDP or disk packets.
+
+Mirrors the reference writer stack (reference: src/packet_writer.hpp
+HeaderInfo + per-format fillers + disk/UDP senders + token-bucket
+RateLimiter at packet_writer.hpp:59; python API
+python/bifrost/packet_writer.py:42-105).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .packet_formats import get_format, PacketDesc
+
+__all__ = ['HeaderInfo', 'UDPTransmit', 'DiskWriter', 'RateLimiter']
+
+
+class HeaderInfo(object):
+    """Mutable header template (reference: bfHeaderInfo*)."""
+
+    def __init__(self):
+        self.nsrc = 1
+        self.nchan = 1
+        self.chan0 = 0
+        self.tuning = 0
+        self.gain = 0
+        self.decimation = 1
+
+    def set_nsrc(self, v):
+        self.nsrc = v
+
+    def set_nchan(self, v):
+        self.nchan = v
+
+    def set_chan0(self, v):
+        self.chan0 = v
+
+    def set_tuning(self, v):
+        self.tuning = v
+
+    def set_gain(self, v):
+        self.gain = v
+
+    def set_decimation(self, v):
+        self.decimation = v
+
+
+class RateLimiter(object):
+    """Token-bucket packets-per-second limiter (reference:
+    packet_writer.hpp:59)."""
+
+    def __init__(self, rate_pps=0):
+        self.rate = rate_pps
+        self._next_time = None
+
+    def wait(self, npackets=1):
+        if not self.rate:
+            return
+        now = time.monotonic()
+        if self._next_time is None:
+            self._next_time = now
+        self._next_time += npackets / float(self.rate)
+        delay = self._next_time - now
+        if delay > 0:
+            time.sleep(delay)
+
+
+class _WriterBase(object):
+    def __init__(self, fmt, core=None):
+        self.fmt = get_format(fmt)
+        self.core = core
+        self.limiter = RateLimiter(0)
+        self.npackets_sent = 0
+
+    def set_rate_limit(self, rate_pps):
+        self.limiter = RateLimiter(rate_pps)
+
+    def reset_counter(self):
+        self.npackets_sent = 0
+
+    def _send_bytes(self, data):
+        raise NotImplementedError
+
+    def send(self, headerinfo, seq, seq_increment, src, src_increment,
+             idata):
+        """Send idata as packets: shape (nseq, nsrc, payload...) — packet
+        (i, j) carries seq + i*seq_increment, src + j*src_increment
+        (reference: bfPacketWriterSend)."""
+        arr = np.ascontiguousarray(np.asarray(idata))
+        if arr.ndim < 2:
+            arr = arr.reshape(1, 1, -1)
+        nseq, nsrc = arr.shape[0], arr.shape[1]
+        payloads = arr.reshape(nseq, nsrc, -1)
+        for i in range(nseq):
+            for j in range(nsrc):
+                desc = PacketDesc(
+                    seq=seq + i * seq_increment,
+                    src=src + j * src_increment,
+                    nsrc=headerinfo.nsrc, chan0=headerinfo.chan0,
+                    nchan=headerinfo.nchan, tuning=headerinfo.tuning,
+                    gain=headerinfo.gain,
+                    decimation=headerinfo.decimation,
+                    payload=payloads[i, j].tobytes())
+                self.limiter.wait()
+                self._send_bytes(self.fmt.pack(desc))
+                self.npackets_sent += 1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class UDPTransmit(_WriterBase):
+    def __init__(self, fmt, sock, core=None):
+        super(UDPTransmit, self).__init__(fmt, core)
+        self.sock = sock
+
+    def _send_bytes(self, data):
+        self.sock.send(data)
+
+
+class DiskWriter(_WriterBase):
+    def __init__(self, fmt, fh, core=None):
+        super(DiskWriter, self).__init__(fmt, core)
+        self.fh = fh
+
+    def _send_bytes(self, data):
+        self.fh.write(data)

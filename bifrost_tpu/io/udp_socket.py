@@ -1,0 +1,78 @@
+"""UDP socket helpers (reference: src/Socket.cpp, src/udp_socket.cpp,
+python/bifrost/udp_socket.py, address.py)."""
+
+from __future__ import annotations
+
+import socket
+
+__all__ = ['Address', 'UDPSocket']
+
+
+class Address(object):
+    """Resolved socket address (reference: python/bifrost/address.py)."""
+
+    def __init__(self, address, port, family=socket.AF_INET):
+        self.address = address
+        self.port = port
+        self.family = family
+        infos = socket.getaddrinfo(address, port, family,
+                                   socket.SOCK_DGRAM)
+        self._sockaddr = infos[0][4]
+
+    @property
+    def sockaddr(self):
+        return self._sockaddr
+
+    @property
+    def mtu(self):
+        return 9000 if self.address.startswith('127.') else 1500
+
+    def __str__(self):
+        return '%s:%d' % self._sockaddr[:2]
+
+
+class UDPSocket(object):
+    """Thin RAII UDP socket (reference: python/bifrost/udp_socket.py)."""
+
+    def __init__(self):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                 1 << 22)
+        except OSError:
+            pass
+        self._timeout = None
+
+    def bind(self, addr):
+        self.sock.bind(addr.sockaddr)
+        return self
+
+    def connect(self, addr):
+        self.sock.connect(addr.sockaddr)
+        return self
+
+    def set_timeout(self, secs):
+        self._timeout = secs
+        self.sock.settimeout(secs)
+
+    def fileno(self):
+        return self.sock.fileno()
+
+    def recv_into(self, buf):
+        return self.sock.recv_into(buf)
+
+    def recv(self, nbyte=65536):
+        return self.sock.recv(nbyte)
+
+    def send(self, data):
+        return self.sock.send(data)
+
+    def close(self):
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

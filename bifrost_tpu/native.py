@@ -111,11 +111,18 @@ def load():
             return None
         path = _lib_path()
         try:
-            if not os.path.exists(path):
+            src = os.path.join(_repo_root(), 'native', 'ring.cpp')
+            stale = (not os.path.exists(path) or
+                     (os.path.exists(src) and
+                      os.path.getmtime(src) > os.path.getmtime(path)))
+            if stale:
+                if os.path.exists(path):
+                    os.unlink(path)
                 _build()
             _lib = _declare(ctypes.CDLL(path))
-        except (OSError, subprocess.CalledProcessError):
-            _lib = None
+        except (OSError, AttributeError,
+                subprocess.CalledProcessError):
+            _lib = None   # fall back to the pure-Python core
         return _lib
 
 

@@ -127,12 +127,18 @@ class NativeRing(Ring):
         except Exception:
             pass
 
+    _SEQ_CACHE_MAX = 64
+
     def _wrap_seq(self, handle_value):
         with self._cache_lock:
             seq = self._seq_cache.get(handle_value)
             if seq is None:
                 seq = _NativeSeq(self._lib, ctypes.c_void_p(handle_value))
                 self._seq_cache[handle_value] = seq
+                # bound the cache: retired sequences' parsed headers can
+                # be large; evict oldest entries (LRU-ish insertion order)
+                while len(self._seq_cache) > self._SEQ_CACHE_MAX:
+                    self._seq_cache.pop(next(iter(self._seq_cache)))
             return seq
 
     # -- geometry ---------------------------------------------------------

@@ -509,11 +509,18 @@ int bft_reader_acquire(void* ring_, long long reader_id, void* seq_,
         return BFT_ERR_INVALID;
     std::unique_lock<std::mutex> lk(r->mtx);
     int64_t want_begin = s->begin + offset;
-    auto it = r->readers.find(reader_id);
-    Reader* rd = (it == r->readers.end()) ? nullptr : it->second.get();
-    if (rd && rd->guarantee) {
-        int64_t g = std::min<int64_t>(want_begin, r->head);
-        if (g > rd->guarantee_offset) rd->guarantee_offset = g;
+    // NOTE: never cache the Reader* across a cv wait — a concurrent
+    // bft_reader_destroy can free it while the mutex is released.
+    auto find_reader = [&]() -> Reader* {
+        auto it = r->readers.find(reader_id);
+        return it == r->readers.end() ? nullptr : it->second.get();
+    };
+    {
+        Reader* rd = find_reader();
+        if (rd && rd->guarantee) {
+            int64_t g = std::min<int64_t>(want_begin, r->head);
+            if (g > rd->guarantee_offset) rd->guarantee_offset = g;
+        }
     }
     int64_t end;
     for (;;) {
@@ -540,6 +547,7 @@ int bft_reader_acquire(void* ring_, long long reader_id, void* seq_,
         skip = ((skip + frame_nbyte - 1) / frame_nbyte) * frame_nbyte;
         begin = std::min<int64_t>(begin + skip, end);
     }
+    Reader* rd = find_reader();   // re-lookup: may have been destroyed
     if (rd && rd->guarantee) rd->guarantee_offset = begin;
     int64_t got = std::max<int64_t>(end - begin, 0);
     if (got > 0) r->ghost_read_locked(begin, got);

@@ -1,0 +1,115 @@
+"""DCN ring bridge tests: ring -> TCP -> ring over loopback (reference
+analogue: the RDMA RingSender/RingReceiver, rdma.py:99-203)."""
+
+import socket
+import threading
+
+import numpy as np
+
+from bifrost_tpu.ring import Ring
+from bifrost_tpu.io.bridge import RingSender, RingReceiver, _send_msg
+from tests.util import simple_header
+
+
+def test_ring_bridge_loopback():
+    src_ring = Ring(space='system', name='bridge_src')
+    dst_ring = Ring(space='system', name='bridge_dst')
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(('127.0.0.1', 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(24, 6).astype(np.float32)
+    hdr = simple_header([-1, 6], 'f32', name='bridged', gulp_nframe=8)
+
+    def writer():
+        with src_ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=8,
+                                   buf_nframe=24) as seq:
+                for k in range(3):
+                    with seq.reserve(8) as span:
+                        span.data.as_numpy()[...] = data[k * 8:(k + 1) * 8]
+                        span.commit(8)
+
+    def sender():
+        conn = socket.create_connection(('127.0.0.1', port))
+        RingSender(src_ring, conn, gulp_nframe=8).run()
+        conn.close()
+
+    def receiver():
+        conn, _ = srv.accept()
+        RingReceiver(conn, dst_ring).run()
+        conn.close()
+
+    threads = [threading.Thread(target=f)
+               for f in (receiver, writer, sender)]
+    for t in threads:
+        t.start()
+
+    got = []
+    names = []
+    for seq in dst_ring.read(guarantee=True):
+        names.append(seq.header['name'])
+        for span in seq.read(8):
+            got.append(np.array(span.data.as_numpy(), copy=True))
+    for t in threads:
+        t.join()
+    srv.close()
+    out = np.concatenate(got, axis=0)
+    np.testing.assert_array_equal(out, data)
+    assert names == ['bridged']
+
+
+def test_ring_bridge_multi_sequence_ringlets():
+    """Bridge a 2-ringlet stream across two sequences."""
+    src_ring = Ring(space='system', name='bridge_src2')
+    dst_ring = Ring(space='system', name='bridge_dst2')
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(('127.0.0.1', 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    rng = np.random.RandomState(1)
+    datasets = [rng.randn(2, 8, 3).astype(np.float32) for _ in range(2)]
+
+    def writer():
+        with src_ring.begin_writing() as wr:
+            for s, d in enumerate(datasets):
+                hdr = simple_header([2, -1, 3], 'f32',
+                                    labels=['beam', 'time', 'chan'],
+                                    name='seq%d' % s, gulp_nframe=8)
+                hdr['time_tag'] = s
+                with wr.begin_sequence(hdr, gulp_nframe=8,
+                                       buf_nframe=24) as seq:
+                    with seq.reserve(8) as span:
+                        span.data.as_numpy()[...] = d
+                        span.commit(8)
+
+    def sender():
+        conn = socket.create_connection(('127.0.0.1', port))
+        RingSender(src_ring, conn, gulp_nframe=8).run()
+        conn.close()
+
+    def receiver():
+        conn, _ = srv.accept()
+        RingReceiver(conn, dst_ring).run()
+        conn.close()
+
+    threads = [threading.Thread(target=f)
+               for f in (receiver, writer, sender)]
+    for t in threads:
+        t.start()
+    got = {}
+    for seq in dst_ring.read(guarantee=True):
+        name = seq.header['name']
+        for span in seq.read(8):
+            got[name] = np.array(span.data.as_numpy(), copy=True)
+    for t in threads:
+        t.join()
+    srv.close()
+    for s, d in enumerate(datasets):
+        np.testing.assert_array_equal(got['seq%d' % s], d)

@@ -232,6 +232,9 @@ def test_stress_concurrent_churn():
     import hashlib
     write_hash = hashlib.sha256()
     read_hash = hashlib.sha256()
+    # The guarantee only protects data once the reader has opened the
+    # sequence; gate the writer so it can't lap the ring before that.
+    reader_attached = threading.Event()
 
     def writer():
         rng = np.random.RandomState(42)
@@ -239,6 +242,8 @@ def test_stress_concurrent_churn():
             with wr.begin_sequence(hdr, gulp_nframe=GULP,
                                    buf_nframe=GULP * 3) as seq:
                 for k in range(NGULP):
+                    if k == 1:
+                        assert reader_attached.wait(30)
                     with seq.reserve(GULP) as span:
                         data = rng.randint(
                             0, 255, size=(GULP, 16)).astype(np.float32)
@@ -250,6 +255,7 @@ def test_stress_concurrent_churn():
     t.start()
     nframes = 0
     for seq in ring.read(guarantee=True):
+        reader_attached.set()
         seq.resize(gulp_nframe=GULP)
         for span in seq.read(GULP):
             read_hash.update(
