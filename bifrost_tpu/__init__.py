@@ -45,3 +45,8 @@ from . import blocks
 from . import views
 from . import stages
 from . import parallel
+from . import io
+from . import trace
+from . import telemetry
+from .utils import EnvVars, ObjectCache
+from .header_standard import enforce_header_standard

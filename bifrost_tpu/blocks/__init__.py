@@ -27,3 +27,9 @@ from .binary_io import (BinaryFileReadBlock, BinaryFileWriteBlock,
                         binary_read, binary_write)
 from .serialize import (SerializeBlock, DeserializeBlock, serialize,
                         deserialize)
+from .wav import WavSourceBlock, WavSinkBlock, read_wav, write_wav
+from .convert_visibilities import (ConvertVisibilitiesBlock,
+                                   convert_visibilities)
+from .psrdada import (DadaFileSourceBlock, read_dada_file,
+                      read_psrdada_buffer)
+from .audio import read_audio

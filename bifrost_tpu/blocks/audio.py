@@ -1,0 +1,21 @@
+"""Live audio capture block (gated: requires PortAudio, which this
+environment does not ship; reference: python/bifrost/blocks/audio.py,
+portaudio.py)."""
+
+from __future__ import annotations
+
+import ctypes.util
+
+__all__ = ['read_audio', 'HAVE_PORTAUDIO']
+
+HAVE_PORTAUDIO = ctypes.util.find_library('portaudio') is not None
+
+
+def read_audio(*args, **kwargs):
+    """Block: capture live audio via PortAudio."""
+    if not HAVE_PORTAUDIO:
+        raise ImportError(
+            "libportaudio is not available in this environment; "
+            "use blocks.read_wav for audio files")
+    raise NotImplementedError(
+        "Live PortAudio capture is not implemented yet")

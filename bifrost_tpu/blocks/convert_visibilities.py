@@ -1,0 +1,177 @@
+"""Visibility-matrix reordering/conversion block (reference:
+python/bifrost/blocks/convert_visibilities.py:36-209).
+
+Formats:
+- 'matrix'  : ['time','freq','station_i','pol_i','station_j','pol_j'],
+              Hermitian; may be lower-triangle-filled
+- 'storage' : ['time','baseline','freq','stokes'] — packed lower
+              triangle with Stokes (I,Q,U,V) products per baseline
+
+Conversions run as jitted gathers/scatters on TPU (the reference uses
+bf.map CUDA codegen with vector types)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+
+import numpy as np
+
+from ..pipeline import TransformBlock
+
+__all__ = ['ConvertVisibilitiesBlock', 'convert_visibilities']
+
+
+def _tri_indices(nstand):
+    b_i, b_j = [], []
+    for i in range(nstand):
+        for j in range(i + 1):
+            b_i.append(i)
+            b_j.append(j)
+    return np.asarray(b_i), np.asarray(b_j)
+
+
+class ConvertVisibilitiesBlock(TransformBlock):
+    def __init__(self, iring, ofmt, *args, **kwargs):
+        super(ConvertVisibilitiesBlock, self).__init__(iring, *args,
+                                                       **kwargs)
+        self.ofmt = ofmt
+        self._fn = None
+        self._fn_key = None
+
+    def define_valid_input_spaces(self):
+        return ('tpu',)
+
+    def on_sequence(self, iseq):
+        ihdr = iseq.header
+        itensor = ihdr['_tensor']
+        labels = itensor['labels']
+        if labels[:2] == ['time', 'freq'] and 'station_i' in labels[2]:
+            self.ifmt = 'matrix'
+        elif labels[:2] == ['time', 'baseline']:
+            self.ifmt = 'storage'
+        else:
+            raise ValueError("Unrecognized visibility layout: %s" % labels)
+        ohdr = deepcopy(ihdr)
+        otensor = ohdr['_tensor']
+        if self.ifmt == 'matrix' and self.ofmt == 'matrix':
+            ohdr['matrix_fill_mode'] = 'full'
+        elif self.ifmt == 'matrix' and self.ofmt == 'storage':
+            t, f = itensor['shape'][0], itensor['shape'][1]
+            nstand = itensor['shape'][2]
+            nbl = nstand * (nstand + 1) // 2
+            otensor['shape'] = [t, nbl, f, 4]
+            otensor['labels'] = ['time', 'baseline', 'freq', 'stokes']
+            otensor['scales'] = [deepcopy(itensor['scales'][0]), None,
+                                 deepcopy(itensor['scales'][1]), None]
+            otensor['units'] = [itensor['units'][0], None,
+                                itensor['units'][1], None]
+            self.nstand = nstand
+        elif self.ifmt == 'storage' and self.ofmt == 'matrix':
+            t, nbl, f = itensor['shape'][:3]
+            nstand = int((np.sqrt(8 * nbl + 1) - 1) / 2)
+            otensor['shape'] = [t, f, nstand, 2, nstand, 2]
+            otensor['labels'] = ['time', 'freq', 'station_i', 'pol_i',
+                                 'station_j', 'pol_j']
+            otensor['scales'] = [deepcopy(itensor['scales'][0]),
+                                 deepcopy(itensor['scales'][2]),
+                                 None, None, None, None]
+            otensor['units'] = [itensor['units'][0], itensor['units'][2],
+                                None, None, None, None]
+            ohdr['matrix_fill_mode'] = 'full'
+            self.nstand = nstand
+        else:
+            raise ValueError("Unsupported conversion %s -> %s"
+                             % (self.ifmt, self.ofmt))
+        self._fn_key = None
+        return ohdr
+
+    def _build(self, shape):
+        import jax
+        import jax.numpy as jnp
+        ifmt, ofmt = self.ifmt, self.ofmt
+
+        if ifmt == 'matrix' and ofmt == 'matrix':
+            nstand = shape[2]
+            ii = jnp.arange(nstand)
+
+            def fn(x):
+                # fill the full Hermitian matrix from the lower triangle
+                sw = jnp.conj(jnp.transpose(x, (0, 1, 4, 5, 2, 3)))
+                pi = jnp.arange(x.shape[3])
+                cond = (ii[:, None, None, None] > ii[None, None, :, None]) \
+                    | ((ii[:, None, None, None] == ii[None, None, :, None])
+                       & (pi[None, :, None, None] >= pi[None, None, None, :]))
+                return jnp.where(cond[None, None], x, sw)
+            return jax.jit(fn)
+
+        b_i, b_j = _tri_indices(self.nstand)
+        bi = np.asarray(b_i)
+        bj = np.asarray(b_j)
+
+        if ifmt == 'matrix' and ofmt == 'storage':
+            def fn(x):
+                # x: (t, f, si, pi, sj, pj) lower-filled
+                full = x
+                sw = jnp.conj(jnp.transpose(x, (0, 1, 4, 5, 2, 3)))
+                ii = jnp.arange(x.shape[2])
+                pi = jnp.arange(x.shape[3])
+                cond = (ii[:, None, None, None] > ii[None, None, :, None]) \
+                    | ((ii[:, None, None, None] == ii[None, None, :, None])
+                       & (pi[None, :, None, None] >= pi[None, None, None, :]))
+                full = jnp.where(cond[None, None], x, sw)
+                v = full[:, :, bi, :, bj, :]    # (nbl, t, f, 2, 2)
+                v = jnp.moveaxis(v, 0, 1)       # (t, nbl, f, 2, 2)
+                xx, xy = v[..., 0, 0], v[..., 0, 1]
+                yx, yy = v[..., 1, 0], v[..., 1, 1]
+                I = xx + yy
+                Q = xx - yy
+                U = xy + yx
+                V = (xy - yx) * 1j
+                return jnp.stack([I, Q, U, V], axis=-1).astype(
+                    jnp.complex64)
+            return jax.jit(fn)
+
+        if ifmt == 'storage' and ofmt == 'matrix':
+            nstand = self.nstand
+
+            def fn(x):
+                # x: (t, nbl, f, 4) IQUV
+                I, Q, U, V = (x[..., k] for k in range(4))
+                xx = 0.5 * (I + Q)
+                yy = 0.5 * (I - Q)
+                xy = 0.5 * (U - 1j * V)
+                yx = 0.5 * (U + 1j * V)
+                blk = jnp.stack(
+                    [jnp.stack([xx, xy], -1),
+                     jnp.stack([yx, yy], -1)], -2)    # (t,nbl,f,2,2)
+                t, nbl, f = x.shape[:3]
+                out = jnp.zeros((t, f, nstand, 2, nstand, 2),
+                                jnp.complex64)
+                blk_t = jnp.moveaxis(blk, 1, 2)       # (t, f, nbl, 2, 2)
+                out = out.at[:, :, bi, :, bj, :].set(
+                    jnp.moveaxis(blk_t, 2, 0))
+                # mirror to the upper triangle
+                sw = jnp.conj(jnp.transpose(out, (0, 1, 4, 5, 2, 3)))
+                ii = jnp.arange(nstand)
+                pi = jnp.arange(2)
+                cond = (ii[:, None, None, None] > ii[None, None, :, None]) \
+                    | ((ii[:, None, None, None] == ii[None, None, :, None])
+                       & (pi[None, :, None, None] >= pi[None, None, None, :]))
+                return jnp.where(cond[None, None], out, sw)
+            return jax.jit(fn)
+        raise ValueError((ifmt, ofmt))
+
+    def on_data(self, ispan, ospan):
+        x = ispan.data
+        key = tuple(x.shape)
+        if self._fn_key != key:
+            self._fn = self._build(x.shape)
+            self._fn_key = key
+        ospan.set(self._fn(x))
+
+
+def convert_visibilities(iring, fmt, *args, **kwargs):
+    """Block: reorder/convert visibility data between 'matrix' and
+    'storage' formats (reference docstring:
+    blocks/convert_visibilities.py:169-209)."""
+    return ConvertVisibilitiesBlock(iring, fmt, *args, **kwargs)

@@ -81,6 +81,8 @@ class _PacketCapture(object):
         self.stats = {'ngood_bytes': 0, 'nmissing_bytes': 0,
                       'nignored': 0, 'ninvalid': 0,
                       'src_ngood': np.zeros(self.nsrc, np.int64)}
+        from ..proclog import ProcLog
+        self._stats_proclog = ProcLog('%s_capture/stats' % ring.name)
 
     # -- method interface --------------------------------------------------
     def _recv_packet(self):
@@ -124,6 +126,11 @@ class _PacketCapture(object):
                 view[:, src] = 0   # blank unreliable source
         span.commit(self.buffer_ntime)
         span.close()
+        self._stats_proclog.update({
+            'ngood_bytes': self.stats['ngood_bytes'],
+            'nmissing_bytes': self.stats['nmissing_bytes'],
+            'ninvalid': self.stats['ninvalid'],
+            'nignored': self.stats['nignored']})
 
     def recv(self):
         """Process packets until one buffer's worth of time has been

@@ -18,8 +18,6 @@ Semantics notes:
 
 from __future__ import annotations
 
-import threading
-
 import numpy as np
 
 from ..dtype import DataType
@@ -29,13 +27,16 @@ from .map_lang import (compile_map, MapSyntaxError, Num, Name, BinOp, UnOp,
 
 __all__ = ['map', 'map_compute', 'clear_map_cache', 'MapSyntaxError']
 
-_cache = {}
-_cache_lock = threading.Lock()
+from ..utils import ObjectCache
+
+# Executor cache: the analogue of the reference's in-memory kernel cache
+# (ObjectCache, src/map.cpp:642); XLA's own compilation cache plays the
+# role of the on-disk PTX cache (DiskCacheMgr, src/map.cpp:409-628).
+_cache = ObjectCache(capacity=256)
 
 
 def clear_map_cache():
-    with _cache_lock:
-        _cache.clear()
+    _cache.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -797,8 +798,7 @@ def map_compute(func_string, data, axis_names=None, shape=None):
         for n, a in arrays.items())),
         tuple(sorted(scalars)), tuple(axis_names or ()), it_shape)
 
-    with _cache_lock:
-        fn = _cache.get(key)
+    fn = _cache.get(key)
     if fn is None:
         arr_names = sorted(arrays)
         sca_names = sorted(scalars)
@@ -814,8 +814,7 @@ def map_compute(func_string, data, axis_names=None, shape=None):
             return [ev.out[o] for o in outputs]
 
         fn = jax.jit(executor)
-        with _cache_lock:
-            _cache[key] = fn
+        _cache.put(key, fn)
     from ..xfer import to_device
     arr_vals = [arrays[n] if isinstance(arrays[n], jax.Array)
                 else to_device(arrays[n]) for n in sorted(arrays)]

@@ -33,6 +33,7 @@ from contextlib import ExitStack
 from copy import copy
 
 from . import affinity, device, memory
+from .trace import ScopedTracer, tracing_enabled as _tracing
 from .ring import Ring, ring_view, EndOfDataStop
 from .ndarray import memset_array
 from .proclog import ProcLog
@@ -630,7 +631,12 @@ class MultiTransformBlock(Block):
                         prev_time = cur_time
 
                         if not force_skip:
-                            ostrides = self._on_data(ispans, ospans)
+                            if _tracing():
+                                with ScopedTracer(self.name + '/on_data'):
+                                    ostrides = self._on_data(ispans,
+                                                             ospans)
+                            else:
+                                ostrides = self._on_data(ispans, ospans)
                             self._sync_gulp(ospans)
 
                         any_overwritten = any(ispan.nframe_overwritten

@@ -1,0 +1,44 @@
+"""Telemetry (opt-in stub).
+
+The reference ships opt-out usage reporting with an install UUID and
+HTTP POSTs (reference: python/bifrost/telemetry/__init__.py:86-197).
+This build deliberately ships a NO-OP implementation with the same API:
+nothing is ever collected or transmitted.  ``python -m
+bifrost_tpu.telemetry --disable`` is accepted for compatibility.
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ['track_module', 'track_function', 'enable', 'disable',
+           'is_active']
+
+_active = False
+
+
+def is_active():
+    return _active
+
+
+def enable():
+    """Telemetry collection is not implemented; this is a no-op."""
+    return False
+
+
+def disable():
+    return True
+
+
+def track_module():
+    pass
+
+
+def track_function(fn=None):
+    if fn is None:
+        return track_function
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return fn(*args, **kwargs)
+    return wrapper

@@ -1,0 +1,13 @@
+import sys
+
+from . import disable, enable, is_active
+
+if '--disable' in sys.argv:
+    disable()
+    print("bifrost_tpu telemetry is a no-op stub; nothing to disable.")
+elif '--enable' in sys.argv:
+    enable()
+    print("bifrost_tpu telemetry is a no-op stub; nothing was enabled.")
+else:
+    print("telemetry active: %s (always False in bifrost_tpu)"
+          % is_active())

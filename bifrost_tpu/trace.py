@@ -1,0 +1,73 @@
+"""Tracing/profiling hooks.
+
+The reference wraps NVTX ranges around block operations so nsight shows
+per-op spans (reference: src/trace.hpp:48-179, --enable-trace).  The
+TPU-native equivalents are jax.profiler trace annotations (visible in
+xprof/TensorBoard) plus simple wall-clock scopes; enable by setting
+``BF_TRACE=1`` (mirrors the reference's compile-time flag with an env
+var).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+
+__all__ = ['tracing_enabled', 'ScopedTracer', 'trace_scope',
+           'start_profile', 'stop_profile']
+
+_enabled = None
+
+
+def tracing_enabled():
+    global _enabled
+    if _enabled is None:
+        _enabled = bool(int(os.environ.get('BF_TRACE', '0') or 0))
+    return _enabled
+
+
+class ScopedTracer(object):
+    """With-block trace range (reference: ScopedTracer,
+    src/trace.hpp:126-179)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ctx = None
+        self.t0 = None
+        self.elapsed = None
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        if tracing_enabled():
+            try:
+                import jax.profiler
+                self._ctx = jax.profiler.TraceAnnotation(self.name)
+                self._ctx.__enter__()
+            except Exception:
+                self._ctx = None
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.t0
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)
+        return False
+
+
+@contextmanager
+def trace_scope(name):
+    with ScopedTracer(name) as t:
+        yield t
+
+
+def start_profile(logdir='/tmp/bifrost_tpu_profile'):
+    """Start an xprof capture (view with TensorBoard)."""
+    import jax.profiler
+    jax.profiler.start_trace(logdir)
+    return logdir
+
+
+def stop_profile():
+    import jax.profiler
+    jax.profiler.stop_trace()

@@ -1,0 +1,89 @@
+"""Tests for wav, convert_visibilities, dada file blocks."""
+
+import os
+import wave
+
+import numpy as np
+
+import bifrost_tpu as bf
+from tests.util import NumpySourceBlock, GatherSink, simple_header
+
+
+def test_wav_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.randint(-3000, 3000, size=(1024, 2)).astype(np.int16)
+    path = str(tmp_path / 'test.wav')
+    with wave.open(path, 'wb') as w:
+        w.setnchannels(2)
+        w.setsampwidth(2)
+        w.setframerate(8000)
+        w.writeframes(data.tobytes())
+    outdir = tmp_path / 'out'
+    os.makedirs(str(outdir))
+    with bf.Pipeline() as p:
+        b = bf.blocks.read_wav([path], gulp_nframe=256)
+        sink = GatherSink(b)
+        b2 = bf.blocks.copy(b)
+        bf.blocks.write_wav(b2, path=str(outdir))
+        p.run()
+    np.testing.assert_array_equal(sink.result(), data)
+    with wave.open(str(outdir / 'test.wav'), 'rb') as w:
+        assert w.getnframes() == 1024
+        back = np.frombuffer(w.readframes(1024), np.int16).reshape(-1, 2)
+    np.testing.assert_array_equal(back, data)
+
+
+def test_convert_visibilities_matrix_roundtrip():
+    """matrix(lower) -> storage -> matrix(full) recovers the Hermitian
+    matrix."""
+    T, F, S = 2, 3, 4
+    rng = np.random.RandomState(1)
+    full = (rng.randn(T, F, S, 2, S, 2) +
+            1j * rng.randn(T, F, S, 2, S, 2)).astype(np.complex64)
+    # make it Hermitian: V[i,pi,j,pj] = conj(V[j,pj,i,pi])
+    sw = np.conj(np.transpose(full, (0, 1, 4, 5, 2, 3)))
+    full = 0.5 * (full + sw)
+    # keep only the lower triangle (incl. diagonal pol-lower)
+    lower = full.copy()
+    for i in range(S):
+        for j in range(S):
+            if i < j:
+                lower[:, :, i, :, j, :] = 0
+    hdr = simple_header([-1, F, S, 2, S, 2], 'cf32',
+                        labels=['time', 'freq', 'station_i', 'pol_i',
+                                'station_j', 'pol_j'], gulp_nframe=T)
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock([lower], hdr, gulp_nframe=T)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.convert_visibilities(b, 'storage')
+        b = bf.blocks.convert_visibilities(b, 'matrix')
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    out = sink.result()
+    assert sink.headers[0]['_tensor']['labels'] == \
+        ['time', 'freq', 'station_i', 'pol_i', 'station_j', 'pol_j']
+    np.testing.assert_allclose(out, full, rtol=1e-4, atol=1e-5)
+
+
+def test_dada_file_reader(tmp_path):
+    hdr_text = (
+        "HDR_SIZE 4096\nNBIT 8\nNPOL 2\nNCHAN 4\nNDIM 2\n"
+        "TSAMP 1.0\nFREQ 1400.0\nBW 4.0\nSOURCE J0000+0000\n"
+        "TELESCOPE TEST\n")
+    rng = np.random.RandomState(2)
+    data = rng.randint(-128, 128, size=(16, 4, 2, 2)).astype(np.int8)
+    path = str(tmp_path / 'test.dada')
+    with open(path, 'wb') as f:
+        f.write(hdr_text.encode().ljust(4096))
+        f.write(data.tobytes())
+    with bf.Pipeline() as p:
+        b = bf.blocks.read_dada_file([path], gulp_nframe=8)
+        sink = GatherSink(b)
+        p.run()
+    hdr = sink.headers[0]
+    assert hdr['_tensor']['dtype'] == 'ci8'
+    assert hdr['source_name'] == 'J0000+0000'
+    out = sink.result()
+    got = np.stack([out['re'], out['im']], axis=-1)
+    np.testing.assert_array_equal(got, data)

@@ -1,0 +1,102 @@
+"""CLI tools smoke tests (reference analogue: test/test_scripts.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import bifrost_tpu as bf
+from tests.util import NumpySourceBlock, GatherSink, simple_header
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), 'tools')
+
+
+def _run_pipeline_and_leave_proclogs():
+    data = np.ones((8, 4), np.float32)
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 4], 'f32')
+        src = NumpySourceBlock([data], hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src)
+        sink = GatherSink(b)
+        p.run()
+    return sink
+
+
+def _tool(name, *args):
+    env = dict(os.environ)
+    return subprocess.run([sys.executable, os.path.join(TOOLS, name)]
+                          + list(args), capture_output=True, text=True,
+                          env=env, timeout=60)
+
+
+def test_like_top_once():
+    _run_pipeline_and_leave_proclogs()
+    res = _tool('like_top.py', str(os.getpid()), '--once')
+    assert res.returncode == 0, res.stderr
+    assert 'block' in res.stdout
+    assert 'CopyBlock' in res.stdout
+
+
+def test_like_ps():
+    _run_pipeline_and_leave_proclogs()
+    res = _tool('like_ps.py')
+    assert res.returncode == 0, res.stderr
+    assert str(os.getpid()) in res.stdout
+
+
+def test_pipeline2dot():
+    _run_pipeline_and_leave_proclogs()
+    res = _tool('pipeline2dot.py', str(os.getpid()))
+    assert res.returncode == 0, res.stderr
+    assert 'digraph pipeline' in res.stdout
+    assert '->' in res.stdout
+
+
+def test_like_bmon_once():
+    res = _tool('like_bmon.py', '--once')
+    assert res.returncode == 0, res.stderr
+    assert 'GOOD_BYTES' in res.stdout
+
+
+def test_proclog_roundtrip():
+    from bifrost_tpu import proclog
+    _run_pipeline_and_leave_proclogs()
+    contents = proclog.load_by_pid(os.getpid())
+    blocks = [b for b in contents if 'CopyBlock' in b]
+    assert blocks
+    perf = contents[blocks[0]].get('perf', {})
+    assert 'process_time' in perf
+
+
+def test_telemetry_stub():
+    import bifrost_tpu.telemetry as tel
+    assert tel.is_active() is False
+    tel.track_module()
+
+    @tel.track_function
+    def f(x):
+        return x + 1
+    assert f(1) == 2
+
+
+def test_header_standard():
+    from bifrost_tpu.header_standard import enforce_header_standard
+    good = {'nchans': 4, 'nifs': 1, 'nbits': 8, 'fch1': 1400.0,
+            'foff': -1.0, 'tstart': 58000.0, 'tsamp': 1e-3}
+    assert enforce_header_standard(good)
+    bad = dict(good)
+    del bad['tsamp']
+    assert not enforce_header_standard(bad)
+
+
+def test_object_cache_and_envvars():
+    from bifrost_tpu.utils import ObjectCache, EnvVars
+    c = ObjectCache(capacity=2)
+    c.put('a', 1)
+    c.put('b', 2)
+    c.put('c', 3)
+    assert 'a' not in c and c.get('c') == 3
+    os.environ['BF_TEST_VAR'] = 'hello'
+    EnvVars.clear()
+    assert EnvVars.get('BF_TEST_VAR') == 'hello'

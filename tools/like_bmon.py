@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""bmon-style monitor of packet capture/transmit statistics
+(reference: tools/like_bmon.py).  Reads the capture engines'
+ProcLog stats entries."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from bifrost_tpu import proclog  # noqa: E402
+
+
+def main():
+    once = '--once' in sys.argv
+    base = proclog.proclog_dir()
+    while True:
+        rows = []
+        if os.path.isdir(base):
+            for pid_s in sorted(os.listdir(base)):
+                if not pid_s.isdigit():
+                    continue
+                contents = proclog.load_by_pid(int(pid_s))
+                for block, logs in sorted(contents.items()):
+                    st = logs.get('stats', {})
+                    if 'ngood_bytes' in st:
+                        rows.append((pid_s, block,
+                                     st.get('ngood_bytes', 0),
+                                     st.get('nmissing_bytes', 0),
+                                     st.get('ninvalid', 0)))
+        if not once:
+            os.system('clear')
+        print('%-8s %-32s %14s %14s %8s'
+              % ('PID', 'CAPTURE', 'GOOD_BYTES', 'MISSING', 'INVALID'))
+        for r in rows:
+            print('%-8s %-32s %14s %14s %8s' % r)
+        if once:
+            return 0
+        time.sleep(1.0)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

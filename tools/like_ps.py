@@ -1,0 +1,30 @@
+#!/usr/bin/env python3
+"""ps-style listing of bifrost_tpu pipelines and their blocks
+(reference: tools/like_ps.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from bifrost_tpu import proclog  # noqa: E402
+
+
+def main():
+    base = proclog.proclog_dir()
+    if not os.path.isdir(base):
+        print("No proclog directory at %s" % base)
+        return 1
+    print('%-8s %-10s %s' % ('PID', 'CORE', 'BLOCK'))
+    for pid_s in sorted(os.listdir(base)):
+        if not pid_s.isdigit():
+            continue
+        contents = proclog.load_by_pid(int(pid_s))
+        for block, logs in sorted(contents.items()):
+            core = logs.get('bind', {}).get('core0', '-')
+            print('%-8s %-10s %s' % (pid_s, core, block))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

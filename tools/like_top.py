@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""top-style monitor of running bifrost_tpu pipelines
+(reference: tools/like_top.py).
+
+Renders per-block acquire/reserve/process times from the ProcLog tree.
+Use --once for a single text snapshot (no curses).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from bifrost_tpu import proclog  # noqa: E402
+
+
+def list_pipelines():
+    base = proclog.proclog_dir()
+    if not os.path.isdir(base):
+        return []
+    return sorted(int(p) for p in os.listdir(base) if p.isdigit())
+
+
+def snapshot(pid):
+    contents = proclog.load_by_pid(pid)
+    rows = []
+    for block, logs in sorted(contents.items()):
+        perf = logs.get('perf', {})
+        if not perf:
+            continue
+        rows.append((block,
+                     perf.get('acquire_time', -1),
+                     perf.get('reserve_time', -1),
+                     perf.get('process_time', -1)))
+    return rows
+
+
+def render(pid, rows):
+    out = ['pipeline pid %d   (%s)' % (pid, time.ctime()),
+           '%-44s %10s %10s %10s' % ('block', 'acquire_s', 'reserve_s',
+                                     'process_s'),
+           '-' * 78]
+    for block, acq, res, proc in rows:
+        def f(v):
+            return '%.2e' % v if isinstance(v, (int, float)) and v >= 0 \
+                else '-'
+        out.append('%-44s %10s %10s %10s' % (block[:44], f(acq), f(res),
+                                             f(proc)))
+    return '\n'.join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('pid', nargs='?', type=int,
+                    help='pipeline PID (default: first found)')
+    ap.add_argument('--once', action='store_true',
+                    help='print one snapshot and exit')
+    ap.add_argument('--interval', type=float, default=1.0)
+    args = ap.parse_args()
+
+    pid = args.pid
+    if pid is None:
+        pids = list_pipelines()
+        if not pids:
+            print("No running pipelines found under %s"
+                  % proclog.proclog_dir())
+            return 1
+        pid = pids[0]
+    if args.once:
+        print(render(pid, snapshot(pid)))
+        return 0
+    try:
+        while True:
+            os.system('clear')
+            print(render(pid, snapshot(pid)))
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""Reconstruct a running pipeline's block/ring graph from its ProcLogs
+and emit graphviz DOT (reference: tools/pipeline2dot.py:97)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from bifrost_tpu import proclog  # noqa: E402
+
+
+def get_data_flows(contents):
+    """block -> ([in rings], [out rings]) from the in/out proclogs."""
+    flows = {}
+    for block, logs in contents.items():
+        def rings(log):
+            d = logs.get(log, {})
+            return [d['ring%i' % i] for i in range(d.get('nring', 0))
+                    if 'ring%i' % i in d]
+        flows[block] = (rings('in'), rings('out'))
+    return flows
+
+
+def to_dot(contents):
+    flows = get_data_flows(contents)
+    lines = ['digraph pipeline {', '  rankdir=LR;']
+    rings = set()
+    for block, (ins, outs) in sorted(flows.items()):
+        lines.append('  "%s" [shape=box,style=filled,'
+                     'fillcolor=lightsteelblue];' % block)
+        for r in ins:
+            rings.add(r)
+            lines.append('  "%s" -> "%s";' % (r, block))
+        for r in outs:
+            rings.add(r)
+            lines.append('  "%s" -> "%s";' % (block, r))
+    for r in sorted(rings):
+        lines.append('  "%s" [shape=ellipse];' % r)
+    lines.append('}')
+    return '\n'.join(lines)
+
+
+def main():
+    if len(sys.argv) > 1:
+        pid = int(sys.argv[1])
+    else:
+        base = proclog.proclog_dir()
+        pids = sorted(int(p) for p in os.listdir(base) if p.isdigit()) \
+            if os.path.isdir(base) else []
+        if not pids:
+            print("No running pipelines found", file=sys.stderr)
+            return 1
+        pid = pids[0]
+    print(to_dot(proclog.load_by_pid(pid)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
