@@ -3,20 +3,29 @@
 Mirrors the reference's north-star pipeline (reference:
 testbench/gpuspec_simple.py:44-58 — FFT(fine_time) -> detect('stokes')
 -> reduce) running through the REAL bifrost_tpu machinery: ring buffers,
-thread-per-block pipeline, jitted device blocks on 'tpu'-space rings.
+thread-per-block pipeline, the fused FFT->Stokes->reduce stage chain as
+ONE jitted computation per gulp.
 
 Prints ONE JSON line:
   {"metric": ..., "value": Msamples/s, "unit": "Msamples/s",
    "vs_baseline": value / A100_BASELINE_MSPS}
+
+MEASUREMENT HONESTY: on this environment's tunneled TPU backend,
+``block_until_ready`` returns before device execution completes, so
+naive timings overstate throughput by orders of magnitude.  This bench
+forces REAL completion by reading back a scalar that depends on the
+final gulp (TPU programs execute in enqueue order, so the last gulp's
+value materializing implies the whole queue drained).  The same forcing
+bounds the warmup phase before the clock starts.
 
 Baseline derivation (BASELINE.md publishes no absolute number, so we use
 a bandwidth model of the same device-resident chain on an A100 running
 the CUDA reference): per complex sample, cuFFT 4096-pt c2c fp32 does
 ~2 r/w passes (32 B) plus detect read+write (~20 B) and reduce (~4 B)
 ≈ 56 B of HBM traffic; at ~1.55 TB/s effective that is ~28 Gsamples/s.
-A100_BASELINE_MSPS = 28000.  (v5e-1 HBM is 819 GB/s, so bandwidth parity
-alone would be ~0.5x; beating it requires the fusion/precision headroom
-XLA gives us.)
+A100_BASELINE_MSPS = 28000.  For calibration, this environment's chip
+measures ~14 TFLOPS on a pure f32 8k matmul (nominal v5e-1 is far
+higher), so numbers here are a lower bound on on-prem v5e performance.
 """
 
 import json
@@ -27,13 +36,20 @@ import numpy as np
 
 A100_BASELINE_MSPS = 28000.0
 
-NTIME = 2048         # frames per gulp
+NTIME = 16384        # frames per gulp
 NPOL = 2
 NFINE = 4096         # fine-time samples -> FFT length
 RFACTOR = 4
-NGULP_WARM = 4
-NGULP_BENCH = 48
-SYNC_DEPTH = 4       # gulps of dispatch-ahead per block
+NGULP_WARM = 3
+NGULP_BENCH = 32
+SYNC_DEPTH = 8       # gulps of dispatch-ahead per block
+
+
+def _force(arr):
+    """Force REAL device completion of ``arr``'s dependency chain by
+    materializing a scalar on the host."""
+    import jax.numpy as jnp
+    return float(jnp.sum(arr))
 
 
 def build_and_run():
@@ -41,6 +57,7 @@ def build_and_run():
     import jax.numpy as jnp
     import bifrost_tpu as bf
     from bifrost_tpu.pipeline import SourceBlock, SinkBlock
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
 
     class VoltageSource(SourceBlock):
         """Emits device-resident ci8 voltage gulps (device rep: int8
@@ -87,20 +104,25 @@ def build_and_run():
             super(SpectraSink, self).__init__(iring, **kwargs)
             self.n = 0
             self.t_start = None
-            self.last = None
+            self.elapsed = None
+            self.checksum = 0.0
 
         def on_sequence(self, iseq):
             pass
 
         def on_data(self, ispan):
-            self.last = ispan.data
             self.n += 1
             if self.n == NGULP_WARM:
-                # warmup done (compilation + cache): start the clock
-                self.last.block_until_ready()
+                # drain the queue (forces everything enqueued so far),
+                # then start the clock
+                self.checksum += _force(ispan.data)
                 self.t_start = time.time()
+            elif self.n == NGULP_WARM + NGULP_BENCH:
+                # force the final gulp -> whole benched queue has
+                # really executed
+                self.checksum += _force(ispan.data)
+                self.elapsed = time.time() - self.t_start
 
-    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
     with bf.Pipeline(sync_depth=SYNC_DEPTH) as p:
         src = VoltageSource(NGULP_WARM + NGULP_BENCH)
         # the whole FFT->detect->reduce chain fuses into ONE XLA
@@ -112,10 +134,12 @@ def build_and_run():
         ])
         sink = SpectraSink(b)
         p.run()
-    sink.last.block_until_ready()
-    elapsed = time.time() - sink.t_start
+    if sink.elapsed is None:
+        raise RuntimeError(
+            "Benchmark incomplete: sink received %d gulps, expected %d"
+            % (sink.n, NGULP_WARM + NGULP_BENCH))
     nsamples = NGULP_BENCH * NTIME * NPOL * NFINE
-    return nsamples / elapsed / 1e6
+    return nsamples / sink.elapsed / 1e6
 
 
 def main():

@@ -1,0 +1,32 @@
+"""Console entry points wrapping the tools/ scripts (so an installed
+package exposes bf-like-top etc. without the repo checkout)."""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def _run(tool):
+    tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools')
+    path = os.path.join(tools_dir, tool)
+    if os.path.exists(path):
+        sys.argv[0] = path
+        runpy.run_path(path, run_name='__main__')
+        return 0
+    print("tool not found: %s" % path, file=sys.stderr)
+    return 1
+
+
+def like_top_main():
+    return _run('like_top.py')
+
+
+def like_ps_main():
+    return _run('like_ps.py')
+
+
+def pipeline2dot_main():
+    return _run('pipeline2dot.py')

@@ -180,6 +180,141 @@ class DrxFormat(_FormatBase):
                           payload=buf[self.header_size:])
 
 
+class IBeamFormat(_FormatBase):
+    """Voltage-beam data carrying the same fields as the reference
+    ibeam decoder (seq, beam, nbeam, nchan, chan0) in a bespoke
+    big-endian layout — NOT wire-compatible with LWA ibeam packets:
+    u64be seq, u8 beam (src), u8 nbeam, u8 nserver, u8 server,
+    u16be nchan, u16be chan0."""
+
+    name = 'ibeam'
+    header_struct = struct.Struct('>QBBBBHH')
+
+    def pack(self, desc):
+        return self.header_struct.pack(desc.seq, desc.src, desc.nsrc,
+                                       1, 1, desc.nchan, desc.chan0) + \
+            bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        seq, src, nsrc, _, _, nchan, chan0 = \
+            self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=seq, src=src, nsrc=nsrc, nchan=nchan,
+                          chan0=chan0, payload=buf[self.header_size:])
+
+
+class CorFormat(_FormatBase):
+    """Correlator (visibility) packets carrying the same fields as the
+    reference cor decoder in a bespoke big-endian layout — NOT
+    wire-compatible with LWA COR packets: u64be time_tag, u32be tuning,
+    u16be baseline id (src), u16be navg, u16be nchan, u16be chan0."""
+
+    name = 'cor'
+    header_struct = struct.Struct('>QIHHHH')
+
+    def pack(self, desc):
+        return self.header_struct.pack(desc.seq, desc.tuning, desc.src,
+                                       desc.decimation, desc.nchan,
+                                       desc.chan0) + bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        seq, tuning, src, navg, nchan, chan0 = \
+            self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=seq, src=src, tuning=tuning,
+                          decimation=navg, nchan=nchan, chan0=chan0,
+                          payload=buf[self.header_size:])
+
+
+class Snap2Format(_FormatBase):
+    """SNAP2-style F-engine packets carrying the same fields as the
+    reference snap2 decoder in a bespoke big-endian layout — NOT
+    wire-compatible with real SNAP2 boards: u64be seq, u16be nchan,
+    u16be chan0, u16be src (antenna group), u16be nsrc."""
+
+    name = 'snap2'
+    header_struct = struct.Struct('>QHHHH')
+
+    def pack(self, desc):
+        return self.header_struct.pack(desc.seq, desc.nchan, desc.chan0,
+                                       desc.src, desc.nsrc) + \
+            bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        seq, nchan, chan0, src, nsrc = \
+            self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=seq, src=src, nsrc=nsrc, nchan=nchan,
+                          chan0=chan0, payload=buf[self.header_size:])
+
+
+class VdifFormat(_FormatBase):
+    """VDIF (VLBI Data Interchange Format) frames, non-legacy 32-byte
+    header (public VDIF spec; reference: src/formats/vdif.hpp).
+    Little-endian words: w0 = invalid(b31)|legacy(b30)|seconds (30b),
+    w1 = ref-epoch(6b)<<24 | frame-number(24b), w2 =
+    version/log2chan/frame-length, w3 = thread_id (bits 16-25) |
+    station_id (bits 0-15).  seq is derived as
+    seconds * frames_per_second + frame_number; src is the thread_id.
+    Legacy (16-byte-header) and invalid-flagged frames are rejected."""
+
+    name = 'vdif'
+    header_struct = struct.Struct('<8I')
+    frames_per_second = 25600
+
+    def pack(self, desc):
+        secs = desc.seq // self.frames_per_second
+        fnum = desc.seq % self.frames_per_second
+        frame_len8 = (self.header_size + len(desc.payload)) // 8
+        w0 = secs & 0x3FFFFFFF
+        w1 = fnum & 0xFFFFFF
+        w2 = frame_len8 & 0xFFFFFF
+        w3 = (desc.src & 0x3FF) << 16     # thread_id field
+        return self.header_struct.pack(w0, w1, w2, w3, 0, 0, 0, 0) + \
+            bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        w = self.header_struct.unpack_from(buf)
+        if w[0] & 0x80000000:   # invalid flag
+            return None
+        if w[0] & 0x40000000:   # legacy 16-byte header: unsupported
+            return None
+        secs = w[0] & 0x3FFFFFFF
+        fnum = w[1] & 0xFFFFFF
+        src = (w[3] >> 16) & 0x3FF        # thread_id
+        return PacketDesc(seq=secs * self.frames_per_second + fnum,
+                          src=src, time_tag=secs,
+                          payload=buf[self.header_size:])
+
+
+class TbfFormat(_FormatBase):
+    """TBF-style buffered-voltage frames carrying the same fields as
+    the reference tbf decoder in a bespoke big-endian layout — NOT
+    wire-compatible with LWA TBF (no sync word): u64be time_tag,
+    u16be nstand-id (src), u16be nchan, u16be chan0, u16be pad."""
+
+    name = 'tbf'
+    header_struct = struct.Struct('>QHHHH')
+    seq_quantum = 1
+
+    def pack(self, desc):
+        return self.header_struct.pack(desc.seq, desc.src, desc.nchan,
+                                       desc.chan0, 0) + \
+            bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        seq, src, nchan, chan0, _ = self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=seq, src=src, nchan=nchan, chan0=chan0,
+                          payload=buf[self.header_size:])
+
+
 FORMATS = {}
 
 
@@ -189,7 +324,8 @@ def register_format(cls_or_obj):
     return cls_or_obj
 
 
-for _f in (SimpleFormat, ChipsFormat, PBeamFormat, TbnFormat, DrxFormat):
+for _f in (SimpleFormat, ChipsFormat, PBeamFormat, TbnFormat, DrxFormat,
+           IBeamFormat, CorFormat, Snap2Format, VdifFormat, TbfFormat):
     register_format(_f)
 
 

@@ -1,0 +1,66 @@
+"""Quantize/unpack oracle tests (reference analogues: test_quantize.py,
+test_guantize.py, test_unpack.py, test_gunpack.py)."""
+
+import numpy as np
+
+import bifrost_tpu as bf
+from bifrost_tpu import ops
+
+
+def test_quantize_f32_to_i8_scale_clip():
+    x = bf.asarray(np.array([0.2, 1.0, -1.0, 300.0, -300.0], np.float32))
+    dst = bf.empty((5,), 'i8', 'system')
+    ops.quantize(x, dst, scale=100.)
+    np.testing.assert_array_equal(dst.as_numpy(),
+                                  [20, 100, -100, 127, -128])
+
+
+def test_quantize_cf32_to_ci8():
+    x = bf.asarray((np.array([1+2j, -3-4j, 200+0.4j])
+                    ).astype(np.complex64))
+    dst = bf.empty((3,), 'ci8', 'system')
+    ops.quantize(x, dst, scale=10.)
+    buf = dst.as_numpy()
+    np.testing.assert_array_equal(buf['re'], [10, -30, 127])
+    np.testing.assert_array_equal(buf['im'], [20, -40, 4])
+
+
+def test_quantize_packed_i4():
+    x = bf.asarray(np.array([1., -2., 3., -4., 5., -6., 7., -8.],
+                            np.float32))
+    dst = bf.empty((8,), 'i4', 'system')
+    ops.quantize(x, dst, scale=1.)
+    back = bf.empty((8,), 'i8', 'system')
+    ops.unpack(dst, back)
+    np.testing.assert_array_equal(back.as_numpy(),
+                                  [1, -2, 3, -4, 5, -6, 7, -8])
+
+
+def test_unpack_ci4_roundtrip():
+    vals = (np.array([1+2j, -3-4j, 7-8j, -8+7j]).astype(np.complex64))
+    dst4 = bf.empty((4,), 'ci4', 'system')
+    ops.quantize(bf.asarray(vals), dst4, scale=1.)
+    back = bf.empty((4,), 'cf32', 'system')
+    ops.unpack(dst4, back)
+    np.testing.assert_array_equal(back.as_numpy(), vals)
+
+
+def test_unpack_u2():
+    packed = bf.empty((8,), 'u2', 'system')
+    # 8 2-bit values -> 2 bytes; values 0..3
+    vals = np.array([0, 1, 2, 3, 3, 2, 1, 0])
+    from bifrost_tpu.ops.quantize import _pack_into
+    from bifrost_tpu.dtype import DataType
+    _pack_into(vals, DataType('u2'), packed.as_numpy())
+    out = bf.empty((8,), 'u8', 'system')
+    ops.unpack(packed, out)
+    np.testing.assert_array_equal(out.as_numpy(), vals)
+
+
+def test_quantize_device_path():
+    x = bf.asarray(np.linspace(-2, 2, 16).astype(np.float32),
+                   space='tpu')
+    dst = bf.empty((16,), 'i8', 'tpu')
+    ops.quantize(x, dst, scale=50.)
+    expect = np.clip(np.round(np.linspace(-2, 2, 16) * 50), -128, 127)
+    np.testing.assert_array_equal(np.asarray(dst.data), expect)

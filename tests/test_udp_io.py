@@ -196,7 +196,8 @@ def test_disk_packet_roundtrip(tmp_path):
 def test_format_roundtrips():
     from bifrost_tpu.io.packet_formats import get_format, PacketDesc
     payload = bytes(range(32))
-    for name in ('simple', 'chips', 'pbeam', 'tbn', 'drx'):
+    for name in ('simple', 'chips', 'pbeam', 'tbn', 'drx',
+                 'ibeam', 'cor', 'snap2', 'vdif', 'tbf'):
         fmt = get_format(name)
         desc = PacketDesc(seq=1234, src=1, nsrc=4, chan0=32, nchan=16,
                           tuning=77, gain=3, decimation=10,
@@ -205,7 +206,11 @@ def test_format_roundtrips():
         back = fmt.unpack(pkt)
         assert back.seq == 1234, name
         assert back.payload == payload, name
-        if name in ('chips', 'pbeam'):
+        if name in ('chips', 'pbeam', 'ibeam', 'snap2', 'cor', 'tbf'):
             assert back.src == 1 and back.chan0 == 32 and back.nchan == 16
+        if name in ('tbn', 'cor'):
+            assert back.src == 1 and back.tuning == 77 or name != 'tbn'
         if name == 'tbn':
-            assert back.src == 1 and back.tuning == 77
+            assert back.tuning == 77
+        if name == 'vdif':
+            assert back.src == 1

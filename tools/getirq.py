@@ -1,0 +1,41 @@
+#!/usr/bin/env python3
+"""Show the CPU affinity of a NIC's IRQs (reference: tools/getirq).
+
+Usage: getirq.py <interface>
+"""
+
+import sys
+
+
+def irqs_for(iface):
+    out = []
+    with open('/proc/interrupts') as f:
+        for line in f:
+            if iface in line:
+                irq = line.split(':', 1)[0].strip()
+                if irq.isdigit():
+                    out.append(int(irq))
+    return out
+
+
+def main():
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 1
+    iface = sys.argv[1]
+    found = irqs_for(iface)
+    if not found:
+        print("No IRQs found for interface %r" % iface)
+        return 1
+    for irq in found:
+        try:
+            with open('/proc/irq/%d/smp_affinity_list' % irq) as f:
+                aff = f.read().strip()
+        except OSError:
+            aff = '?'
+        print("irq %d -> cpus %s" % (irq, aff))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""Show hyperthread sibling groups (reference: tools/getsiblings).
+
+Helps choose cores for capture threads that do not share an execution
+unit with compute threads.
+"""
+
+import glob
+import sys
+
+
+def main():
+    groups = {}
+    for path in sorted(glob.glob(
+            '/sys/devices/system/cpu/cpu*/topology/thread_siblings_list')):
+        cpu = path.split('/')[5][3:]
+        try:
+            with open(path) as f:
+                sibs = f.read().strip()
+        except OSError:
+            continue
+        groups.setdefault(sibs, []).append(cpu)
+    for sibs in sorted(groups, key=lambda s: int(s.split(',')[0].split('-')[0])):
+        print(sibs)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

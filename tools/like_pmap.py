@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""pmap-style memory map of bifrost_tpu pipeline processes
+(reference: tools/like_pmap.py): per-pipeline ring/buffer summary from
+/proc/<pid>/status plus the ProcLog tree."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from bifrost_tpu import proclog  # noqa: E402
+
+
+def _proc_mem(pid):
+    out = {}
+    try:
+        with open('/proc/%d/status' % pid) as f:
+            for line in f:
+                if line.startswith(('VmRSS', 'VmSize', 'VmHWM')):
+                    k, v = line.split(':', 1)
+                    out[k] = v.strip()
+    except OSError:
+        pass
+    return out
+
+
+def main():
+    base = proclog.proclog_dir()
+    if not os.path.isdir(base):
+        print("No proclog directory at %s" % base)
+        return 1
+    for pid_s in sorted(os.listdir(base)):
+        if not pid_s.isdigit():
+            continue
+        pid = int(pid_s)
+        mem = _proc_mem(pid)
+        print("pid %d  %s" % (pid, '  '.join('%s=%s' % kv
+                                             for kv in mem.items())))
+        contents = proclog.load_by_pid(pid)
+        rings = set()
+        for block, logs in sorted(contents.items()):
+            for log in ('in', 'out'):
+                d = logs.get(log, {})
+                for i in range(d.get('nring', 0)):
+                    if 'ring%i' % i in d:
+                        rings.add(d['ring%i' % i])
+        for r in sorted(rings):
+            print("   ring %s" % r)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,32 @@
+#!/usr/bin/env python3
+"""Pin a NIC's IRQs to a CPU (reference: tools/setirq).
+
+Usage: setirq.py <interface> <cpu>   (requires root)
+"""
+
+import sys
+
+from getirq import irqs_for  # noqa: E402
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(__doc__)
+        return 1
+    iface, cpu = sys.argv[1], int(sys.argv[2])
+    found = irqs_for(iface)
+    if not found:
+        print("No IRQs found for interface %r" % iface)
+        return 1
+    for irq in found:
+        try:
+            with open('/proc/irq/%d/smp_affinity_list' % irq, 'w') as f:
+                f.write(str(cpu))
+            print("irq %d -> cpu %d" % (irq, cpu))
+        except OSError as e:
+            print("irq %d: %s (need root?)" % (irq, e))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
