@@ -1,0 +1,93 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference hand-writes CUDA kernels where library code falls short
+(reference: src/linalg_kernels.cu, src/fdmt.cu, ...).  The TPU analogue
+is Pallas.  XLA's fusion already covers most of this framework's chains
+(see blocks/fused.py), so Pallas is reserved for cases where explicit
+tiling wins; this module establishes the pattern with a Stokes-detect
+kernel operating on re/im planes (complex refs are avoided — TPU Pallas
+works on real tiles) and is gated by :func:`available`.
+
+Enable in stages with ``BF_USE_PALLAS=1`` (off by default; on the
+current tunneled backend XLA's fused path measures equal or faster).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ['available', 'stokes_detect']
+
+_checked = None
+
+
+def available():
+    """True if Pallas compiles and runs on the current backend."""
+    global _checked
+    if _checked is not None:
+        return _checked
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def k(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        x = jnp.ones((8, 128), jnp.float32)
+        out = pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x)
+        _checked = bool(abs(float(out.sum()) - 2 * 8 * 128) < 1e-3)
+    except Exception:
+        _checked = False
+    return _checked
+
+
+def enabled():
+    return bool(int(os.environ.get('BF_USE_PALLAS', '0') or 0)) \
+        and available()
+
+
+def stokes_detect(xr, xi, yr, yi, tile=512):
+    """Stokes I,Q,U,V from dual-pol complex voltages given as re/im
+    planes, as a tiled Pallas kernel.
+
+    xr/xi/yr/yi: (T, F) float32.  Returns (T, 4, F) float32.
+    (reference math: blocks/detect.py stokes mode)
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    T, F = xr.shape
+    tile = min(tile, F)
+    if F % tile:
+        tile = F
+
+    def kernel(xr_ref, xi_ref, yr_ref, yi_ref, o_ref):
+        a_r = xr_ref[...]
+        a_i = xi_ref[...]
+        b_r = yr_ref[...]
+        b_i = yi_ref[...]
+        xx = a_r * a_r + a_i * a_i
+        yy = b_r * b_r + b_i * b_i
+        # x * conj(y)
+        xy_r = a_r * b_r + a_i * b_i
+        xy_i = a_i * b_r - a_r * b_i
+        o_ref[:, 0, :] = xx + yy
+        o_ref[:, 1, :] = xx - yy
+        o_ref[:, 2, :] = 2.0 * xy_r
+        o_ref[:, 3, :] = -2.0 * xy_i
+
+    grid = (F // tile,)
+    spec = pl.BlockSpec((T, tile), lambda j: (0, j))
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec, spec, spec, spec],
+        out_specs=pl.BlockSpec((T, 4, tile), lambda j: (0, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((T, 4, F), jnp.float32),
+    )(xr, xi, yr, yi)
+    return out

@@ -1,0 +1,96 @@
+"""Dedispersion search demo (reference: testbench/test_fdmt.py):
+synthesize a dispersed pulse in a filterbank stream, dedisperse with
+the FDMT block on TPU, and report the detected DM/time.
+
+Run: python fdmt_search.py
+"""
+
+import os
+import sys
+
+try:
+    import bifrost_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+
+import bifrost_tpu as bf
+from bifrost_tpu.ops.fdmt import _cff
+from bifrost_tpu.xfer import to_host
+
+
+NCHAN, NTIME, F0, DF = 64, 1024, 100.0, 1.0   # MHz
+D_TRUE, T0 = 40, 200                          # delay (samples), pulse time
+
+
+class DispersedPulseSource(bf.SourceBlock):
+    def create_reader(self, name):
+        class R(object):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+        return R()
+
+    def on_sequence(self, reader, name):
+        rng = np.random.RandomState(0)
+        x = rng.randn(NCHAN, NTIME).astype(np.float32) * 0.1
+        band = _cff(F0, F0 + NCHAN * DF, -2.0)
+        for c in range(NCHAN):
+            delay = D_TRUE * _cff(F0, F0 + c * DF, -2.0) / band
+            x[c, T0 + int(round(delay))] += 3.0
+        self.data = x
+        self.pos = 0
+        return [{'name': 'pulse',
+                 '_tensor': {'shape': [NCHAN, -1], 'dtype': 'f32',
+                             'labels': ['freq', 'time'],
+                             'scales': [[F0, DF], [0.0, 1e-3]],
+                             'units': ['MHz', 's']}}]
+
+    def on_data(self, reader, ospans):
+        if self.pos >= NTIME:
+            return [0]
+        n = min(ospans[0].nframe, NTIME - self.pos)
+        ospans[0].data.as_numpy()[:, :n] = \
+            self.data[:, self.pos:self.pos + n]
+        self.pos += n
+        return [n]
+
+
+class PeakFinder(bf.SinkBlock):
+    def __init__(self, iring, **kwargs):
+        super(PeakFinder, self).__init__(iring, **kwargs)
+        self.best = (-np.inf, 0, 0)
+        self.offset = 0
+
+    def on_sequence(self, iseq):
+        self.dm_step = iseq.header['_tensor']['scales'][-2][1]
+
+    def on_data(self, ispan):
+        dmt = to_host(ispan.data)
+        row, t = np.unravel_index(np.argmax(dmt), dmt.shape)
+        if dmt[row, t] > self.best[0]:
+            self.best = (float(dmt[row, t]), int(row),
+                         self.offset + int(t))
+        self.offset += ispan.nframe
+
+
+def main():
+    with bf.Pipeline() as pipeline:
+        src = DispersedPulseSource(['pulse'], gulp_nframe=256)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fdmt(b, max_delay=64)
+        b = bf.blocks.copy(b, space='system')
+        peak = PeakFinder(b)
+        pipeline.run()
+    snr, row, t = peak.best
+    print("peak %.1f at DM row %d (true %d), t=%d (true %d), "
+          "DM = %.3f pc/cm^3" % (snr, row, D_TRUE, t, T0,
+                                 row * peak.dm_step))
+
+
+if __name__ == '__main__':
+    main()

@@ -8,7 +8,14 @@ pipeline (reference: testbench/gpuspec_simple.py:44-58).
 Usage: python gpuspec_simple.py <file.raw> [outdir]
 """
 
+import os
 import sys
+
+try:
+    import bifrost_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 import bifrost_tpu as bf
 from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage

@@ -1,0 +1,89 @@
+"""Writing your first block (reference: testbench/your_first_block.py).
+
+A TransformBlock needs two methods:
+- on_sequence(iseq): inspect/transform the header, return the output
+  header
+- on_data(ispan, ospan): compute one gulp
+
+Device blocks receive jax arrays from 'tpu'-space rings and publish
+results with ospan.set(...); host blocks mutate numpy views in place.
+Run: python your_first_block.py
+"""
+
+import os
+import sys
+
+try:
+    import bifrost_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+from copy import deepcopy
+
+import numpy as np
+
+import bifrost_tpu as bf
+
+
+class UselessAdd(bf.TransformBlock):
+    """Adds 1000 to every sample — on TPU when the ring is there."""
+
+    def on_sequence(self, iseq):
+        return deepcopy(iseq.header)
+
+    def on_data(self, ispan, ospan):
+        if ispan.ring.space == 'tpu':
+            ospan.set(ispan.data + 1000.0)
+        else:
+            ospan.data.as_numpy()[...] = \
+                ispan.data.as_numpy() + 1000.0
+
+
+class PrintStats(bf.SinkBlock):
+    def on_sequence(self, iseq):
+        print("sequence:", iseq.header['name'])
+
+    def on_data(self, ispan):
+        d = ispan.data.as_numpy()
+        print("gulp mean = %.2f" % float(d.mean()))
+
+
+class CountingSource(bf.SourceBlock):
+    def create_reader(self, name):
+        class R(object):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+        return R()
+
+    def on_sequence(self, reader, name):
+        self.count = 0
+        return [{'name': name,
+                 '_tensor': {'shape': [-1, 16], 'dtype': 'f32',
+                             'labels': ['time', 'chan'],
+                             'scales': [[0, 1], [0, 1]],
+                             'units': [None, None]}}]
+
+    def on_data(self, reader, ospans):
+        if self.count >= 4:
+            return [0]
+        self.count += 1
+        ospans[0].data.as_numpy()[...] = self.count
+        return [ospans[0].nframe]
+
+
+def main():
+    with bf.Pipeline() as pipeline:
+        src = CountingSource(['demo'], gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        b = UselessAdd(b)
+        b = bf.blocks.copy(b, space='system')
+        PrintStats(b)
+        pipeline.run()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,28 @@
+"""Pallas kernel tests (skipped where Pallas is unavailable, e.g. some
+CPU backends)."""
+
+import numpy as np
+import pytest
+
+from bifrost_tpu.ops import pallas_kernels as pk
+
+
+pytestmark = pytest.mark.skipif(not pk.available(),
+                                reason="Pallas unavailable on backend")
+
+
+def test_stokes_detect_matches_jnp():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    T, F = 16, 256
+    xr, xi, yr, yi = (rng.randn(T, F).astype(np.float32)
+                      for _ in range(4))
+    out = np.asarray(pk.stokes_detect(jnp.asarray(xr), jnp.asarray(xi),
+                                      jnp.asarray(yr), jnp.asarray(yi)))
+    x = xr + 1j * xi
+    y = yr + 1j * yi
+    xy = x * np.conj(y)
+    expect = np.stack([np.abs(x) ** 2 + np.abs(y) ** 2,
+                       np.abs(x) ** 2 - np.abs(y) ** 2,
+                       2 * xy.real, -2 * xy.imag], axis=1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-4)
