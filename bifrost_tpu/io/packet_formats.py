@@ -315,6 +315,36 @@ class TbfFormat(_FormatBase):
                           payload=buf[self.header_size:])
 
 
+class Drx8Format(DrxFormat):
+    """DRX with 8+8-bit complex samples (reference: src/formats/drx8.hpp)
+    — same header as drx, wider payload samples."""
+
+    name = 'drx8'
+
+
+class VBeamFormat(_FormatBase):
+    """Voltage-beam frames carrying the same fields as the reference
+    vbeam decoder in a bespoke big-endian layout — NOT wire-compatible:
+    u64be time_tag, u32be tuning, u16be beam (src), u16be nchan,
+    u16be chan0, u16be pad."""
+
+    name = 'vbeam'
+    header_struct = struct.Struct('>QIHHHH')
+
+    def pack(self, desc):
+        return self.header_struct.pack(desc.seq, desc.tuning, desc.src,
+                                       desc.nchan, desc.chan0, 0) + \
+            bytes(desc.payload)
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        seq, tuning, src, nchan, chan0, _ = \
+            self.header_struct.unpack_from(buf)
+        return PacketDesc(seq=seq, src=src, tuning=tuning, nchan=nchan,
+                          chan0=chan0, payload=buf[self.header_size:])
+
+
 FORMATS = {}
 
 
@@ -325,7 +355,8 @@ def register_format(cls_or_obj):
 
 
 for _f in (SimpleFormat, ChipsFormat, PBeamFormat, TbnFormat, DrxFormat,
-           IBeamFormat, CorFormat, Snap2Format, VdifFormat, TbfFormat):
+           IBeamFormat, CorFormat, Snap2Format, VdifFormat, TbfFormat,
+           Drx8Format, VBeamFormat):
     register_format(_f)
 
 

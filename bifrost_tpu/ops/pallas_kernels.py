@@ -16,8 +16,6 @@ from __future__ import annotations
 
 import os
 
-import numpy as np
-
 __all__ = ['available', 'stokes_detect']
 
 _checked = None
@@ -46,8 +44,8 @@ def available():
 
 
 def enabled():
-    return bool(int(os.environ.get('BF_USE_PALLAS', '0') or 0)) \
-        and available()
+    flag = os.environ.get('BF_USE_PALLAS', '').strip().lower()
+    return flag in ('1', 'true', 'yes', 'on') and available()
 
 
 def stokes_detect(xr, xi, yr, yi, tile=512):

@@ -227,6 +227,13 @@ class DetectStage(Stage):
             if npol == 1:
                 return mag2(x).astype(odt)
             xp, yp = take(x, 0), take(x, 1)
+            if mode == 'stokes' and axis == 1 and xp.ndim == 2 \
+                    and odt == jnp.float32:
+                from .ops import pallas_kernels as _pk
+                if _pk.enabled():
+                    return _pk.stokes_detect(
+                        jnp.real(xp), jnp.imag(xp),
+                        jnp.real(yp), jnp.imag(yp))
             xx, yy = mag2(xp), mag2(yp)
             if mode == 'stokes_i':
                 out = (xx + yy)[None]

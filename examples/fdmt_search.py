@@ -17,8 +17,12 @@ except ImportError:
 import numpy as np
 
 import bifrost_tpu as bf
-from bifrost_tpu.ops.fdmt import _cff
 from bifrost_tpu.xfer import to_host
+
+
+def cff(f1, f2):
+    """Quadratic dispersion delay factor between two frequencies."""
+    return abs(f1 ** -2 - f2 ** -2)
 
 
 NCHAN, NTIME, F0, DF = 64, 1024, 100.0, 1.0   # MHz
@@ -38,9 +42,9 @@ class DispersedPulseSource(bf.SourceBlock):
     def on_sequence(self, reader, name):
         rng = np.random.RandomState(0)
         x = rng.randn(NCHAN, NTIME).astype(np.float32) * 0.1
-        band = _cff(F0, F0 + NCHAN * DF, -2.0)
+        band = cff(F0, F0 + NCHAN * DF)
         for c in range(NCHAN):
-            delay = D_TRUE * _cff(F0, F0 + c * DF, -2.0) / band
+            delay = D_TRUE * cff(F0, F0 + c * DF) / band
             x[c, T0 + int(round(delay))] += 3.0
         self.data = x
         self.pos = 0

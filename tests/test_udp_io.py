@@ -197,7 +197,8 @@ def test_format_roundtrips():
     from bifrost_tpu.io.packet_formats import get_format, PacketDesc
     payload = bytes(range(32))
     for name in ('simple', 'chips', 'pbeam', 'tbn', 'drx',
-                 'ibeam', 'cor', 'snap2', 'vdif', 'tbf'):
+                 'ibeam', 'cor', 'snap2', 'vdif', 'tbf',
+                 'drx8', 'vbeam'):
         fmt = get_format(name)
         desc = PacketDesc(seq=1234, src=1, nsrc=4, chan0=32, nchan=16,
                           tuning=77, gain=3, decimation=10,
@@ -206,7 +207,8 @@ def test_format_roundtrips():
         back = fmt.unpack(pkt)
         assert back.seq == 1234, name
         assert back.payload == payload, name
-        if name in ('chips', 'pbeam', 'ibeam', 'snap2', 'cor', 'tbf'):
+        if name in ('chips', 'pbeam', 'ibeam', 'snap2', 'cor', 'tbf',
+                    'vbeam'):
             assert back.src == 1 and back.chan0 == 32 and back.nchan == 16
         if name in ('tbn', 'cor'):
             assert back.src == 1 and back.tuning == 77 or name != 'tbn'
