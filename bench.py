@@ -42,7 +42,7 @@ NFINE = 4096         # fine-time samples -> FFT length
 RFACTOR = 4
 NGULP_WARM = 3
 NGULP_BENCH = 32
-SYNC_DEPTH = 8       # gulps of dispatch-ahead per block
+SYNC_DEPTH = 4       # gulps of dispatch-ahead per block
 
 
 def _force(arr):
