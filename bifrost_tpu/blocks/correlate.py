@@ -61,11 +61,11 @@ class CorrelateBlock(TransformBlock):
                                   self.nframe_per_integration)
         return ohdr
 
-    def _build(self, shape, dtype, reim):
+    def _build(self, shape, dtype, reim, acc_is_none):
         import jax
         import jax.numpy as jnp
 
-        def fn(x, acc):
+        def local_vis(x):
             if reim:
                 # int8 MXU path: x (T, F, S, P, 2)
                 t, f, s, p = x.shape[:4]
@@ -86,19 +86,70 @@ class CorrelateBlock(TransformBlock):
                 vis = jnp.einsum('tfi,tfj->fij', xm, jnp.conj(xm),
                                  preferred_element_type=jnp.complex64)
                 vis = vis.reshape(f, s, p, s, p)
+            return vis
+
+        def fn(x, acc):
+            vis = local_vis(x)
             return vis if acc is None else acc + vis
 
-        return jax.jit(fn)
+        mesh = self.mesh
+        if mesh is not None:
+            # Time-parallel integration over the mesh: each shard
+            # cross-multiplies its time slice, partial visibilities meet
+            # in a psum over the time axis (the pattern of
+            # parallel.ops._local_correlate).
+            from ..parallel.ops import _shard_map
+            from ..parallel.scope import (time_axis_name, shardable_nframe,
+                                          shard_gulp, replicated_sharding)
+            if shardable_nframe(mesh, shape[0]):
+                from jax.sharding import PartitionSpec as P
+                tname = time_axis_name(mesh)
+                in_spec = P(*([tname] + [None] * (len(shape) - 1)))
+                rep = P()
+                shard_map = _shard_map()
+
+                def local_fn(x, acc):
+                    vis = jax.lax.psum(local_vis(x), tname)
+                    return vis if acc is None else acc + vis
+
+                if acc_is_none:
+                    sharded = jax.jit(shard_map(
+                        lambda x: local_fn(x, None), mesh=mesh,
+                        in_specs=in_spec, out_specs=rep))
+
+                    def mesh_fn(x, acc):
+                        return sharded(shard_gulp(x, mesh, 0))
+                else:
+                    sharded = jax.jit(shard_map(
+                        local_fn, mesh=mesh,
+                        in_specs=(in_spec, rep), out_specs=rep))
+
+                    def mesh_fn(x, acc):
+                        acc = jax.device_put(acc,
+                                             replicated_sharding(mesh))
+                        return sharded(shard_gulp(x, mesh, 0), acc)
+                return mesh_fn
+
+        jfn = jax.jit(fn)
+
+        def plain_fn(x, acc):
+            from ..parallel.scope import gather_local
+            x = gather_local(x)
+            if acc is not None:
+                acc = gather_local(acc)
+            return jfn(x, acc)
+        return plain_fn
 
     def on_data(self, ispan, ospan):
         import jax.numpy as jnp
         x = ispan.data
         reim = ispan.tensor['dtype'].kind == 'ci' and \
             not jnp.issubdtype(x.dtype, jnp.complexfloating)
-        key = (tuple(x.shape), str(x.dtype), self._acc is None)
+        acc_is_none = self._acc is None
+        key = (tuple(x.shape), str(x.dtype), acc_is_none)
         fn = self._fn.get(key)
         if fn is None:
-            fn = self._build(x.shape, x.dtype, reim)
+            fn = self._build(x.shape, x.dtype, reim, acc_is_none)
             self._fn[key] = fn
         self._acc = fn(x, self._acc)
         self.nframe_integrated += ispan.nframe

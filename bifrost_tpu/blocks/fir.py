@@ -37,7 +37,8 @@ class FirBlock(TransformBlock):
         if gulp % self._decim:
             raise ValueError("Decimation factor (%d) does not divide "
                              "gulp_nframe (%d)" % (self._decim, gulp))
-        self.fir.init(self._coeffs, decim=self._decim, space='tpu')
+        self.fir.init(self._coeffs, decim=self._decim, space='tpu',
+                      mesh=self.mesh)
         ohdr = deepcopy(iseq.header)
         t = ohdr['_tensor']
         taxis = t['shape'].index(-1)

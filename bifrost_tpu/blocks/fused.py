@@ -56,7 +56,20 @@ class FusedBlock(TransformBlock):
             fn = stage.build(meta)
             fns.append(fn)
             cur = jax.eval_shape(fn, cur)
-        return jax.jit(lambda x: _reduce(lambda v, f: f(v), fns, x))
+        composed = lambda x: _reduce(lambda v, f: f(v), fns, x)
+        mesh = self.mesh
+        if mesh is not None:
+            # Scale the whole fused chain over the scope's mesh: shard the
+            # gulp's frame axis, let GSPMD partition every stage and insert
+            # any collectives (the TPU generalization of the reference's
+            # per-block gpu=N placement, reference: pipeline.py:365-366).
+            from ..parallel.scope import shardable_nframe, time_sharding
+            taxis = self._headers[0]['_tensor']['shape'].index(-1)
+            if shardable_nframe(mesh, shape[taxis]):
+                sharding = time_sharding(mesh, len(shape), taxis)
+                return (jax.jit(composed, in_shardings=sharding),
+                        taxis)
+        return jax.jit(composed), None
 
     def on_data(self, ispan, ospan):
         x = ispan.data
@@ -64,7 +77,11 @@ class FusedBlock(TransformBlock):
         if self._plan_key != key:
             self._plan = self._build_plan(x.shape, x.dtype)
             self._plan_key = key
-        ospan.set(self._plan(x))
+        fn, taxis = self._plan
+        if taxis is not None:
+            from ..parallel.scope import shard_gulp
+            x = shard_gulp(x, self.mesh, taxis)
+        ospan.set(fn(x))
 
 
 def fused(iring, stages, *args, **kwargs):

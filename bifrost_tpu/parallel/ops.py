@@ -40,26 +40,49 @@ def _P(*args):
 # per-shard bodies (shared by the sharded_* wrappers and spectrometer_step)
 # ---------------------------------------------------------------------------
 
-def _local_fir(x, coeffs, axis_name):
-    """Causal FIR along the (sharded) leading time axis with a left-halo
-    ppermute exchange — the sequence-parallel pattern (reference op keeps
-    inter-gulp state host-side: src/fir.cu:143-316)."""
+def _local_fir_stateful(x, coeffs, state, axis_name, decim=1):
+    """Causal FIR along the (sharded) leading time axis.  ``state`` holds
+    the replicated inter-gulp history (the previous gulp's final ntap-1
+    frames) consumed by shard 0; interior shard boundaries exchange halos
+    via ppermute — the sequence-parallel pattern (reference op keeps
+    inter-gulp state host-side: src/fir.cu:143-316).  Returns
+    ``(y, new_state)``; ``new_state`` is this gulp's global final ntap-1
+    frames, replicated to every shard."""
     import jax
     import jax.numpy as jnp
     ntap = coeffs.shape[0]
     if ntap == 1:
-        return coeffs[0] * x
+        y = coeffs[0] * x
+        return (y[::decim] if decim > 1 else y), state
     axis_size = jax.lax.axis_size(axis_name)
     halo = x[-(ntap - 1):]
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     left = jax.lax.ppermute(halo, axis_name, perm)
     idx = jax.lax.axis_index(axis_name)
-    left = jnp.where(idx == 0, jnp.zeros_like(left), left)
+    left = jnp.where(idx == 0, state.astype(x.dtype), left)
     xp = jnp.concatenate([left, x], axis=0)
     out = jnp.zeros_like(x)
     for t in range(ntap):
         out = out + coeffs[t] * xp[ntap - 1 - t: xp.shape[0] - t]
-    return out
+    if decim > 1:
+        out = out[::decim]
+    # New state = the LAST shard's halo; a masked psum (rather than
+    # all_gather + index) so shard_map can prove the result replicated.
+    mask = (idx == axis_size - 1).astype(halo.dtype)
+    new_state = jax.lax.psum(halo * mask, axis_name)
+    return out, new_state
+
+
+def _local_fir(x, coeffs, axis_name):
+    """Stateless wrapper over :func:`_local_fir_stateful` (zero initial
+    history; any unused all_gather is dead-code-eliminated by XLA)."""
+    import jax.numpy as jnp
+    ntap = coeffs.shape[0]
+    if ntap == 1:
+        return coeffs[0] * x
+    state = jnp.zeros((ntap - 1,) + x.shape[1:], x.dtype)
+    y, _ = _local_fir_stateful(x, coeffs, state, axis_name)
+    return y
 
 
 def _local_stokes(s):

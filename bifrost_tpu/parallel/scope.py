@@ -1,0 +1,86 @@
+"""Pipeline <-> mesh glue: how ``BlockScope(mesh=...)`` becomes sharded
+execution inside blocks.
+
+The reference's analogue is per-block device placement (`gpu=N` ->
+set_device on the block thread, reference: python/bifrost/pipeline.py:365-366).
+On TPU a block scales *out* instead: its jitted gulp function runs over a
+``jax.sharding.Mesh``, with the gulp's frame (time) axis sharded across
+the mesh's time axis.  Two integration styles, both driven from here:
+
+- **GSPMD** (generic stage chains — FusedBlock): ``jax.jit`` with
+  ``in_shardings`` on the frame axis; XLA partitions the whole fused
+  chain and inserts any collectives it needs.  Right for arbitrary stage
+  compositions where the collective pattern is not known a priori.
+- **shard_map** (ops with a known collective pattern — correlate's
+  time-psum, FIR's halo exchange): explicit per-shard bodies from
+  :mod:`bifrost_tpu.parallel.ops`.
+
+Axis-name conventions: the *time* axis of a mesh is ``'sp'`` if present,
+else the first axis; the *station* axis is ``'tp'`` if present.
+"""
+
+from __future__ import annotations
+
+__all__ = ['time_axis_name', 'station_axis_name', 'time_axis_size',
+           'time_sharding', 'replicated_sharding', 'shardable_nframe',
+           'shard_gulp', 'gather_local']
+
+
+def time_axis_name(mesh):
+    """The mesh axis that gulp frame/time axes shard over."""
+    return 'sp' if 'sp' in mesh.axis_names else mesh.axis_names[0]
+
+
+def station_axis_name(mesh):
+    """The mesh axis for antenna/station sharding, or None."""
+    return 'tp' if 'tp' in mesh.axis_names else None
+
+
+def time_axis_size(mesh):
+    return mesh.shape[time_axis_name(mesh)]
+
+
+def time_sharding(mesh, ndim, taxis):
+    """NamedSharding placing axis ``taxis`` of an ndim-array over the
+    mesh's time axis (all other axes replicated)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = [None] * ndim
+    spec[taxis] = time_axis_name(mesh)
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shardable_nframe(mesh, nframe):
+    """Whether a gulp of ``nframe`` frames divides over the time axis."""
+    return nframe % time_axis_size(mesh) == 0
+
+
+def shard_gulp(x, mesh, taxis):
+    """Lay a gulp array out over the mesh (frame axis sharded).  A no-op
+    when the frame axis does not divide the mesh, or when the array is
+    already in the target layout."""
+    import jax
+    if x.shape[taxis] % time_axis_size(mesh):
+        return x
+    sharding = time_sharding(mesh, x.ndim, taxis)
+    if getattr(x, 'sharding', None) == sharding:
+        return x
+    return jax.device_put(x, sharding)
+
+
+def gather_local(x):
+    """Bring a (possibly mesh-committed) array back to this thread's
+    single device.  Blocks need this when they fall back from the
+    sharded to the unsharded build mid-sequence (e.g. a partial final
+    gulp) while carrying state computed on the mesh — mixing committed
+    device sets in one jit call is an error."""
+    import jax
+    if isinstance(x, jax.Array) and \
+            len(getattr(x, 'sharding').device_set) > 1:
+        from ..device import get_device
+        return jax.device_put(x, get_device())
+    return x

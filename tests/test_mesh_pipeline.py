@@ -1,0 +1,218 @@
+"""Mesh-in-the-pipeline tests: blocks consume ``BlockScope(mesh=...)``
+and run their gulp functions sharded over the 8-device virtual CPU mesh,
+with output identical to the single-device run (VERDICT r1 item 2;
+the TPU generalization of the reference's per-block gpu=N placement,
+reference: python/bifrost/pipeline.py:365-366)."""
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu.parallel import create_mesh
+
+from util import NumpySourceBlock, GatherSink, simple_header
+
+
+def _spectro_inputs():
+    rng = np.random.RandomState(42)
+    gulps = [(rng.randn(16, 2, 32) + 1j * rng.randn(16, 2, 32))
+             .astype(np.complex64) for _ in range(3)]
+    hdr = simple_header([-1, 2, 32], 'cf32',
+                        labels=['time', 'pol', 'fine_time'])
+    return gulps, hdr
+
+
+def _run_fused_chain(mesh):
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    gulps, hdr = _spectro_inputs()
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock(gulps, hdr, gulp_nframe=16)
+        b = bf.blocks.copy(src, space='tpu')
+        with bf.block_scope(mesh=mesh):
+            b = bf.blocks.fused(b, [
+                FftStage('fine_time', axis_labels='freq'),
+                DetectStage('stokes', axis='pol'),
+                ReduceStage('freq', factor=4)])
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    return sink.result()
+
+
+def test_fused_chain_on_mesh_matches_single_device():
+    """The fused FFT->detect->reduce chain through rings, sharded over
+    the mesh (GSPMD over the frame axis), must be bit-compatible with
+    the single-device run."""
+    base = _run_fused_chain(None)
+    meshed = _run_fused_chain(create_mesh({'sp': 8}))
+    assert base is not None and meshed is not None
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-4)
+
+
+def test_fused_chain_on_2d_mesh():
+    meshed = _run_fused_chain(create_mesh({'sp': 2, 'tp': 4}))
+    base = _run_fused_chain(None)
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-4)
+
+
+def test_fused_chain_mesh_indivisible_falls_back():
+    """gulp_nframe=12 does not divide 8 shards: the block must fall back
+    to unsharded execution and still be correct."""
+    from bifrost_tpu.stages import FftStage, DetectStage
+    rng = np.random.RandomState(3)
+    data = (rng.randn(12, 2, 16) + 1j * rng.randn(12, 2, 16)) \
+        .astype(np.complex64)
+    hdr = simple_header([-1, 2, 16], 'cf32',
+                        labels=['time', 'pol', 'fine_time'])
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock([data], hdr, gulp_nframe=12)
+        b = bf.blocks.copy(src, space='tpu')
+        with bf.block_scope(mesh=create_mesh({'sp': 8})):
+            b = bf.blocks.fused(b, [FftStage('fine_time'),
+                                    DetectStage('stokes', axis='pol')])
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    s = np.fft.fft(data, axis=-1)
+    x, y = s[:, 0], s[:, 1]
+    xy = x * np.conj(y)
+    expect = np.stack([np.abs(x)**2 + np.abs(y)**2,
+                       np.abs(x)**2 - np.abs(y)**2,
+                       2 * xy.real, -2 * xy.imag], axis=1)
+    np.testing.assert_allclose(sink.result(), expect, rtol=1e-4, atol=1e-3)
+
+
+def _run_correlate(mesh, gulps, hdr, nint):
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock(gulps, hdr, gulp_nframe=gulps[0].shape[0])
+        b = bf.blocks.copy(src, space='tpu')
+        with bf.block_scope(mesh=mesh):
+            b = bf.blocks.correlate(b, nframe_per_integration=nint)
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    return sink.result()
+
+
+def test_correlate_on_mesh_matches_single_device():
+    """Time-parallel correlation: per-shard cross-multiply + psum over
+    the mesh time axis (parallel.ops pattern), integrated across gulps."""
+    rng = np.random.RandomState(7)
+    gulps = [(rng.randn(8, 4, 3, 2) + 1j * rng.randn(8, 4, 3, 2))
+             .astype(np.complex64) for _ in range(2)]
+    hdr = simple_header([-1, 4, 3, 2], 'cf32',
+                        labels=['time', 'freq', 'station', 'pol'],
+                        gulp_nframe=8)
+    base = _run_correlate(None, gulps, hdr, 16)
+    meshed = _run_correlate(create_mesh({'sp': 8}), gulps, hdr, 16)
+    assert base is not None and meshed is not None
+    np.testing.assert_allclose(meshed, base, rtol=1e-4, atol=1e-3)
+
+
+def test_correlate_ci8_on_mesh():
+    """int8 MXU 3-matmul path under shard_map: int32 partials psum."""
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    rng = np.random.RandomState(8)
+    raw = np.zeros((16, 2, 3, 2), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-16, 16, size=raw.shape)
+    raw['im'] = rng.randint(-16, 16, size=raw.shape)
+    hdr = simple_header([-1, 2, 3, 2], 'ci8',
+                        labels=['time', 'freq', 'station', 'pol'],
+                        gulp_nframe=16)
+    base = _run_correlate(None, [raw], hdr, 16)
+    meshed = _run_correlate(create_mesh({'sp': 8}), [raw], hdr, 16)
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-5)
+
+
+def test_fir_on_mesh_matches_single_device():
+    """Sequence-parallel FIR: inter-gulp state feeds shard 0, interior
+    shard boundaries exchange halos via ppermute."""
+    rng = np.random.RandomState(9)
+    gulps = [rng.randn(16, 3).astype(np.float32) for _ in range(3)]
+    coeffs = np.array([0.5, 0.3, 0.2], np.float32)
+    hdr = simple_header([-1, 3], 'f32', gulp_nframe=16)
+
+    def run(mesh):
+        with bf.Pipeline() as p:
+            src = NumpySourceBlock(gulps, hdr, gulp_nframe=16)
+            b = bf.blocks.copy(src, space='tpu')
+            with bf.block_scope(mesh=mesh):
+                b = bf.blocks.fir(b, coeffs)
+            b = bf.blocks.copy(b, space='system')
+            sink = GatherSink(b)
+            p.run()
+        return sink.result()
+
+    base = run(None)
+    meshed = run(create_mesh({'sp': 8}))
+    # oracle: causal FIR over the concatenated stream
+    x = np.concatenate(gulps, axis=0)
+    xp = np.concatenate([np.zeros((2, 3), np.float32), x])
+    expect = sum(coeffs[t] * xp[2 - t:2 - t + 48] for t in range(3))
+    np.testing.assert_allclose(base, expect, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(meshed, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_correlate_mesh_partial_gulp_fallback():
+    """A partial gulp mid-integration routes to the single-device build
+    while the carried accumulator lives on the mesh; the block must
+    reconcile the device sets both directions (code-review regression)."""
+    rng = np.random.RandomState(11)
+    gulps = [(rng.randn(n, 2, 3, 2) + 1j * rng.randn(n, 2, 3, 2))
+             .astype(np.complex64) for n in (8, 4, 4)]
+    hdr = simple_header([-1, 2, 3, 2], 'cf32',
+                        labels=['time', 'freq', 'station', 'pol'],
+                        gulp_nframe=8)
+    base = _run_correlate(None, gulps, hdr, 16)
+    meshed = _run_correlate(create_mesh({'sp': 8}), gulps, hdr, 16)
+    assert base is not None and meshed is not None
+    np.testing.assert_allclose(meshed, base, rtol=1e-4, atol=1e-3)
+
+
+def test_fir_mesh_partial_gulp_fallback():
+    """A partial final gulp after sharded gulps: the carried FIR state is
+    mesh-committed but the tail build is single-device (code-review
+    regression)."""
+    rng = np.random.RandomState(12)
+    gulps = [rng.randn(n, 3).astype(np.float32) for n in (16, 16, 4)]
+    coeffs = np.array([0.5, 0.3, 0.2], np.float32)
+    hdr = simple_header([-1, 3], 'f32', gulp_nframe=16)
+
+    def run(mesh):
+        with bf.Pipeline() as p:
+            src = NumpySourceBlock(gulps, hdr, gulp_nframe=16)
+            b = bf.blocks.copy(src, space='tpu')
+            with bf.block_scope(mesh=mesh):
+                b = bf.blocks.fir(b, coeffs)
+            b = bf.blocks.copy(b, space='system')
+            sink = GatherSink(b)
+            p.run()
+        return sink.result()
+
+    base = run(None)
+    meshed = run(create_mesh({'sp': 8}))
+    assert base is not None and meshed is not None
+    assert meshed.shape[0] == 36
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-5)
+
+
+def test_fir_on_mesh_with_decimation():
+    rng = np.random.RandomState(10)
+    gulps = [rng.randn(16, 2).astype(np.float32) for _ in range(2)]
+    coeffs = np.array([0.25, 0.5, 0.25], np.float32)
+    hdr = simple_header([-1, 2], 'f32', gulp_nframe=16)
+
+    def run(mesh):
+        with bf.Pipeline() as p:
+            src = NumpySourceBlock(gulps, hdr, gulp_nframe=16)
+            b = bf.blocks.copy(src, space='tpu')
+            with bf.block_scope(mesh=mesh):
+                b = bf.blocks.fir(b, coeffs, decim=2)
+            b = bf.blocks.copy(b, space='system')
+            sink = GatherSink(b)
+            p.run()
+        return sink.result()
+
+    base = run(None)
+    meshed = run(create_mesh({'sp': 8}))
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-5)
