@@ -131,7 +131,11 @@ class CorrelateBlock(TransformBlock):
                 return mesh_fn
 
         jfn = jax.jit(fn)
+        if mesh is None:
+            return jfn
 
+        # mesh fallback (e.g. indivisible partial gulp): carried state
+        # may be mesh-committed — reconcile device sets first
         def plain_fn(x, acc):
             from ..parallel.scope import gather_local
             x = gather_local(x)

@@ -1,38 +1,66 @@
-"""Wire formats for packet capture/transmit.
+"""Wire formats for packet capture/transmit — bit-exact reference layouts.
 
-The reference implements per-telescope formats as C++ decoder/processor
-pairs (reference: src/formats/*.hpp — chips, tbn, drx, pbeam, ibeam,
-vdif, ...; base classes formats/base.hpp:91-155).  Here each format is a
-small codec object with
+The reference implements per-telescope formats as C++ decoder /
+header-filler pairs over ``__attribute__((packed))`` structs
+(reference: src/formats/*.hpp; base classes formats/base.hpp:91-155).
+Each codec here is a small object with
 
-- ``header_size`` / ``pack(desc) -> bytes`` / ``unpack(buf) -> desc``
-- ``frame_layout(desc)``: how one time-step (all sources) lays out in
-  the ring, used by the capture engine's scatter
+- ``header_size``
+- ``pack(desc, framecount=0) -> bytes`` — mirrors the reference
+  *HeaderFiller* byte-for-byte (so transmitted packets are accepted by
+  reference/real receivers)
+- ``unpack(buf) -> PacketDesc | None`` — mirrors the reference
+  *Decoder* field-for-field (so real recorded packets decode
+  identically); returns None where the reference's frame-size /
+  validity gates reject the packet outright
 
-'simple' matches the reference wire format exactly (u64 big-endian
-sequence number + raw payload, reference: src/formats/simple.hpp:33-35).
-'chips', 'tbn', 'drx' and 'pbeam' carry the same header fields as their
-reference namesakes (seq/timestamp, source id, channel info) in a
-documented big-endian layout.
+Wire-convention notes (all faithful to the reference):
+
+- LWA-style formats (tbn/drx/drx8/tbf/cor) carry a little-endian
+  ``sync_word`` 0x5CDEC0DE followed by big-endian fields; frame sizes
+  are fixed (TBN 1048, DRX 4128, DRX8 8224 bytes) and enforced
+  (reference: tbn.hpp:33, drx.hpp:33, drx8.hpp:33 — the reference's
+  drx8 decoder compares against DRX_FRAME_SIZE, an apparent bug; we
+  use the intended DRX8_FRAME_SIZE).
+- chips/ibeam wire sequence numbers are 1-based; decoders subtract 1
+  (chips.hpp:64, ibeam.hpp:73) while fillers write the caller's value
+  verbatim — pack/unpack therefore round-trip to ``seq - 1``, exactly
+  like the reference pair.
+- pbeam's decoder composes ``src = beam*nserver + (server-1)`` from the
+  1-based wire beam (pbeam.hpp:76); its filler writes
+  ``beam = src/nserver + 1`` — the reference pair round-trips with a
+  +nserver offset absorbed by the capture ``src0``; we mirror both
+  sides exactly.
 """
 
 from __future__ import annotations
 
+import math
 import struct
 
 import numpy as np
 
 __all__ = ['PacketDesc', 'get_format', 'register_format', 'FORMATS']
 
+SYNC_WORD = 0x5CDEC0DE
+
+TBN_FRAME_SIZE = 1048     # reference: tbn.hpp:33
+DRX_FRAME_SIZE = 4128     # reference: drx.hpp:33
+DRX8_FRAME_SIZE = 8224    # reference: drx8.hpp:33
+
 
 class PacketDesc(object):
     """Decoded packet metadata (reference: formats/base.hpp PacketDesc)."""
 
     __slots__ = ('seq', 'src', 'nsrc', 'chan0', 'nchan', 'time_tag',
-                 'tuning', 'gain', 'decimation', 'payload', 'payload_size')
+                 'tuning', 'tuning1', 'gain', 'decimation', 'beam',
+                 'valid_mode', 'sync', 'nchan_tot', 'npol', 'npol_tot',
+                 'pol0', 'payload', 'payload_size')
 
     def __init__(self, seq=0, src=0, nsrc=1, chan0=0, nchan=1, time_tag=0,
-                 tuning=0, gain=0, decimation=1, payload=b''):
+                 tuning=0, tuning1=0, gain=0, decimation=1, beam=0,
+                 valid_mode=0, sync=0, nchan_tot=0, npol=0, npol_tot=0,
+                 pol0=0, payload=b''):
         self.seq = seq
         self.src = src
         self.nsrc = nsrc
@@ -40,8 +68,16 @@ class PacketDesc(object):
         self.nchan = nchan
         self.time_tag = time_tag
         self.tuning = tuning
+        self.tuning1 = tuning1
         self.gain = gain
         self.decimation = decimation
+        self.beam = beam
+        self.valid_mode = valid_mode
+        self.sync = sync
+        self.nchan_tot = nchan_tot
+        self.npol = npol
+        self.npol_tot = npol_tot
+        self.pol0 = pol0
         self.payload = payload
         self.payload_size = len(payload)
 
@@ -54,7 +90,7 @@ class _FormatBase(object):
     def header_size(self):
         return self.header_struct.size
 
-    def pack(self, desc):
+    def pack(self, desc, framecount=0):
         raise NotImplementedError
 
     def unpack(self, buf):
@@ -62,12 +98,12 @@ class _FormatBase(object):
 
 
 class SimpleFormat(_FormatBase):
-    """u64be seq + payload (reference: src/formats/simple.hpp:33-62)."""
+    """u64be seq + payload (reference: src/formats/simple.hpp:33-93)."""
 
     name = 'simple'
     header_struct = struct.Struct('>Q')
 
-    def pack(self, desc):
+    def pack(self, desc, framecount=0):
         return self.header_struct.pack(desc.seq) + bytes(desc.payload)
 
     def unpack(self, buf):
@@ -79,270 +115,494 @@ class SimpleFormat(_FormatBase):
 
 
 class ChipsFormat(_FormatBase):
-    """F-engine channelized voltages: one packet per (seq, roach).
-    Header: u64be seq, u8 src, u8 nsrc, u16be nchan, u16be chan0, u16be
-    pad (fields of reference src/formats/chips.hpp's chips_hdr_type)."""
+    """CHIPS F-engine packets (reference: src/formats/chips.hpp:33-43).
+
+    Wire header (14 bytes, packed): u8 roach (1-based), u8 gbe/tuning,
+    u8 nchan, u8 nsubband, u8 subband, u8 nroach, u16be chan0,
+    u64be seq (1-based)."""
 
     name = 'chips'
-    header_struct = struct.Struct('>QBBHHH')
+    header_struct = struct.Struct('>BBBBBBHQ')
 
-    def pack(self, desc):
-        return self.header_struct.pack(desc.seq, desc.src, desc.nsrc,
-                                       desc.nchan, desc.chan0, 0) + \
-            bytes(desc.payload)
+    def pack(self, desc, framecount=0):
+        # mirror CHIPSHeaderFiller (chips.hpp:169-183)
+        return self.header_struct.pack(
+            (desc.src + 1) & 0xFF, desc.tuning & 0xFF, desc.nchan & 0xFF,
+            1, 0, desc.nsrc & 0xFF, desc.chan0 & 0xFFFF,
+            desc.seq) + bytes(desc.payload)
 
     def unpack(self, buf):
+        # mirror CHIPSDecoder (chips.hpp:55-73)
         if len(buf) < self.header_size:
             return None
-        seq, src, nsrc, nchan, chan0, _ = \
+        roach, gbe, nchan, _nsub, _sub, nroach, chan0, seq = \
             self.header_struct.unpack_from(buf)
-        return PacketDesc(seq=seq, src=src, nsrc=nsrc, nchan=nchan,
-                          chan0=chan0, payload=buf[self.header_size:])
+        return PacketDesc(seq=seq - 1, src=roach - 1, nsrc=nroach,
+                          tuning=gbe, nchan=nchan, chan0=chan0,
+                          payload=buf[self.header_size:])
 
 
 class PBeamFormat(_FormatBase):
-    """Power-beam spectra. Header: u64be timestamp (=seq), u8 beam (src),
-    u8 nbeam, u16be nchan, u16be chan0, u16be navg (fields of reference
-    src/formats/pbeam.hpp)."""
+    """Power-beam spectra (reference: src/formats/pbeam.hpp:33-46).
+
+    Wire header (18 bytes, packed): u8 server (1-based), u8 beam
+    (1-based), u8 gbe, u8 nchan, u8 nbeam, u8 nserver, u16be navg,
+    u16be chan0, u64be seq (a timestamp; decoder seq = wire_seq/navg)."""
 
     name = 'pbeam'
-    header_struct = struct.Struct('>QBBHHH')
+    header_struct = struct.Struct('>BBBBBBHHQ')
 
-    def pack(self, desc):
-        return self.header_struct.pack(desc.seq, desc.src, desc.nsrc,
-                                       desc.nchan, desc.chan0,
-                                       desc.decimation) + \
-            bytes(desc.payload)
+    def __init__(self, nbeam=1):
+        self.nbeam = nbeam
+
+    def pack(self, desc, framecount=0):
+        # mirror PBeamHeaderFiller (pbeam.hpp:126-147)
+        nserver = max(desc.nsrc // self.nbeam, 1)
+        server = (desc.src % nserver) + 1
+        beam = (desc.src // nserver) + 1
+        return self.header_struct.pack(
+            server & 0xFF, beam & 0xFF, desc.tuning & 0xFF,
+            desc.nchan & 0xFF, self.nbeam & 0xFF, nserver & 0xFF,
+            desc.decimation & 0xFFFF, desc.chan0 & 0xFFFF,
+            desc.seq) + bytes(desc.payload)
 
     def unpack(self, buf):
+        # mirror PBeamDecoder (pbeam.hpp:58-84)
         if len(buf) < self.header_size:
             return None
-        seq, src, nsrc, nchan, chan0, navg = \
+        server, beam, gbe, nchan, nbeam, nserver, navg, chan0, wseq = \
             self.header_struct.unpack_from(buf)
-        return PacketDesc(seq=seq, src=src, nsrc=nsrc, nchan=nchan,
-                          chan0=chan0, decimation=navg,
+        navg = max(navg, 1)
+        src = beam * max(nserver, 1) + (server - 1)
+        return PacketDesc(seq=wseq // navg, time_tag=wseq,
+                          decimation=navg, src=src, beam=nbeam,
+                          tuning=gbe, nchan=nchan,
+                          chan0=chan0 - nchan * src,
                           payload=buf[self.header_size:])
 
 
 class TbnFormat(_FormatBase):
-    """LWA TBN-style raw voltages: u64be time_tag, u32be tuning, u16be
-    id (src+flags), u16be gain (fields of reference
-    src/formats/tbn.hpp:35-41).  seq = time_tag // (512 * decimation)."""
+    """LWA TBN frames, 1048 bytes total (reference: src/formats/tbn.hpp).
+
+    Wire header (24 bytes, packed): u32le sync 0x5CDEC0DE, u32be
+    frame_count, u32be tuning_word, u16be tbn_id (1-based stand |
+    flags), u16be gain, u64be time_tag.  Payload: 512 ci8 samples
+    (1024 bytes).  seq = time_tag // decimation // 512 with the
+    decimation learned stream-side (reference: TBNCache) — here a
+    constructor parameter."""
 
     name = 'tbn'
-    header_struct = struct.Struct('>QIHH')
-    seq_quantum = 512   # samples per packet timestamp step
+    header_struct = struct.Struct('<I')
+    _rest = struct.Struct('>IIHHQ')
+    seq_quantum = 512
 
     def __init__(self, decimation=1):
-        self.decimation = decimation
+        self.decimation = max(int(decimation), 1)
 
-    def pack(self, desc):
-        time_tag = desc.seq * self.seq_quantum * self.decimation
-        return self.header_struct.pack(time_tag, desc.tuning,
-                                       (desc.src + 1) & 0x3FFF,
-                                       desc.gain) + bytes(desc.payload)
+    @property
+    def header_size(self):
+        return self.header_struct.size + self._rest.size
+
+    def pack(self, desc, framecount=0):
+        # mirror TBNHeaderFiller (tbn.hpp:124-141)
+        return (self.header_struct.pack(SYNC_WORD) +
+                self._rest.pack(framecount & 0xFFFFFF, desc.tuning,
+                                (desc.src + 1) & 0x3FFF, desc.gain,
+                                desc.seq) +
+                bytes(desc.payload))
 
     def unpack(self, buf):
-        if len(buf) < self.header_size:
+        # mirror TBNDecoder (tbn.hpp:80-111); wire seq IS the time_tag
+        if len(buf) != TBN_FRAME_SIZE:
             return None
-        time_tag, tuning, tbn_id, gain = \
-            self.header_struct.unpack_from(buf)
+        (sync,) = self.header_struct.unpack_from(buf)
+        fcount, tuning, tbn_id, gain, time_tag = \
+            self._rest.unpack_from(buf, self.header_struct.size)
+        if sync != SYNC_WORD:
+            return None
         return PacketDesc(
-            seq=time_tag // (self.seq_quantum * self.decimation),
+            seq=time_tag // self.decimation // self.seq_quantum,
             src=(tbn_id & 1023) - 1, time_tag=time_tag, tuning=tuning,
-            gain=gain, nchan=1, payload=buf[self.header_size:])
+            gain=gain, valid_mode=(tbn_id >> 15) & 1,
+            decimation=self.decimation, sync=sync, nchan=1,
+            payload=buf[self.header_size:])
 
 
 class DrxFormat(_FormatBase):
-    """LWA DRX-style beam voltages: u64be time_tag, u32be tuning, u16be
-    id (beam/tuning/pol), u16be decimation (fields of reference
-    src/formats/drx.hpp)."""
+    """LWA DRX frames, 4128 bytes total (reference: src/formats/drx.hpp).
+
+    Wire header (32 bytes, packed): u32le sync, u8 id (beam 1-3 in bits
+    0-2, tuning 1-2 in bits 3-5, reserved bit 6, pol in bit 7), 3 bytes
+    frame count, u32be seconds, u16be decimation, u16be time_offset,
+    u64be time_tag, u32be tuning_word, u32be flags.  Payload: 4096 ci4
+    samples.  Decoded src = ((tuning-1) << 1) | pol;
+    seq = (time_tag - time_offset) // decimation // 4096."""
 
     name = 'drx'
-    header_struct = struct.Struct('>QIHH')
+    frame_size = DRX_FRAME_SIZE
+    npayload = 4096
+    header_struct = struct.Struct('<IB')
+    _rest = struct.Struct('>3sIHHQII')
     seq_quantum = 4096
 
-    def pack(self, desc):
-        time_tag = desc.seq * self.seq_quantum
-        return self.header_struct.pack(time_tag, desc.tuning,
-                                       desc.src & 0xFFFF,
-                                       desc.decimation) + \
-            bytes(desc.payload)
+    @property
+    def header_size(self):
+        return self.header_struct.size + self._rest.size
+
+    def pack(self, desc, framecount=0):
+        # mirror DRXHeaderFiller (drx.hpp:156-172): desc.src is the raw
+        # wire ID byte (bit 6 masked off)
+        return (self.header_struct.pack(SYNC_WORD, desc.src & 0xBF) +
+                self._rest.pack(b'\x00\x00\x00', 0,
+                                desc.decimation & 0xFFFF, 0, desc.seq,
+                                desc.tuning, 0) +
+                bytes(desc.payload))
 
     def unpack(self, buf):
-        if len(buf) < self.header_size:
+        # mirror DRXDecoder (drx.hpp:66-96)
+        if len(buf) != self.frame_size:
             return None
-        time_tag, tuning, drx_id, decim = \
-            self.header_struct.unpack_from(buf)
-        return PacketDesc(seq=time_tag // self.seq_quantum,
-                          src=drx_id & 0x7, time_tag=time_tag,
-                          tuning=tuning, decimation=decim, nchan=1,
+        sync, pkt_id = self.header_struct.unpack_from(buf)
+        _fc, _secs, decim, toff, time_tag, tuning_word, _flags = \
+            self._rest.unpack_from(buf, self.header_struct.size)
+        if sync != SYNC_WORD:
+            return None
+        beam = (pkt_id & 0x7) - 1
+        tune = ((pkt_id >> 3) & 0x7) - 1
+        pol = (pkt_id >> 7) & 0x1
+        src = (tune << 1) | pol
+        decim = max(decim, 1)
+        time_tag = time_tag - toff
+        desc = PacketDesc(seq=time_tag // decim // self.seq_quantum,
+                          src=src, beam=beam, time_tag=time_tag,
+                          decimation=decim, sync=sync,
+                          valid_mode=(pkt_id >> 6) & 0x1, nchan=1,
                           payload=buf[self.header_size:])
-
-
-class IBeamFormat(_FormatBase):
-    """Voltage-beam data carrying the same fields as the reference
-    ibeam decoder (seq, beam, nbeam, nchan, chan0) in a bespoke
-    big-endian layout — NOT wire-compatible with LWA ibeam packets:
-    u64be seq, u8 beam (src), u8 nbeam, u8 nserver, u8 server,
-    u16be nchan, u16be chan0."""
-
-    name = 'ibeam'
-    header_struct = struct.Struct('>QBBBBHH')
-
-    def pack(self, desc):
-        return self.header_struct.pack(desc.seq, desc.src, desc.nsrc,
-                                       1, 1, desc.nchan, desc.chan0) + \
-            bytes(desc.payload)
-
-    def unpack(self, buf):
-        if len(buf) < self.header_size:
-            return None
-        seq, src, nsrc, _, _, nchan, chan0 = \
-            self.header_struct.unpack_from(buf)
-        return PacketDesc(seq=seq, src=src, nsrc=nsrc, nchan=nchan,
-                          chan0=chan0, payload=buf[self.header_size:])
-
-
-class CorFormat(_FormatBase):
-    """Correlator (visibility) packets carrying the same fields as the
-    reference cor decoder in a bespoke big-endian layout — NOT
-    wire-compatible with LWA COR packets: u64be time_tag, u32be tuning,
-    u16be baseline id (src), u16be navg, u16be nchan, u16be chan0."""
-
-    name = 'cor'
-    header_struct = struct.Struct('>QIHHHH')
-
-    def pack(self, desc):
-        return self.header_struct.pack(desc.seq, desc.tuning, desc.src,
-                                       desc.decimation, desc.nchan,
-                                       desc.chan0) + bytes(desc.payload)
-
-    def unpack(self, buf):
-        if len(buf) < self.header_size:
-            return None
-        seq, tuning, src, navg, nchan, chan0 = \
-            self.header_struct.unpack_from(buf)
-        return PacketDesc(seq=seq, src=src, tuning=tuning,
-                          decimation=navg, nchan=nchan, chan0=chan0,
-                          payload=buf[self.header_size:])
-
-
-class Snap2Format(_FormatBase):
-    """SNAP2-style F-engine packets carrying the same fields as the
-    reference snap2 decoder in a bespoke big-endian layout — NOT
-    wire-compatible with real SNAP2 boards: u64be seq, u16be nchan,
-    u16be chan0, u16be src (antenna group), u16be nsrc."""
-
-    name = 'snap2'
-    header_struct = struct.Struct('>QHHHH')
-
-    def pack(self, desc):
-        return self.header_struct.pack(desc.seq, desc.nchan, desc.chan0,
-                                       desc.src, desc.nsrc) + \
-            bytes(desc.payload)
-
-    def unpack(self, buf):
-        if len(buf) < self.header_size:
-            return None
-        seq, nchan, chan0, src, nsrc = \
-            self.header_struct.unpack_from(buf)
-        return PacketDesc(seq=seq, src=src, nsrc=nsrc, nchan=nchan,
-                          chan0=chan0, payload=buf[self.header_size:])
-
-
-class VdifFormat(_FormatBase):
-    """VDIF (VLBI Data Interchange Format) frames, non-legacy 32-byte
-    header (public VDIF spec; reference: src/formats/vdif.hpp).
-    Little-endian words: w0 = invalid(b31)|legacy(b30)|seconds (30b),
-    w1 = ref-epoch(6b)<<24 | frame-number(24b), w2 =
-    version/log2chan/frame-length, w3 = thread_id (bits 16-25) |
-    station_id (bits 0-15).  seq is derived as
-    seconds * frames_per_second + frame_number; src is the thread_id.
-    Legacy (16-byte-header) and invalid-flagged frames are rejected."""
-
-    name = 'vdif'
-    header_struct = struct.Struct('<8I')
-    frames_per_second = 25600
-
-    def pack(self, desc):
-        secs = desc.seq // self.frames_per_second
-        fnum = desc.seq % self.frames_per_second
-        frame_len8 = (self.header_size + len(desc.payload)) // 8
-        w0 = secs & 0x3FFFFFFF
-        w1 = fnum & 0xFFFFFF
-        w2 = frame_len8 & 0xFFFFFF
-        w3 = (desc.src & 0x3FF) << 16     # thread_id field
-        return self.header_struct.pack(w0, w1, w2, w3, 0, 0, 0, 0) + \
-            bytes(desc.payload)
-
-    def unpack(self, buf):
-        if len(buf) < self.header_size:
-            return None
-        w = self.header_struct.unpack_from(buf)
-        if w[0] & 0x80000000:   # invalid flag
-            return None
-        if w[0] & 0x40000000:   # legacy 16-byte header: unsupported
-            return None
-        secs = w[0] & 0x3FFFFFFF
-        fnum = w[1] & 0xFFFFFF
-        src = (w[3] >> 16) & 0x3FF        # thread_id
-        return PacketDesc(seq=secs * self.frames_per_second + fnum,
-                          src=src, time_tag=secs,
-                          payload=buf[self.header_size:])
-
-
-class TbfFormat(_FormatBase):
-    """TBF-style buffered-voltage frames carrying the same fields as
-    the reference tbf decoder in a bespoke big-endian layout — NOT
-    wire-compatible with LWA TBF (no sync word): u64be time_tag,
-    u16be nstand-id (src), u16be nchan, u16be chan0, u16be pad."""
-
-    name = 'tbf'
-    header_struct = struct.Struct('>QHHHH')
-    seq_quantum = 1
-
-    def pack(self, desc):
-        return self.header_struct.pack(desc.seq, desc.src, desc.nchan,
-                                       desc.chan0, 0) + \
-            bytes(desc.payload)
-
-    def unpack(self, buf):
-        if len(buf) < self.header_size:
-            return None
-        seq, src, nchan, chan0, _ = self.header_struct.unpack_from(buf)
-        return PacketDesc(seq=seq, src=src, nchan=nchan, chan0=chan0,
-                          payload=buf[self.header_size:])
+        if src // 2 == 0:
+            desc.tuning = tuning_word
+        else:
+            desc.tuning1 = tuning_word
+        return desc
 
 
 class Drx8Format(DrxFormat):
-    """DRX with 8+8-bit complex samples (reference: src/formats/drx8.hpp)
-    — same header as drx, wider payload samples."""
+    """DRX with 8+8-bit samples, 8224 bytes total (reference:
+    src/formats/drx8.hpp; the reference decoder's size gate references
+    DRX_FRAME_SIZE — an apparent bug — we use the intended 8224)."""
 
     name = 'drx8'
+    frame_size = DRX8_FRAME_SIZE
+    npayload = 8192
 
 
-class VBeamFormat(_FormatBase):
-    """Voltage-beam frames carrying the same fields as the reference
-    vbeam decoder in a bespoke big-endian layout — NOT wire-compatible:
-    u64be time_tag, u32be tuning, u16be beam (src), u16be nchan,
-    u16be chan0, u16be pad."""
+class IBeamFormat(_FormatBase):
+    """LWA ibeam voltage-beam packets (reference: src/formats/ibeam.hpp:33-41).
 
-    name = 'vbeam'
-    header_struct = struct.Struct('>QIHHHH')
+    Wire header (13 bytes, packed): u8 server (1-based), u8 gbe,
+    u8 nchan, u8 nbeam, u8 nserver, u16be chan0 (global: logical chan0
+    + nchan*src), u64be seq (1-based)."""
 
-    def pack(self, desc):
-        return self.header_struct.pack(desc.seq, desc.tuning, desc.src,
-                                       desc.nchan, desc.chan0, 0) + \
+    name = 'ibeam'
+    header_struct = struct.Struct('>BBBBBHQ')
+
+    def __init__(self, nbeam=1):
+        self.nbeam = nbeam
+
+    def pack(self, desc, framecount=0):
+        # mirror IBeamHeaderFiller (ibeam.hpp:92-109); wire chan0 is the
+        # *global* first channel, reconstructed from the logical chan0
+        wire_chan0 = (desc.chan0 + desc.nchan * desc.src) & 0xFFFF
+        return self.header_struct.pack(
+            (desc.src + 1) & 0xFF, desc.tuning & 0xFF, desc.nchan & 0xFF,
+            self.nbeam & 0xFF, desc.nsrc & 0xFF, wire_chan0,
+            desc.seq + 1) + bytes(desc.payload)
+
+    def unpack(self, buf):
+        # mirror IBeamDecoder (ibeam.hpp:56-81)
+        if len(buf) < self.header_size:
+            return None
+        server, gbe, nchan, nbeam, nserver, chan0, seq = \
+            self.header_struct.unpack_from(buf)
+        src = server - 1
+        return PacketDesc(seq=seq - 1, src=src, nsrc=nserver, beam=nbeam,
+                          tuning=gbe, nchan=nchan,
+                          chan0=chan0 - nchan * src,
+                          payload=buf[self.header_size:])
+
+
+class CorFormat(_FormatBase):
+    """LWA COR visibility packets (reference: src/formats/cor.hpp:33-44).
+
+    Wire header (32 bytes, packed): u32le sync, u32be frame_count_word
+    (flag 0x02 in bits 24-31; nchan_decim / nserver / server in bits
+    16-23 / 8-15 / 0-7), u32be second_count, u16be first_chan, u16be
+    gain, u64be time_tag, u32be navg, u16be stand0 (1-based), u16be
+    stand1 (1-based).  Decoded src enumerates (baseline, server);
+    seq = time_tag // 196e6 // (navg/100)."""
+
+    name = 'cor'
+    header_struct = struct.Struct('<I')
+    _rest = struct.Struct('>IIHHQIHH')
+
+    def __init__(self, nsrc=1):
+        # total number of (baseline, server) sources; sets the stand
+        # count used to (de)compose baseline indices, like the
+        # reference's decoder nsrc (cor.hpp:74)
+        self.nsrc = max(int(nsrc), 1)
+
+    @property
+    def header_size(self):
+        return self.header_struct.size + self._rest.size
+
+    def _nserver_of(self, tuning):
+        return max((tuning >> 8) & 0xFF, 1)
+
+    def pack(self, desc, framecount=0):
+        # mirror CORHeaderFiller (cor.hpp:117-146): recover the stand
+        # pair from the flat baseline index
+        n = int((math.isqrt(8 * desc.nsrc + 1) - 1) // 2)
+        b = 2 + 2 * (n - 1) + 1
+        stand0 = int((b - math.sqrt(b * b - 8 * desc.src)) / 2)
+        stand1 = desc.src - stand0 * (2 * (n - 1) + 1 - stand0) // 2
+        fcw = (0x02 << 24) | (desc.tuning & 0xFFFFFF)
+        return (self.header_struct.pack(SYNC_WORD) +
+                self._rest.pack(fcw, 0, desc.chan0 & 0xFFFF, desc.gain,
+                                desc.seq, desc.decimation,
+                                (stand0 + 1) & 0xFFFF,
+                                (stand1 + 1) & 0xFFFF) +
+                bytes(desc.payload))
+
+    def unpack(self, buf):
+        # mirror CORDecoder (cor.hpp:62-97)
+        if len(buf) < self.header_size:
+            return None
+        (sync,) = self.header_struct.unpack_from(buf)
+        fcw, _secs, first_chan, gain, time_tag, navg, stand0, stand1 = \
+            self._rest.unpack_from(buf, self.header_struct.size)
+        if sync != SYNC_WORD:
+            return None
+        pld = buf[self.header_size:]
+        nchan_decim = (fcw >> 16) & 0xFF
+        nserver = max((fcw >> 8) & 0xFF, 1)
+        server = fcw & 0xFF
+        nchan_pkt = len(pld) // (8 * 4)
+        stand0, stand1 = stand0 - 1, stand1 - 1
+        nstand = int((math.isqrt(8 * self.nsrc // nserver + 1) - 1) // 2)
+        navg = max(navg, 1)
+        src = (stand0 * (2 * (nstand - 1) + 1 - stand0) // 2 +
+               stand1 + 1) * nserver + (server - 1)
+        return PacketDesc(
+            seq=time_tag // 196000000 // max(navg // 100, 1),
+            time_tag=time_tag, decimation=navg, src=src,
+            nsrc=self.nsrc, nchan=nchan_pkt,
+            chan0=first_chan - nchan_decim * nchan_pkt * (server - 1),
+            tuning=(nserver << 8) | max(server - 1, 0), gain=gain,
+            sync=sync, payload=pld)
+
+
+class Snap2Format(_FormatBase):
+    """SNAP2 F-engine packets (reference: src/formats/snap2.hpp:50-60).
+
+    Wire header (28 bytes, packed, big-endian as read by the decoder's
+    be*toh calls): u64 seq, u32 sync_time, u16 npol, u16 npol_tot,
+    u16 nchan, u16 nchan_tot, u32 chan_block_id, u32 chan0, u32 pol0.
+    Decoded src = pol0//npol + chan_block_id*npol_blocks.  (The
+    reference *filler* stores its fields without byte swaps —
+    inconsistent with its own decoder; we pack decoder-readably.)"""
+
+    name = 'snap2'
+    header_struct = struct.Struct('>QIHHHHIII')
+
+    def pack(self, desc, framecount=0):
+        npol = desc.npol or 2
+        npol_tot = desc.npol_tot or npol
+        nchan_tot = desc.nchan_tot or desc.nchan * desc.nsrc
+        return self.header_struct.pack(
+            desc.seq, desc.time_tag & 0xFFFFFFFF, npol, npol_tot,
+            desc.nchan, nchan_tot, desc.src, desc.chan0, desc.pol0) + \
             bytes(desc.payload)
+
+    def unpack(self, buf):
+        # mirror SNAP2Decoder (snap2.hpp:70-103)
+        if len(buf) < self.header_size:
+            return None
+        seq, sync_time, npol, npol_tot, nchan, nchan_tot, \
+            chan_block_id, chan0, pol0 = self.header_struct.unpack_from(buf)
+        npol = max(npol, 1)
+        nchan = max(nchan, 1)
+        npol_blocks = max(npol_tot // npol, 1)
+        nchan_blocks = max(nchan_tot // nchan, 1)
+        return PacketDesc(
+            seq=seq, time_tag=sync_time, tuning=chan0,
+            nsrc=npol_blocks * nchan_blocks, nchan=nchan,
+            chan0=chan_block_id * nchan, nchan_tot=nchan_tot,
+            npol=npol, npol_tot=npol_tot, pol0=pol0,
+            src=pol0 // npol + chan_block_id * npol_blocks,
+            payload=buf[self.header_size:])
+
+
+class VdifFormat(_FormatBase):
+    """VDIF frames (public VDIF spec; reference: src/formats/vdif.hpp).
+
+    16-byte base header of little-endian 32-bit words with LSB-first
+    bitfields; non-legacy frames carry a 16-byte extended header before
+    the payload.
+      w0: seconds(30) | legacy(1) | invalid(1)
+      w1: frame_in_second(24) | ref_epoch(6) | unassigned(2)
+      w2: frame_length/8(24) | log2_nchan(5) | version(3)
+      w3: station_id(16) | thread_id(10) | bits/sample-1(5) | complex(1)
+    seq = seconds * frames_per_second + frame_in_second (the reference
+    learns frames_per_second stream-side via VDIFCache; constructor
+    parameter here); src = thread_id."""
+
+    name = 'vdif'
+    header_struct = struct.Struct('<4I')
+    ext_struct = struct.Struct('<4I')
+
+    def __init__(self, frames_per_second=25600, legacy=False,
+                 log2_nchan=0, nbit=8, is_complex=True, station_id=0,
+                 ref_epoch=0):
+        self.frames_per_second = frames_per_second
+        self.legacy = legacy
+        self.log2_nchan = log2_nchan
+        self.nbit = nbit
+        self.is_complex = is_complex
+        self.station_id = station_id
+        self.ref_epoch = ref_epoch
+
+    @property
+    def header_size(self):
+        # non-legacy frames carry the 16-byte extended header too; this
+        # must match pack()'s framing so fixed-record disk streams of
+        # VDIF frames read back aligned (packet_capture DiskReader sizes
+        # records as header_size + payload)
+        if self.legacy:
+            return self.header_struct.size
+        return self.header_struct.size + self.ext_struct.size
+
+    def pack(self, desc, framecount=0):
+        secs = desc.seq // self.frames_per_second
+        fnum = desc.seq % self.frames_per_second
+        hdr_len = 16 if self.legacy else 32
+        frame_len8 = (hdr_len + len(desc.payload)) // 8
+        w0 = (secs & 0x3FFFFFFF) | ((1 << 30) if self.legacy else 0)
+        w1 = (fnum & 0xFFFFFF) | ((self.ref_epoch & 0x3F) << 24)
+        w2 = (frame_len8 & 0xFFFFFF) | ((self.log2_nchan & 0x1F) << 24)
+        w3 = (self.station_id & 0xFFFF) | ((desc.src & 0x3FF) << 16) | \
+            (((self.nbit - 1) & 0x1F) << 26) | \
+            ((1 << 31) if self.is_complex else 0)
+        out = self.header_struct.pack(w0, w1, w2, w3)
+        if not self.legacy:
+            out += self.ext_struct.pack(0, 0, 0, 0)
+        return out + bytes(desc.payload)
+
+    def unpack(self, buf):
+        # mirror VDIFDecoder (vdif.hpp:119-168)
+        if len(buf) < self.header_struct.size:
+            return None
+        w0, w1, w2, w3 = self.header_struct.unpack_from(buf)
+        if w0 & 0x80000000:           # invalid flag
+            return None
+        legacy = (w0 >> 30) & 1
+        off = self.header_struct.size
+        if not legacy:
+            off += self.ext_struct.size
+            if len(buf) < off:
+                return None
+        secs = w0 & 0x3FFFFFFF
+        fnum = w1 & 0xFFFFFF
+        ref_epoch = (w1 >> 24) & 0x3F
+        log2_nchan = (w2 >> 24) & 0x1F
+        thread_id = (w3 >> 16) & 0x3FF
+        nbit = ((w3 >> 26) & 0x1F) + 1
+        is_complex = (w3 >> 31) & 1
+        pld = buf[off:]
+        return PacketDesc(
+            seq=secs * self.frames_per_second + fnum,
+            time_tag=secs, src=thread_id,
+            chan0=1 << log2_nchan, nchan=len(pld) // 8,
+            tuning=(ref_epoch << 16) | (nbit << 8) | is_complex,
+            payload=pld)
+
+
+class TbfFormat(_FormatBase):
+    """LWA TBF buffered-voltage frames (reference: src/formats/tbf.hpp
+    — header-filler only in the reference; decode inverts it).
+
+    Wire header (24 bytes, packed): u32le sync, u32be frame_count_word
+    (TBF flag 0x01 in bits 24-31), u32be seconds_count, u16be
+    first_chan, u16be nstand, u64be time_tag."""
+
+    name = 'tbf'
+    header_struct = struct.Struct('<I')
+    _rest = struct.Struct('>IIHHQ')
+
+    @property
+    def header_size(self):
+        return self.header_struct.size + self._rest.size
+
+    def pack(self, desc, framecount=0):
+        # mirror TBFHeaderFiller (tbf.hpp:42-59): 'src' rides first_chan
+        fcw = (0x01 << 24) | (framecount & 0xFFFFFF)
+        return (self.header_struct.pack(SYNC_WORD) +
+                self._rest.pack(fcw, 0, desc.src & 0xFFFF,
+                                desc.nsrc & 0xFFFF, desc.seq) +
+                bytes(desc.payload))
 
     def unpack(self, buf):
         if len(buf) < self.header_size:
             return None
-        seq, tuning, src, nchan, chan0, _ = \
-            self.header_struct.unpack_from(buf)
-        return PacketDesc(seq=seq, src=src, tuning=tuning, nchan=nchan,
-                          chan0=chan0, payload=buf[self.header_size:])
+        (sync,) = self.header_struct.unpack_from(buf)
+        fcw, _secs, first_chan, nstand, time_tag = \
+            self._rest.unpack_from(buf, self.header_struct.size)
+        if sync != SYNC_WORD:
+            return None
+        return PacketDesc(seq=time_tag, time_tag=time_tag,
+                          src=first_chan, nsrc=nstand, sync=sync,
+                          payload=buf[self.header_size:])
+
+
+class VBeamFormat(_FormatBase):
+    """Voltage-beam frames (reference: src/formats/vbeam.hpp — header
+    filler only; the reference fills sync_word + time_tag and zeroes
+    the rest).
+
+    Wire header (52 bytes, packed): u64le sync 0xAABBCCDD00000000,
+    u64le sync_time, u64be time_tag, f64le bw_hz, f64le sfreq,
+    u32le nchan, u32le chan0, u32le npol."""
+
+    name = 'vbeam'
+    SYNC = 0xAABBCCDD00000000
+    header_struct = struct.Struct('<QQ')
+    _mid = struct.Struct('>Q')
+    _tail = struct.Struct('<ddIII')
+
+    @property
+    def header_size(self):
+        return (self.header_struct.size + self._mid.size +
+                self._tail.size)
+
+    def pack(self, desc, framecount=0):
+        # mirror VBeamHeaderFiller (vbeam.hpp:44-57) + populate the
+        # descriptive fields the reference leaves zeroed
+        return (self.header_struct.pack(self.SYNC, desc.time_tag) +
+                self._mid.pack(desc.seq) +
+                self._tail.pack(0.0, 0.0, desc.nchan, desc.chan0,
+                                desc.npol) +
+                bytes(desc.payload))
+
+    def unpack(self, buf):
+        if len(buf) < self.header_size:
+            return None
+        sync, sync_time = self.header_struct.unpack_from(buf)
+        (time_tag,) = self._mid.unpack_from(buf, self.header_struct.size)
+        _bw, _sfreq, nchan, chan0, npol = self._tail.unpack_from(
+            buf, self.header_struct.size + self._mid.size)
+        if sync != self.SYNC:
+            return None
+        return PacketDesc(seq=time_tag, time_tag=sync_time,
+                          nchan=max(nchan, 1), chan0=chan0, npol=npol,
+                          payload=buf[self.header_size:])
 
 
 FORMATS = {}
@@ -360,13 +620,16 @@ for _f in (SimpleFormat, ChipsFormat, PBeamFormat, TbnFormat, DrxFormat,
     register_format(_f)
 
 
-def get_format(fmt):
+def get_format(fmt, **kwargs):
     """Look up a format; accepts 'chips', 'chips_64' (with a parameter
-    suffix, ignored here), or a format object."""
+    suffix, ignored here), or a format object.  Keyword arguments build
+    a fresh parameterized instance (e.g. get_format('cor', nsrc=184))."""
     if not isinstance(fmt, str):
         return fmt
     base = fmt.split('_')[0]
     if base not in FORMATS:
         raise KeyError("Unknown packet format: %r (known: %s)"
                        % (fmt, sorted(FORMATS)))
+    if kwargs:
+        return type(FORMATS[base])(**kwargs)
     return FORMATS[base]

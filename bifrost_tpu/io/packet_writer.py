@@ -104,7 +104,10 @@ class _WriterBase(object):
                     decimation=headerinfo.decimation,
                     payload=payloads[i, j].tobytes())
                 self.limiter.wait()
-                self._send_bytes(self.fmt.pack(desc))
+                # frame counter rides the wire frame_count_word where the
+                # format has one (reference: packet_writer.hpp framecount)
+                self._send_bytes(self.fmt.pack(
+                    desc, framecount=self.npackets_sent))
                 self.npackets_sent += 1
 
     def __enter__(self):

@@ -176,7 +176,9 @@ class SigprocFile(object):
             data = raw.view(dtype)
         else:
             per = 8 // nbits
-            shifts = (np.arange(per) * nbits)[::-1].astype(np.uint8)
+            # LSB-first sample order within each byte (reference:
+            # python/bifrost/sigproc.py:281 'assumes LSB-first')
+            shifts = (np.arange(per) * nbits).astype(np.uint8)
             vals = (raw[:, None] >> shifts) & ((1 << nbits) - 1)
             vals = vals.reshape(-1)
             if signed:

@@ -129,7 +129,8 @@ def _to_logical(buf, dtype):
         b = buf.view(np.uint8)
         per = 8 // nbits
         shifts = np.arange(per, dtype=np.uint8) * nbits
-        vals = (b[..., None] >> shifts[::-1]) & ((1 << nbits) - 1)
+        # LSB-first sample order (reference bfUnpack convention)
+        vals = (b[..., None] >> shifts) & ((1 << nbits) - 1)
         vals = vals.reshape(buf.shape[:-1] + (-1,))
         if dtype.kind == 'i':
             vals = (vals.astype(np.int8) << (8 - nbits)) >> (8 - nbits)

@@ -84,7 +84,9 @@ def _pack_into(vals, dtype, out_buf):
         per = 8 // nbits
         v = np.asarray(vals).astype(np.int64) & ((1 << nbits) - 1)
         v = v.reshape(v.shape[:-1] + (v.shape[-1] // per, per))
-        shifts = (np.arange(per) * nbits)[::-1]
+        # LSB-first: sample k lands in bits [k*nbits, (k+1)*nbits)
+        # (reference bfUnpack/bfQuantize convention)
+        shifts = np.arange(per) * nbits
         packed = np.bitwise_or.reduce(v << shifts, axis=-1).astype(np.uint8)
         out_buf[...] = packed.reshape(out_buf.shape)
         return

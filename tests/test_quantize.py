@@ -64,3 +64,50 @@ def test_quantize_device_path():
     ops.quantize(x, dst, scale=50.)
     expect = np.clip(np.round(np.linspace(-2, 2, 16) * 50), -128, 127)
     np.testing.assert_array_equal(np.asarray(dst.data), expect)
+
+
+def test_subbyte_bit_order_is_lsb_first():
+    """Sample k lives in bits [k*nbits, (k+1)*nbits) of each byte — the
+    reference convention (python/bifrost/sigproc.py:281 'assumes
+    LSB-first ordering', bfUnpack).  Fixture bytes are hand-derived, so
+    an MSB-first regression cannot cancel out in a round trip."""
+    import numpy as np
+    from bifrost_tpu.ops.map import _to_logical
+    from bifrost_tpu.ops.quantize import _pack_into
+    from bifrost_tpu.dtype import DataType
+
+    # u2: byte 0xE4 = 0b11100100 -> samples [0, 1, 2, 3]
+    vals = _to_logical(np.array([0xE4], np.uint8), DataType('u2'))
+    np.testing.assert_array_equal(vals, [0, 1, 2, 3])
+    out = np.zeros(1, np.uint8)
+    _pack_into(np.array([0, 1, 2, 3], np.uint8), DataType('u2'), out)
+    assert out[0] == 0xE4
+
+    # u4: byte 0xBA -> samples [0xA, 0xB]
+    vals = _to_logical(np.array([0xBA], np.uint8), DataType('u4'))
+    np.testing.assert_array_equal(vals, [0xA, 0xB])
+
+    # i4: byte 0xF7 -> low nibble 7, high nibble 0xF = -1
+    vals = _to_logical(np.array([0xF7], np.uint8), DataType('i4'))
+    np.testing.assert_array_equal(vals, [7, -1])
+
+    # u1: byte 0b00000101 -> first three samples 1, 0, 1
+    vals = _to_logical(np.array([0b00000101], np.uint8), DataType('u1'))
+    np.testing.assert_array_equal(vals[:3], [1, 0, 1])
+
+
+def test_sigproc_subbyte_read_lsb_first(tmp_path):
+    """2-bit SIGPROC file packed LSB-first reads back in order."""
+    import numpy as np
+    from bifrost_tpu.io.sigproc import SigprocFile
+    hdr = {'nbits': 2, 'nifs': 1, 'nchans': 4, 'data_type': 1,
+           'tsamp': 1e-3, 'fch1': 100.0, 'foff': -1.0, 'tstart': 50000.0}
+    from bifrost_tpu.io.sigproc import pack_header
+    path = str(tmp_path / 'lsb.fil')
+    with open(path, 'wb') as f:
+        f.write(pack_header(hdr))
+        # one frame of 4 chans [0,1,2,3] -> LSB-first byte 0xE4
+        f.write(bytes([0xE4]))
+    with SigprocFile(path) as r:
+        data = r.read(1)
+    np.testing.assert_array_equal(data.reshape(-1), [0, 1, 2, 3])

@@ -71,12 +71,13 @@ def test_udp_loopback_chips():
         with UDPTransmit('chips', tx_sock) as tx:
             # first packet opens the sequence; wait for the reader's
             # guarantee before streaming the rest
-            tx.send(hi, 0, 1, 0, 1, data[:1])
+            # chips wire sequence numbers are 1-based
+            tx.send(hi, 1, 1, 0, 1, data[:1])
             assert reader_attached.wait(30)
-            tx.send(hi, 1, 1, 0, 1, data[1:])
+            tx.send(hi, 2, 1, 0, 1, data[1:])
         pad = np.zeros((BUF_NTIME * 2, NSRC, PAYLOAD), np.uint8)
         with UDPTransmit('chips', tx_sock) as tx:
-            tx.send(hi, NSEQ, 1, 0, 1, pad)
+            tx.send(hi, NSEQ + 1, 1, 0, 1, pad)
 
     got = []
 
@@ -130,11 +131,11 @@ def test_udp_loopback_with_packet_loss():
                 for j in range(NSRC):
                     if i == 3 and j == 1:
                         continue
-                    tx.send(hi, i, 1, j, 1, data[i:i+1, j:j+1])
+                    tx.send(hi, i + 1, 1, j, 1, data[i:i+1, j:j+1])
                 if i == 0:
                     assert reader_attached.wait(30)
             pad = np.zeros((BUF_NTIME * 2, NSRC, PAYLOAD), np.uint8)
-            tx.send(hi, NSEQ, 1, 0, 1, pad)
+            tx.send(hi, NSEQ + 1, 1, 0, 1, pad)
 
     got = []
 
@@ -171,9 +172,9 @@ def test_disk_packet_roundtrip(tmp_path):
     hi.set_nsrc(NSRC)
     with open(path, 'wb') as f:
         with DiskWriter('chips', f) as dw:
-            dw.send(hi, 0, 1, 0, 1, data)
+            dw.send(hi, 1, 1, 0, 1, data)
             pad = np.zeros((BUF_NTIME * 2, NSRC, PAYLOAD), np.uint8)
-            dw.send(hi, NSEQ, 1, 0, 1, pad)
+            dw.send(hi, NSEQ + 1, 1, 0, 1, pad)
 
     ring = Ring(space='system', name='disk_rx')
     cb = PacketCaptureCallback()
@@ -194,25 +195,95 @@ def test_disk_packet_roundtrip(tmp_path):
 
 
 def test_format_roundtrips():
+    """pack -> unpack round trips under the reference wire conventions.
+
+    These complement tests/test_wire_formats.py's golden-bytes fixtures:
+    golden bytes prove the layouts; this proves the codec pairs compose
+    the way the reference decoder/filler pairs do (including their
+    1-based/derived-field conventions)."""
     from bifrost_tpu.io.packet_formats import get_format, PacketDesc
     payload = bytes(range(32))
-    for name in ('simple', 'chips', 'pbeam', 'tbn', 'drx',
-                 'ibeam', 'cor', 'snap2', 'vdif', 'tbf',
-                 'drx8', 'vbeam'):
-        fmt = get_format(name)
-        desc = PacketDesc(seq=1234, src=1, nsrc=4, chan0=32, nchan=16,
-                          tuning=77, gain=3, decimation=10,
-                          payload=payload)
-        pkt = fmt.pack(desc)
-        back = fmt.unpack(pkt)
-        assert back.seq == 1234, name
-        assert back.payload == payload, name
-        if name in ('chips', 'pbeam', 'ibeam', 'snap2', 'cor', 'tbf',
-                    'vbeam'):
-            assert back.src == 1 and back.chan0 == 32 and back.nchan == 16
-        if name in ('tbn', 'cor'):
-            assert back.src == 1 and back.tuning == 77 or name != 'tbn'
-        if name == 'tbn':
-            assert back.tuning == 77
-        if name == 'vdif':
-            assert back.src == 1
+
+    def rt(name, desc, **kwargs):
+        fmt = get_format(name, **kwargs) if kwargs else get_format(name)
+        return fmt.unpack(fmt.pack(desc))
+
+    back = rt('simple', PacketDesc(seq=1234, payload=payload))
+    assert back.seq == 1234 and back.payload == payload
+
+    # chips: wire seq is 1-based; filler writes the caller's value
+    # verbatim and the decoder subtracts 1 (chips.hpp:64,182)
+    back = rt('chips', PacketDesc(seq=1235, src=1, nsrc=4, chan0=32,
+                                  nchan=16, tuning=7, payload=payload))
+    assert back.seq == 1234 and back.src == 1 and back.chan0 == 32
+    assert back.nchan == 16 and back.nsrc == 4 and back.tuning == 7
+    assert back.payload == payload
+
+    # ibeam: codec adds/removes the 1-based wire offsets symmetrically
+    back = rt('ibeam', PacketDesc(seq=1234, src=1, nsrc=4, chan0=32,
+                                  nchan=16, payload=payload))
+    assert back.seq == 1234 and back.src == 1 and back.chan0 == 32
+
+    # pbeam: decoder src = beam*nserver + server-1 from the 1-based wire
+    # beam while the filler writes beam = src//nserver + 1, so the pair
+    # round-trips with a +nserver offset (absorbed by capture src0)
+    # (like tbn, the writer's seq is the raw wire timestamp)
+    back = rt('pbeam', PacketDesc(seq=1234 * 10, src=1, nsrc=4, chan0=32,
+                                  nchan=16, decimation=10,
+                                  payload=payload))
+    assert back.seq == 1234 and back.decimation == 10
+    assert back.src == 1 + 4        # + nserver
+    assert back.chan0 == 32 - 16 * back.src
+
+    # tbn: the writer's seq IS the wire time_tag (tbn.hpp:139)
+    back = rt('tbn', PacketDesc(seq=512 * 10 * 1234, src=1, tuning=77,
+                                gain=3, payload=b'\x00' * 1024),
+              decimation=10)
+    assert back.seq == 1234 and back.src == 1
+    assert back.tuning == 77 and back.gain == 3
+
+    # drx: desc.src carries the raw wire ID byte on pack; unpack
+    # decodes (tuning-1)<<1 | pol from it
+    wire_id = 1 | (2 << 3) | (1 << 7)    # beam 1, tuning 2, pol 1
+    back = rt('drx', PacketDesc(seq=4096 * 10 * 99, src=wire_id,
+                                tuning=77, decimation=10,
+                                payload=b'\x00' * 4096))
+    assert back.seq == 99 and back.src == 3 and back.beam == 0
+    assert back.tuning1 == 77      # src 3 -> second tuning slot
+    back = rt('drx8', PacketDesc(seq=4096 * 10 * 99, src=1 | (1 << 3),
+                                 tuning=77, decimation=10,
+                                 payload=b'\x00' * 8192))
+    assert back.seq == 99 and back.src == 0 and back.tuning == 77
+
+    # cor: src enumerates (baseline, server); tuning carries
+    # (nchan_decim, nserver, server)
+    from bifrost_tpu.io.packet_formats import CorFormat
+    fmt = CorFormat(nsrc=6)
+    desc = PacketDesc(seq=196000000 * 2 * 50, src=2, nsrc=3,
+                      tuning=(2 << 8) | 1, gain=3, decimation=200,
+                      payload=payload)
+    back = fmt.unpack(fmt.pack(desc))
+    assert back.seq == 50 and back.gain == 3 and back.decimation == 200
+    # decoder re-encodes tuning as (nserver << 8) | (server - 1)
+    assert back.tuning == (2 << 8) | 0
+    # baseline src=2 of 3 -> stand pair (1,1); decode composes
+    # (stand0*(2*(nstand-1)+1-stand0)//2 + stand1 + 1)*nserver + server-1
+    assert back.src == (1 * (2 * 1 + 1 - 1) // 2 + 1 + 1) * 2 + 0
+
+    back = rt('snap2', PacketDesc(seq=1234, src=1, nsrc=4, chan0=32,
+                                  nchan=16, npol=2, npol_tot=2,
+                                  payload=payload))
+    assert back.seq == 1234 and back.nchan == 16
+    assert back.chan0 == 1 * 16    # chan_block_id * nchan
+
+    back = rt('vdif', PacketDesc(seq=1234, src=1, payload=payload))
+    assert back.seq == 1234 and back.src == 1
+    assert back.payload == payload
+
+    back = rt('tbf', PacketDesc(seq=1234, src=300, nsrc=64,
+                                payload=payload))
+    assert back.seq == 1234 and back.src == 300 and back.nsrc == 64
+
+    back = rt('vbeam', PacketDesc(seq=1234, time_tag=99, nchan=16,
+                                  chan0=32, npol=2, payload=payload))
+    assert back.seq == 1234 and back.nchan == 16 and back.chan0 == 32
