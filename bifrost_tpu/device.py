@@ -61,6 +61,38 @@ def stream_synchronize(*arrays):
             a.block_until_ready()
 
 
+def force_completion(*arrays):
+    """Force device execution of ``arrays`` to COMPLETE via a one-element
+    value readback.
+
+    On some backends (the tunneled axon TPU platform), block_until_ready
+    returns before device execution finishes — only a readback drains the
+    queue.  Because the TPU runtime executes in enqueue order, forcing
+    the newest array implies everything enqueued before it has finished.
+    Complex arrays read back their real part (complex host transfers are
+    unimplemented on axon; see bifrost_tpu.xfer)."""
+    import jax
+    import jax.numpy as jnp
+    for a in arrays:
+        if hasattr(a, 'as_jax') and getattr(a, 'space', None) == 'tpu':
+            a = a.data
+        if isinstance(a, jax.Array) and a.size:
+            x = jnp.ravel(a)[0]
+            if jnp.issubdtype(a.dtype, jnp.complexfloating):
+                x = jnp.real(x)
+            float(x)
+
+
+def execution_in_order():
+    """Whether the backend executes dispatched work in enqueue order —
+    the assumption that lets the pipeline's dispatch-ahead drain wait on
+    only the newest gulp.  All supported backends (TPU single-stream
+    runtime, CPU) are in-order; set BF_ASSUME_IN_ORDER=0 to make drains
+    wait on every outstanding gulp instead."""
+    import os
+    return os.environ.get('BF_ASSUME_IN_ORDER', '1') != '0'
+
+
 class ExternalStream(object):
     """No-op context manager kept for API compatibility with the
     reference's cupy/pycuda interop (reference: device.py:56-84)."""

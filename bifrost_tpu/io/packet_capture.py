@@ -63,9 +63,17 @@ class PacketCaptureCallback(object):
 class _PacketCapture(object):
     def __init__(self, fmt, ring, nsrc, src0, max_payload_size,
                  buffer_ntime, slot_ntime, sequence_callback, core=None):
-        self.fmt = get_format(fmt)
-        self.ring = ring
         self.nsrc = int(np.prod(nsrc)) if not np.isscalar(nsrc) else nsrc
+        # 'cor' decoding depends on the source count (it sets the stand
+        # count used to compose baseline indices, reference cor.hpp:74);
+        # parameterize the codec with the engine's nsrc.  Other
+        # parameterized codecs (TbnFormat(decimation=...)) are passed in
+        # as format objects.
+        if isinstance(fmt, str) and fmt.split('_')[0] == 'cor':
+            self.fmt = get_format('cor', nsrc=self.nsrc)
+        else:
+            self.fmt = get_format(fmt)
+        self.ring = ring
         self.src0 = src0
         self.payload_size = max_payload_size
         self.buffer_ntime = buffer_ntime

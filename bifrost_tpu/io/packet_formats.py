@@ -309,13 +309,15 @@ class IBeamFormat(_FormatBase):
         self.nbeam = nbeam
 
     def pack(self, desc, framecount=0):
-        # mirror IBeamHeaderFiller (ibeam.hpp:92-109); wire chan0 is the
-        # *global* first channel, reconstructed from the logical chan0
+        # mirror IBeamHeaderFiller (ibeam.hpp:92-109): seq written
+        # verbatim (wire convention is 1-based, so like chips the pair
+        # round-trips to seq-1); wire chan0 is the *global* first
+        # channel, reconstructed from the logical chan0
         wire_chan0 = (desc.chan0 + desc.nchan * desc.src) & 0xFFFF
         return self.header_struct.pack(
             (desc.src + 1) & 0xFF, desc.tuning & 0xFF, desc.nchan & 0xFF,
             self.nbeam & 0xFF, desc.nsrc & 0xFF, wire_chan0,
-            desc.seq + 1) + bytes(desc.payload)
+            desc.seq) + bytes(desc.payload)
 
     def unpack(self, buf):
         # mirror IBeamDecoder (ibeam.hpp:56-81)

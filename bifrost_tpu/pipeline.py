@@ -101,12 +101,13 @@ class BlockScope(object):
     instance_count = 0
 
     _TUNABLES = ('gulp_nframe', 'buffer_nframe', 'buffer_factor', 'core',
-                 'device', 'mesh', 'share_temp_storage', 'sync_depth')
+                 'device', 'mesh', 'share_temp_storage', 'sync_depth',
+                 'sync_strict')
 
     def __init__(self, name=None, gulp_nframe=None, buffer_nframe=None,
                  buffer_factor=None, core=None, gpu=None, device=None,
                  mesh=None, share_temp_storage=False, fuse=False,
-                 sync_depth=None):
+                 sync_depth=None, sync_strict=None):
         if name is None:
             name = 'BlockScope_%i' % BlockScope.instance_count
             BlockScope.instance_count += 1
@@ -119,6 +120,7 @@ class BlockScope(object):
         self._mesh = mesh
         self._share_temp_storage = share_temp_storage
         self._sync_depth = sync_depth
+        self._sync_strict = sync_strict
         self._fused = fuse
         self._temp_storage = {}
         self._parent_scope = get_current_block_scope() \
@@ -445,12 +447,24 @@ class Block(BlockScope):
         ``sync_depth`` gulps of outputs — lower sync_depth for
         HBM-tight workloads.
 
-        NOTE: draining waits only on the newest popped gulp, which is
-        sufficient on TPU's in-order single-stream runtime; a
-        multi-stream backend would need to wait on every popped gulp
-        (device.stream_synchronize accepts them all)."""
+        Draining waits only on the newest popped gulp, which is
+        sufficient on in-order backends (the TPU single-stream runtime);
+        with BF_ASSUME_IN_ORDER=0 (out-of-order backend) every popped
+        gulp is waited on instead.
+
+        Strict mode (``sync_strict=True`` scope attribute, or
+        BF_SYNC_STRICT=1): forces completion via a one-element value
+        readback instead of block_until_ready.  On backends where
+        block_until_ready is advisory (axon), only strict mode truly
+        bounds in-flight device work and therefore HBM held by pending
+        outputs; without it the sync_depth memory bound is best-effort
+        there."""
+        import os
         depth = self.sync_depth if self.sync_depth is not None \
             else BlockScope.DEFAULT_SYNC_DEPTH
+        strict = self.sync_strict
+        if strict is None:
+            strict = os.environ.get('BF_SYNC_STRICT', '0') == '1'
         pend = getattr(self, '_pending_outputs', None)
         if pend is None:
             pend = self._pending_outputs = deque()
@@ -460,10 +474,14 @@ class Block(BlockScope):
             pend.append(arrays)
         if len(pend) > depth:
             drain = max(1, depth // 2)
-            newest = None
-            for _ in range(drain):
-                newest = pend.popleft()
-            device.stream_synchronize(*newest)
+            popped = [pend.popleft() for _ in range(drain)]
+            wait = device.force_completion if strict \
+                else device.stream_synchronize
+            if device.execution_in_order():
+                wait(*popped[-1])
+            else:
+                for gulp in popped:
+                    wait(*gulp)
 
     # -- overridables ------------------------------------------------------
     def _define_output_nframes(self, input_nframes):

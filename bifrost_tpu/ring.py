@@ -135,7 +135,10 @@ class _HostStorage(object):
     def allocate(self, size, ghost, nringlet, tail, head, old=None):
         new = np.zeros((nringlet, size + ghost), dtype=np.uint8)
         if old is not None and old.buf is not None and head > tail:
-            # preserve [tail, head) across the re-layout
+            # preserve [tail, head) across the re-layout; when the ringlet
+            # count grows, only the existing lanes carry data (matches the
+            # native core, native/ring.cpp min-lane copy)
+            nl = min(old.nringlet, nringlet)
             n = head - tail
             if n > size:
                 tail = head - size
@@ -144,8 +147,8 @@ class _HostStorage(object):
             while o < head:
                 run = min(head - o, old.size - o % old.size,
                           size - o % size)
-                new[:, o % size:o % size + run] = \
-                    old.buf[:, o % old.size:o % old.size + run]
+                new[:nl, o % size:o % size + run] = \
+                    old.buf[:nl, o % old.size:o % old.size + run]
                 o += run
         self.buf, self.size, self.ghost, self.nringlet = \
             new, size, ghost, nringlet
@@ -414,6 +417,14 @@ class Ring(object):
 
     def _reserve_span(self, nbyte, nonblocking=False, span=None):
         with self._lock:
+            # A queued partial commit truncates reserve_head when it
+            # lands; reserving past it would hand out offsets the
+            # truncation then invalidates.
+            for sp in self._open_wspans:
+                if sp._closed and sp._commit_nbyte < sp._nbyte:
+                    raise RuntimeError(
+                        "Cannot reserve a span while a partial commit "
+                        "is pending")
             if nbyte > self._ghost:
                 # Guaranteed-contiguous window too small; grow it.
                 self._lock.release()
@@ -452,22 +463,29 @@ class Ring(object):
 
     def _commit_span(self, wspan, commit_nbyte):
         with self._lock:
+            # A partial commit truncates reserve_head, so it is only legal
+            # on the newest outstanding span; reject it up front, before
+            # any state changes.
+            if commit_nbyte < wspan._nbyte and self._open_wspans and \
+                    self._open_wspans[-1] is not wspan:
+                raise RuntimeError(
+                    "Partial commit with later spans outstanding")
             wspan._commit_nbyte = commit_nbyte
             wspan._closed = True
+            # (The up-front check above plus _reserve_span's pending-
+            # partial-commit rejection guarantee the closed prefix is
+            # always legal to apply here.)
             # In-order commit barrier (reference: ring_impl.cpp:591-594):
             # apply commits only for the prefix of closed spans.
             while self._open_wspans and self._open_wspans[0]._closed:
                 sp = self._open_wspans.pop(0)
                 cb = sp._commit_nbyte
                 if cb < sp._nbyte:
-                    if self._open_wspans:
-                        raise RuntimeError(
-                            "Partial commit with later spans outstanding")
                     self._reserve_head = sp._begin + cb
                 self._head = sp._begin + cb
                 if cb > 0:
                     sp._finalize_storage(cb)
-            self._nwrite_open -= 1
+                self._nwrite_open -= 1
             self._read_cond.notify_all()
             self._span_cond.notify_all()
 

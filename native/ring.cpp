@@ -328,6 +328,12 @@ int bft_ring_reserve(void* ring_, long long nbyte, int nonblocking,
     if (!r || !begin_out || !span_id_out || nbyte < 0)
         return BFT_ERR_INVALID;
     std::unique_lock<std::mutex> lk(r->mtx);
+    // A queued partial commit truncates reserve_head when it lands;
+    // reserving past it would hand out offsets that the truncation
+    // then invalidates.
+    for (auto& ws : r->open_wspans)
+        if (ws.commit_nbyte >= 0 && ws.commit_nbyte < ws.nbyte)
+            return BFT_ERR_STATE;
     if (nbyte > r->ghost) {
         // guaranteed-contiguous window too small; grow it
         r->span_cv.wait(lk, [&] {
@@ -369,11 +375,18 @@ int bft_ring_commit(void* ring_, long long span_id, long long commit_nbyte) {
     Ring* r = static_cast<Ring*>(ring_);
     if (!r) return BFT_ERR_INVALID;
     std::lock_guard<std::mutex> lk(r->mtx);
+    // A partial commit truncates reserve_head, so it is only legal on
+    // the newest outstanding span; reject it up front, before any state
+    // changes (an error raised mid-pop used to leak nwrite_open and
+    // permanently block resize quiescence).
     bool found = false;
     for (auto& ws : r->open_wspans) {
         if (ws.id == span_id) {
             if (ws.commit_nbyte >= 0) return BFT_ERR_STATE;
             if (commit_nbyte > ws.nbyte) return BFT_ERR_INVALID;
+            if (commit_nbyte < ws.nbyte &&
+                ws.id != r->open_wspans.back().id)
+                return BFT_ERR_STATE;
             ws.commit_nbyte = commit_nbyte;
             found = true;
             break;
@@ -387,10 +400,8 @@ int bft_ring_commit(void* ring_, long long span_id, long long commit_nbyte) {
         r->open_wspans.pop_front();
         if (ws.commit_nbyte > 0)
             r->ghost_write_locked(ws.begin, ws.commit_nbyte);
-        if (ws.commit_nbyte < ws.nbyte) {
-            if (!r->open_wspans.empty()) return BFT_ERR_STATE;
+        if (ws.commit_nbyte < ws.nbyte)
             r->reserve_head = ws.begin + ws.commit_nbyte;
-        }
         r->head = ws.begin + ws.commit_nbyte;
         r->total_written += ws.commit_nbyte;
         r->nwrite_open -= 1;

@@ -264,3 +264,75 @@ def test_stress_concurrent_churn():
     t.join()
     assert nframes == NGULP * GULP
     assert write_hash.hexdigest() == read_hash.hexdigest()
+
+
+def test_partial_commit_with_outstanding_spans_is_clean_error():
+    """A partial commit is only legal on the newest outstanding span; the
+    error must leave ring state untouched (no nwrite_open leak — a leak
+    blocks resize quiescence forever; ADVICE r1)."""
+    ring = Ring(space='system')
+    hdr = _hdr()
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=8, buf_nframe=32) as seq:
+            s1 = seq.reserve(8)
+            s2 = seq.reserve(8)
+            s1.commit(4)
+            with pytest.raises(Exception):
+                s1.close()
+            # recover: full commits in order must still work
+            s1.commit(8)
+            s1.close()
+            s2.commit(8)
+            s2.close()
+            # the leak symptom: resize waits for quiescence forever
+            done = threading.Event()
+
+            def do_resize():
+                ring.resize(16 * 16, 64 * 16)
+                done.set()
+
+            t = threading.Thread(target=do_resize, daemon=True)
+            t.start()
+            assert done.wait(10), "resize deadlocked: nwrite_open leaked"
+            t.join()
+
+
+def test_partial_commit_on_newest_span_ok():
+    """Partial commit on the newest span truncates the stream cleanly."""
+    ring = Ring(space='system')
+    hdr = _hdr()
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=8,
+                                   buf_nframe=32) as seq:
+                with seq.reserve(8) as span:
+                    span.data.as_numpy()[...] = 5
+                    span.commit(3)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    got = []
+    for seq in ring.read(guarantee=True):
+        seq.resize(gulp_nframe=8)
+        for span in seq.read(8):
+            got.append(span.nframe)
+    t.join()
+    assert got == [3]
+
+
+def test_reserve_after_partial_commit_rejected():
+    """Reserving past a queued partial commit would hand out offsets the
+    truncation then invalidates; both cores reject it up front."""
+    ring = Ring(space='system')
+    hdr = _hdr()
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=8, buf_nframe=32) as seq:
+            s1 = seq.reserve(8)
+            s2 = seq.reserve(8)
+            s2.commit(4)
+            s2.close()              # queued partial (s1 still open)
+            with pytest.raises(Exception):
+                seq.reserve(8)
+            s1.commit(8)
+            s1.close()              # barrier applies s1 full, s2 partial

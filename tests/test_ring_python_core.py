@@ -36,3 +36,24 @@ def test_native_core_is_default_when_available():
     core is used)"""
     # this test runs WITH the fixture, so just assert the fixture works
     assert native_mod.available() is False
+
+test_partial_commit_with_outstanding_spans_is_clean_error = \
+    test_ring.test_partial_commit_with_outstanding_spans_is_clean_error
+test_partial_commit_on_newest_span_ok = \
+    test_ring.test_partial_commit_on_newest_span_ok
+
+
+def test_host_storage_ringlet_grow_preserves_lanes():
+    """Growing nringlet during a live resize copies only the existing
+    lanes (matches native/ring.cpp min-lane copy; ADVICE r1)."""
+    import numpy as np
+    from bifrost_tpu.ring import _HostStorage
+    old = _HostStorage()
+    old.allocate(16, 4, 1, 0, 0)
+    old.buf[0, :8] = np.arange(8)
+    new = _HostStorage()
+    new.allocate(32, 4, 3, 0, 8, old=old)
+    np.testing.assert_array_equal(new.buf[0, :8], np.arange(8))
+    assert not new.buf[1:].any()
+test_reserve_after_partial_commit_rejected = \
+    test_ring.test_reserve_after_partial_commit_rejected

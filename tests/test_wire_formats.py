@@ -166,7 +166,8 @@ def test_ibeam_golden():
     assert d.nchan == 96
     assert d.chan0 == 50                    # global - nchan*src
     assert d.payload == pld
-    packed = IBeamFormat().pack(PacketDesc(seq=2000, src=3, nsrc=6,
+    # filler mirror: seq written verbatim (1-based wire convention)
+    packed = IBeamFormat().pack(PacketDesc(seq=2001, src=3, nsrc=6,
                                            tuning=1, nchan=96, chan0=50,
                                            payload=pld))
     assert packed == wire
