@@ -89,3 +89,80 @@ def stokes_detect(xr, xi, yr, yi, tile=512):
         out_shape=jax.ShapeDtypeStruct((T, 4, F), jnp.float32),
     )(xr, xi, yr, yi)
     return out
+
+
+def fdmt_step(d1, d2, passthrough, rows_hi_max, sgn, T, interpret=False):
+    """Build a Pallas kernel for one FDMT merge step.
+
+    The step computes, for each output (subband s, delay d) row,
+    ``out[s,d,t] = lo[2s, d1[s,d], t] + hi[rows_hi[s], d2[s,d], t + sgn*d1[s,d]]``
+    with zero outside the valid time range — a gather+add along the
+    lane-contiguous time axis that XLA lowers as a slow general gather
+    (SURVEY.md §7 hard part d; reference CUDA kernel: src/fdmt.cu:53-96).
+
+    Here the delay tables ride scalar prefetch (SMEM), block index maps
+    pick the subband rows (so each subband's rows DMA once and stay in
+    VMEM across its nd_out programs), and the per-row time shift is a
+    lane roll + mask on the VPU.
+
+    d1/d2: (nout, nd_out) int32; passthrough: (nout,) int32;
+    rows_hi_max: nchan_cur-1 (clamp for odd tails); sgn: +-1; T: logical
+    time length (lane padding beyond T is masked).
+    Returns fn(lo_hi_state (nchan_cur, nd_cur, Tp)) -> (nout, nd_out, Tp).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nout, nd_out = d1.shape
+
+    # One program per output subband: its lo/hi rows DMA to VMEM once,
+    # then a fori_loop emits all nd_out delay rows (full-(nd,T) blocks
+    # keep the TPU tiling constraint — second-minor block dims must be
+    # full-size or 8-divisible).
+    def kernel(d1_ref, d2_ref, pt_ref, lo_ref, hi_ref, o_ref):
+        s = pl.program_id(0)
+
+        def body(d, carry):
+            d1v = d1_ref[s, d]
+            d2v = d2_ref[s, d]
+            a = lo_ref[0, pl.ds(d1v, 1), :]          # (1, Tp)
+            b = hi_ref[0, pl.ds(d2v, 1), :]
+            shift = sgn * d1v
+            rolled = pltpu.roll(b, -shift, axis=1)   # rolled[t]=b[t+shift]
+            tt = jax.lax.broadcasted_iota(jnp.int32, a.shape, 1)
+            ok = (tt + shift >= 0) & (tt + shift <= T - 1)
+            res = a + jnp.where(ok, rolled, 0.0)
+            res = jnp.where(pt_ref[s] != 0, a, res)
+            o_ref[0, pl.ds(d, 1), :] = res
+            return carry
+
+        jax.lax.fori_loop(0, nd_out, body, 0)
+
+    def fn(state):
+        nchan_cur, nd_cur, Tp = state.shape
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(nout,),
+            in_specs=[
+                pl.BlockSpec((1, nd_cur, Tp),
+                             lambda s, *_: (2 * s, 0, 0)),
+                pl.BlockSpec((1, nd_cur, Tp),
+                             lambda s, *_: (
+                                 jnp.minimum(2 * s + 1, rows_hi_max),
+                                 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, nd_out, Tp),
+                                   lambda s, *_: (s, 0, 0)),
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((nout, nd_out, Tp),
+                                           jnp.float32),
+            interpret=interpret,
+        )(jnp.asarray(d1), jnp.asarray(d2),
+          jnp.asarray(passthrough, jnp.int32), state, state)
+
+    return fn

@@ -180,13 +180,51 @@ class _HostStorage(object):
         pass  # byte buffer reclaims implicitly
 
 
+def _build_stitcher(plan, taxis):
+    """Compile a stitcher for a piece plan: ('z', nframe) zero-fill and
+    ('a', f0, f1, arg_index) slice pieces, concatenated along taxis.
+    The plan is closure-static, so jit compiles one fused gather per
+    distinct overlap pattern and per-gulp dispatch is a cache hit."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*arrs):
+        parts = []
+        for p in plan:
+            if p[0] == 'z':
+                ref = arrs[0]
+                shp = list(ref.shape)
+                shp[taxis] = p[1]
+                parts.append(jnp.zeros(shp, ref.dtype))
+            else:
+                _, f0, f1, k = p
+                a = arrs[k]
+                idx = [slice(None)] * a.ndim
+                idx[taxis] = slice(f0, f1)
+                parts.append(a[tuple(idx)])
+        if len(parts) == 1:
+            return parts[0]
+        return jnp.concatenate(parts, axis=taxis)
+
+    return jax.jit(fn)
+
+
 class _DeviceStorage(object):
     """Chunk-map storage for 'tpu' rings: committed gulps are jax arrays
     keyed by absolute byte offset.  Logical shape of each chunk is
-    (*ringlet_shape, nframe, *frame_shape)."""
+    (*ringlet_shape, nframe, *frame_shape).
+
+    Overlap reads (FIR/FDMT input history) straddle chunk boundaries
+    every gulp; the piece plan is found by bisect over a maintained
+    sorted offset index and executed by a per-pattern cached jitted
+    stitcher — the hot loop pays one compiled-dispatch instead of a
+    Python chunk scan + eager concatenate (measured 207us -> see
+    CHANGELOG)."""
 
     def __init__(self):
         self.chunks = {}   # abs byte offset -> (nbyte, jax.Array, time_axis)
+        self._offsets = []          # sorted keys of self.chunks
+        self._stitchers = {}        # piece plan -> jitted stitcher
         self.size = 0
         self.ghost = 0
         self.nringlet = 1
@@ -194,47 +232,67 @@ class _DeviceStorage(object):
     def allocate(self, size, ghost, nringlet, tail, head, old=None):
         if old is not None and old is not self:
             self.chunks = dict(old.chunks)
+            self._offsets = sorted(self.chunks)
         self.size, self.ghost, self.nringlet = size, ghost, nringlet
 
     def put(self, offset, nbyte, array, time_axis):
+        import bisect
+        if offset not in self.chunks:
+            bisect.insort(self._offsets, offset)
         self.chunks[offset] = (nbyte, array, time_axis)
 
     def get(self, offset, nbyte, frame_nbyte, zeros_fn):
         """Assemble the logical array covering [offset, offset+nbyte).
         Fast path: a single committed chunk covers the request exactly."""
+        import bisect
         hit = self.chunks.get(offset)
         if hit is not None and hit[0] == nbyte:
             return hit[1]
-        # Slow path: stitch overlapping chunks along the time axis.
-        import jax.numpy as jnp
-        want_frames = nbyte // frame_nbyte
-        pieces, covered = [], offset
-        for o in sorted(self.chunks):
-            cn, arr, taxis = self.chunks[o]
-            if o + cn <= covered or o >= offset + nbyte:
+        end = offset + nbyte
+        # piece plan over the sorted chunk index
+        i = bisect.bisect_right(self._offsets, offset) - 1
+        if i < 0:
+            i = 0
+        plan, arrs, covered, taxis = [], [], offset, 0
+        while covered < end and i < len(self._offsets):
+            o = self._offsets[i]
+            cn, arr, ctaxis = self.chunks[o]
+            i += 1
+            if o + cn <= covered:
                 continue
-            if o > covered:  # gap (overwritten / never written): zero fill
-                pieces.append(zeros_fn((o - covered) // frame_nbyte))
+            if o >= end:
+                break
+            if o > covered:  # gap (overwritten / never written): zeros
+                plan.append(('z', (o - covered) // frame_nbyte))
                 covered = o
             f0 = (covered - o) // frame_nbyte
-            f1 = min(cn, offset + nbyte - o) // frame_nbyte
-            idx = [slice(None)] * arr.ndim
-            idx[taxis] = slice(f0, f1)
-            pieces.append(arr[tuple(idx)])
+            f1 = min(cn, end - o) // frame_nbyte
+            plan.append(('a', f0, f1, len(arrs)))
+            arrs.append(arr)
+            taxis = ctaxis
             covered = o + f1 * frame_nbyte
-        if covered < offset + nbyte:
-            pieces.append(zeros_fn((offset + nbyte - covered) // frame_nbyte))
-        if not pieces:
-            return zeros_fn(want_frames)
-        if len(pieces) == 1:
-            return pieces[0]
-        taxis = next(iter(self.chunks.values()))[2] if self.chunks else 0
-        return jnp.concatenate(pieces, axis=taxis)
+        if covered < end:
+            plan.append(('z', (end - covered) // frame_nbyte))
+        if not arrs:
+            return zeros_fn(nbyte // frame_nbyte)
+        if len(plan) == 1:
+            _, f0, f1, k = plan[0]
+            a = arrs[k]
+            idx = [slice(None)] * a.ndim
+            idx[taxis] = slice(f0, f1)
+            return a[tuple(idx)]
+        key = (tuple(plan), taxis)
+        fn = self._stitchers.get(key)
+        if fn is None:
+            fn = self._stitchers[key] = _build_stitcher(plan, taxis)
+        return fn(*arrs)
 
     def discard_before(self, offset):
         dead = [o for o, (cn, _, _) in self.chunks.items() if o + cn <= offset]
         for o in dead:
             del self.chunks[o]
+        if dead:
+            self._offsets = sorted(self.chunks)
 
 
 # ---------------------------------------------------------------------------
