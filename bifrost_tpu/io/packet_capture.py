@@ -28,7 +28,8 @@ import numpy as np
 from .packet_formats import get_format, PacketDesc
 from ..ring import RingWriter
 
-__all__ = ['PacketCaptureCallback', 'UDPCapture', 'DiskReader',
+__all__ = ['PacketCaptureCallback', 'UDPCapture', 'UDPSniffer',
+           'DiskReader',
            'CAPTURE_STARTED', 'CAPTURE_CONTINUED', 'CAPTURE_ENDED',
            'CAPTURE_NO_DATA', 'CAPTURE_INTERRUPTED']
 
@@ -140,9 +141,140 @@ class _PacketCapture(object):
             'ninvalid': self.stats['ninvalid'],
             'nignored': self.stats['nignored']})
 
+    # -- vectorized batch path (recvmmsg + decode_batch formats) -----------
+    def _assign_batch(self, offs, srcs, payloads):
+        """Scatter a decoded batch into the open window, sliding it as
+        needed.  Returns True if any span was committed."""
+        committed = False
+        remaining = np.ones(len(offs), bool)
+        while remaining.any():
+            last_end = (self._bufs[-1][0] + self.buffer_ntime) \
+                if self._bufs else 0
+            beyond = remaining & (offs >= last_end)
+            in_window = remaining & (offs < last_end)
+            idx = np.nonzero(in_window)[0]
+            if idx.size:
+                o = offs[idx]
+                for start, span, view, got in self._bufs:
+                    m = (o >= start) & (o < start + self.buffer_ntime)
+                    if m.any():
+                        sel = idx[m]
+                        ts = offs[sel] - start
+                        view[ts, srcs[sel], :payloads.shape[1]] = \
+                            payloads[sel]
+                        got[ts, srcs[sel]] = True
+                if self._bufs:
+                    too_late = o < self._bufs[0][0]
+                    self.stats['nignored'] += int(too_late.sum())
+                remaining[idx] = False
+            if beyond.any():
+                if len(self._bufs) == 2:
+                    self._commit_oldest()
+                    committed = True
+                self._open_buf(last_end)
+            elif not idx.size:
+                break
+        return committed
+
+    def _recv_batched(self):
+        """recv() over whole recvmmsg batches with vectorized header
+        decode — the per-packet Python cost (struct.unpack + slice +
+        scatter) collapses into a handful of numpy ops per batch."""
+        started = False
+        committed = False
+        while not committed:
+            raw, lengths = self._recv_raw_batch()
+            if raw is None:
+                return CAPTURE_NO_DATA if self._seq0 is None \
+                    else CAPTURE_INTERRUPTED
+            n = len(lengths)
+            stride = self._raw_stride
+            arr = np.frombuffer(raw, np.uint8,
+                                count=n * stride).reshape(n, stride)
+            if len(set(lengths)) != 1:
+                # mixed sizes: per-packet fallback for this batch
+                for i in range(n):
+                    s, c = self._process_one(bytes(arr[i, :lengths[i]]))
+                    started = started or s
+                    committed = committed or c
+                continue
+            if lengths[0] < self.fmt.header_size:
+                self.stats['ninvalid'] += n     # runts
+                continue
+            seqs, srcs, hoff = self.fmt.decode_batch(arr)
+            srcs = srcs - self.src0
+            valid = (srcs >= 0) & (srcs < self.nsrc)
+            self.stats['nignored'] += int((~valid).sum())
+            if not valid.any():
+                continue
+            if self._seq0 is None:
+                first = int(np.nonzero(valid)[0][0])
+                desc = self.fmt.unpack(bytes(arr[first, :lengths[first]]))
+                if desc is None:
+                    self.stats['ninvalid'] += 1
+                    continue
+                desc.src -= self.src0
+                self._begin_sequence(desc)
+                started = True
+            offs = seqs - self._seq0
+            fresh = valid & (offs >= 0)
+            self.stats['nignored'] += int((valid & ~fresh).sum())
+            if not fresh.any():
+                continue
+            payloads = arr[:, hoff:lengths[0]]
+            committed = self._assign_batch(offs[fresh].astype(np.int64),
+                                           srcs[fresh].astype(np.int64),
+                                           payloads[fresh]) or committed
+        return CAPTURE_STARTED if started else CAPTURE_CONTINUED
+
+    def _recv_raw_batch(self):
+        return None, None       # only UDPCapture implements this
+
+    def _process_one(self, pkt):
+        """Single-packet slow path used by recv() and mixed batches."""
+        desc = self.fmt.unpack(pkt)
+        if desc is None:
+            self.stats['ninvalid'] += 1
+            return False, False
+        desc.src -= self.src0
+        if desc.src < 0 or desc.src >= self.nsrc:
+            self.stats['nignored'] += 1
+            return False, False
+        started = False
+        if self._seq0 is None:
+            self._begin_sequence(desc)
+            started = True
+        off = desc.seq - self._seq0
+        if off < 0:
+            self.stats['nignored'] += 1
+            return started, False
+        committed = False
+        while True:
+            last_end = (self._bufs[-1][0] + self.buffer_ntime) \
+                if self._bufs else 0
+            if off < last_end:
+                break
+            if len(self._bufs) == 2:
+                self._commit_oldest()
+                committed = True
+            self._open_buf(last_end)
+        for start, span, view, got in self._bufs:
+            if start <= off < start + self.buffer_ntime:
+                t = off - start
+                payload = np.frombuffer(desc.payload, np.uint8)
+                view[t, desc.src, :len(payload)] = payload
+                got[t, desc.src] = True
+                break
+            elif off < start:
+                self.stats['nignored'] += 1   # too late
+                break
+        return started, committed
+
     def recv(self):
         """Process packets until one buffer's worth of time has been
         committed (reference: bfPacketCaptureRecv)."""
+        if getattr(self, '_use_batch', False):
+            return self._recv_batched()
         started = False
         committed = False
         while not committed:
@@ -150,41 +282,9 @@ class _PacketCapture(object):
             if pkt is None:
                 return CAPTURE_NO_DATA if self._seq0 is None \
                     else CAPTURE_INTERRUPTED
-            desc = self.fmt.unpack(pkt)
-            if desc is None:
-                self.stats['ninvalid'] += 1
-                continue
-            desc.src -= self.src0
-            if desc.src < 0 or desc.src >= self.nsrc:
-                self.stats['nignored'] += 1
-                continue
-            if self._seq0 is None:
-                self._begin_sequence(desc)
-                started = True
-            off = desc.seq - self._seq0
-            if off < 0:
-                self.stats['nignored'] += 1
-                continue
-            # slide the double-buffered window forward as needed
-            while True:
-                last_end = (self._bufs[-1][0] + self.buffer_ntime) \
-                    if self._bufs else 0
-                if off < last_end:
-                    break
-                if len(self._bufs) == 2:
-                    self._commit_oldest()
-                    committed = True
-                self._open_buf(last_end)
-            for start, span, view, got in self._bufs:
-                if start <= off < start + self.buffer_ntime:
-                    t = off - start
-                    payload = np.frombuffer(desc.payload, np.uint8)
-                    view[t, desc.src, :len(payload)] = payload
-                    got[t, desc.src] = True
-                    break
-                elif off < start:
-                    self.stats['nignored'] += 1   # too late
-                    break
+            s, c = self._process_one(pkt)
+            started = started or s
+            committed = committed or c
         return CAPTURE_STARTED if started else CAPTURE_CONTINUED
 
     def flush(self):
@@ -211,16 +311,35 @@ class _PacketCapture(object):
 
 class UDPCapture(_PacketCapture):
     """Capture packets from a UDP socket (reference:
-    bfUdpCaptureCreate, src/packet_capture.cpp:324)."""
+    bfUdpCaptureCreate, src/packet_capture.cpp:324).
+
+    Uses recvmmsg batching when the socket supports it (up to
+    ``batch`` datagrams per syscall — the reference's Socket.hpp:145-158
+    shim); falls back to per-packet recv otherwise."""
+
+    BATCH = 128
 
     def __init__(self, fmt, sock, ring, nsrc, src0, max_payload_size,
-                 buffer_ntime, slot_ntime, sequence_callback, core=None):
+                 buffer_ntime, slot_ntime, sequence_callback, core=None,
+                 batch=None):
         super(UDPCapture, self).__init__(
             fmt, ring, nsrc, src0, max_payload_size, buffer_ntime,
             slot_ntime, sequence_callback, core)
         self.sock = sock
+        self.batch = batch or self.BATCH
+        self._pending = []
+        self._pending_idx = 0
+        self._use_mmsg = hasattr(sock, 'recv_mmsg')
+        # fully-vectorized path: recvmmsg raw buffer + batch header
+        # decode (formats that define decode_batch)
+        self._raw_stride = max_payload_size + 1024
+        self._use_batch = (hasattr(sock, 'recv_mmsg_raw') and
+                           hasattr(self.fmt, 'decode_batch'))
 
-    def _recv_packet(self):
+    def _recv_raw_batch(self):
+        return self.sock.recv_mmsg_raw(self.batch, self._raw_stride)
+
+    def _recv_plain(self):
         try:
             return self.sock.recv(self.payload_size + 1024)
         except (socket_mod.timeout, TimeoutError):
@@ -229,6 +348,73 @@ class UDPCapture(_PacketCapture):
             if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
                 return None
             raise
+
+    def _recv_packet(self):
+        if not self._use_mmsg:
+            return self._recv_plain()
+        if self._pending_idx >= len(self._pending):
+            try:
+                batch = self.sock.recv_mmsg(self.batch,
+                                            self.payload_size + 1024)
+            except (OSError, AttributeError):
+                self._use_mmsg = False
+                return self._recv_plain()
+            if not batch:
+                return None
+            self._pending = batch
+            self._pending_idx = 0
+        pkt = self._pending[self._pending_idx]
+        self._pending_idx += 1
+        return pkt
+
+
+class UDPSniffer(_PacketCapture):
+    """Promiscuous capture: sees every inbound UDP datagram on the host
+    via a raw IPPROTO_UDP socket, filtered to ``addr``'s port, with the
+    IP + UDP headers stripped (reference: bfUdpSnifferCreate,
+    src/packet_capture.cpp:352, UDPSnifferCapture method
+    packet_capture.hpp:287-304).  Requires CAP_NET_RAW/root."""
+
+    def __init__(self, fmt, addr, ring, nsrc, src0, max_payload_size,
+                 buffer_ntime, slot_ntime, sequence_callback, core=None):
+        super(UDPSniffer, self).__init__(
+            fmt, ring, nsrc, src0, max_payload_size, buffer_ntime,
+            slot_ntime, sequence_callback, core)
+        self.port = addr.port if hasattr(addr, 'port') else int(addr)
+        self.raw = socket_mod.socket(socket_mod.AF_INET,
+                                     socket_mod.SOCK_RAW,
+                                     socket_mod.IPPROTO_UDP)
+        self.raw.settimeout(0.5)
+
+    def set_timeout(self, secs):
+        self.raw.settimeout(secs)
+
+    def _recv_packet(self):
+        while True:
+            try:
+                dgram = self.raw.recv(65535)
+            except (socket_mod.timeout, TimeoutError):
+                return None
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    return None
+                raise
+            if len(dgram) < 1:
+                continue
+            ihl = (dgram[0] & 0xF) * 4          # IP header length
+            if len(dgram) < ihl + 8:
+                continue
+            dport = int.from_bytes(dgram[ihl + 2:ihl + 4], 'big')
+            if self.port and dport != self.port:
+                continue
+            return dgram[ihl + 8:]              # strip IP + UDP headers
+
+    def close(self):
+        self.raw.close()
+
+    def __exit__(self, *exc):
+        self.end()
+        self.close()
 
 
 class DiskReader(_PacketCapture):

@@ -113,6 +113,12 @@ class SimpleFormat(_FormatBase):
         return PacketDesc(seq=seq, src=0, nsrc=1, nchan=1,
                           payload=buf[self.header_size:])
 
+    def decode_batch(self, arr):
+        """Vectorized header decode for a (npkt, pkt_bytes) uint8 array
+        (recvmmsg batch).  Returns (seqs, srcs, payload_offset)."""
+        seqs = arr[:, :8].copy().view('>u8').astype(np.int64).ravel()
+        return seqs, np.zeros(len(arr), np.int64), self.header_size
+
 
 class ChipsFormat(_FormatBase):
     """CHIPS F-engine packets (reference: src/formats/chips.hpp:33-43).
@@ -140,6 +146,12 @@ class ChipsFormat(_FormatBase):
         return PacketDesc(seq=seq - 1, src=roach - 1, nsrc=nroach,
                           tuning=gbe, nchan=nchan, chan0=chan0,
                           payload=buf[self.header_size:])
+
+    def decode_batch(self, arr):
+        """Vectorized header decode (see SimpleFormat.decode_batch)."""
+        seqs = arr[:, 8:16].copy().view('>u8').astype(np.int64).ravel() - 1
+        srcs = arr[:, 0].astype(np.int64) - 1
+        return seqs, srcs, self.header_size
 
 
 class PBeamFormat(_FormatBase):

@@ -34,6 +34,29 @@ def _cff(f1, f2, exponent):
     return abs(f1 ** exponent - f2 ** exponent)
 
 
+def _xla_merge_step(state, step, sgn, T_logical):
+    """One FDMT merge step as XLA gathers, shared by the pure-XLA core
+    and the Pallas core's SMEM-overflow fallback.  ``state`` may be
+    time-padded: the validity mask uses ``T_logical`` while the gather
+    clip uses the padded extent (pad values never reach [0, T))."""
+    import jax.numpy as jnp
+    Tp = state.shape[2]
+    t = jnp.arange(Tp)
+    lo = state[step.rows_lo]
+    hi = state[step.rows_hi]
+    d1 = jnp.asarray(step.d1)
+    d2 = jnp.asarray(step.d2)
+    pt = jnp.asarray(step.passthrough)
+    nout = d1.shape[0]
+    rows = jnp.arange(nout)[:, None, None]
+    tshift = t[None, None, :] + sgn * d1[:, :, None]
+    ok = (tshift >= 0) & (tshift <= T_logical - 1)
+    tshift = jnp.clip(tshift, 0, Tp - 1)
+    a = lo[rows, d1[:, :, None], t[None, None, :]]
+    b = hi[rows, d2[:, :, None], tshift] * ok
+    return jnp.where(pt[:, None, None], a, a + b)
+
+
 class _Step(object):
     __slots__ = ('rows_lo', 'rows_hi', 'd1', 'd2', 'nd_out', 'passthrough')
 
@@ -141,19 +164,7 @@ class Fdmt(object):
             terms = x[:, idx] * pad_ok[None, :, :]
             state = jnp.cumsum(terms, axis=1)   # (nchan, nd_init, T)
             for step in steps:
-                lo = state[step.rows_lo]        # (nout, nd_cur, T)
-                hi = state[step.rows_hi]
-                d1 = jnp.asarray(step.d1)       # (nout, nd_out)
-                d2 = jnp.asarray(step.d2)
-                pt = jnp.asarray(step.passthrough)
-                nout, nd_out = d1.shape
-                rows = jnp.arange(nout)[:, None, None]
-                tshift = t[None, None, :] + sgn * d1[:, :, None]
-                ok = (tshift >= 0) & (tshift <= T - 1)
-                tshift = jnp.clip(tshift, 0, T - 1)
-                a = lo[rows, d1[:, :, None], t[None, None, :]]
-                b = hi[rows, d2[:, :, None], tshift] * ok
-                state = jnp.where(pt[:, None, None], a, a + b)
+                state = _xla_merge_step(state, step, sgn, T)
             return state[0, :max_delay, :]
         return core
 
@@ -171,26 +182,8 @@ class Fdmt(object):
         sgn = -1 if negative_delays else +1
 
         # Scalar-prefetch delay tables live in SMEM; steps whose tables
-        # exceed SMEM_TABLE_BUDGET (huge-nchan plans) fall back to the
-        # XLA gather for that step only.  Pad-region values (t >= T)
-        # never flow into the logical region: the shifted 'b' term is
-        # masked to t+shift <= T-1 and the 'a' term is t-aligned.
-        def xla_step(state, step, T):
-            t = jnp.arange(state.shape[2])
-            lo = state[step.rows_lo]
-            hi = state[step.rows_hi]
-            d1 = jnp.asarray(step.d1)
-            d2 = jnp.asarray(step.d2)
-            pt = jnp.asarray(step.passthrough)
-            nout = d1.shape[0]
-            rows = jnp.arange(nout)[:, None, None]
-            tshift = t[None, None, :] + sgn * d1[:, :, None]
-            ok = (tshift >= 0) & (tshift <= T - 1)
-            tshift = jnp.clip(tshift, 0, state.shape[2] - 1)
-            a = lo[rows, d1[:, :, None], t[None, None, :]]
-            b = hi[rows, d2[:, :, None], tshift] * ok
-            return jnp.where(pt[:, None, None], a, a + b)
-
+        # exceed SMEM_TABLE_BUDGET (huge-nchan plans) fall back to
+        # _xla_merge_step for that step only.
         def core(x):
             nchan, T = x.shape
             Tp = -(-T // 128) * 128
@@ -207,7 +200,7 @@ class Fdmt(object):
             for step in steps:
                 table_bytes = (2 * step.d1.size + len(step.passthrough)) * 4
                 if table_bytes > SMEM_TABLE_BUDGET:
-                    state = xla_step(state, step, T)
+                    state = _xla_merge_step(state, step, sgn, T)
                 else:
                     fn = _pk.fdmt_step(step.d1, step.d2,
                                        step.passthrough.astype(np.int32),

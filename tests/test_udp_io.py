@@ -288,3 +288,56 @@ def test_format_roundtrips():
     back = rt('vbeam', PacketDesc(seq=1234, time_tag=99, nchan=16,
                                   chan0=32, npol=2, payload=payload))
     assert back.seq == 1234 and back.nchan == 16 and back.chan0 == 32
+
+
+def test_udp_sniffer_loopback():
+    """Raw-socket sniffer sees UDP datagrams addressed to its port and
+    strips IP+UDP headers (reference: packet_capture.hpp:287)."""
+    import struct
+    from bifrost_tpu.io.packet_capture import UDPSniffer
+    try:
+        rx = UDPSocket().bind(Address('127.0.0.1', 0))
+        port = rx.sock.getsockname()[1]
+        ring = Ring(space='system', name='sniff_rx')
+
+        def cb(desc):
+            return 0, {'name': 'sniff', '_tensor': {
+                'shape': [-1, 1, 32], 'dtype': 'u8',
+                'labels': ['time', 'src', 'byte'],
+                'scales': [[0, 1]] * 3, 'units': [None] * 3}}
+
+        sniff = UDPSniffer('simple', Address('127.0.0.1', port), ring,
+                           1, 0, 32, 8, 8, cb)
+    except PermissionError:
+        import pytest
+        pytest.skip("raw sockets need CAP_NET_RAW")
+    sniff.set_timeout(0.5)
+    tx = UDPSocket().connect(Address('127.0.0.1', port))
+    payload = bytes(range(32))
+    tx.send(struct.pack('>Q', 7) + payload)
+    pkt = sniff._recv_packet()
+    assert pkt is not None
+    d = sniff.fmt.unpack(pkt)
+    assert d.seq == 7 and bytes(d.payload) == payload
+    sniff.close()
+    tx.close()
+    rx.close()
+
+
+def test_send_recv_mmsg_roundtrip():
+    """sendmmsg/recvmmsg batched syscalls round-trip datagrams in order
+    with reusable scatter/gather state."""
+    rx = UDPSocket().bind(Address('127.0.0.1', 0))
+    port = rx.sock.getsockname()[1]
+    rx.set_timeout(0.5)
+    tx = UDPSocket().connect(Address('127.0.0.1', port))
+    pkts = [bytes([i]) * (16 + i) for i in range(8)]
+    assert tx.send_mmsg(pkts) == 8
+    got = rx.recv_mmsg(16, 64)
+    assert [bytes(g) for g in got] == pkts
+    # cached-structure reuse (same sizes)
+    assert tx.send_mmsg(pkts) == 8
+    got = rx.recv_mmsg(16, 64)
+    assert [bytes(g) for g in got] == pkts
+    tx.close()
+    rx.close()
