@@ -1,12 +1,18 @@
-"""CPU core pinning for block threads (reference: src/affinity.cpp:1-191,
-python/bifrost/affinity.py).  Uses Linux sched_setaffinity; no-ops on
-platforms without it."""
+"""CPU core pinning + NUMA memory binding (reference:
+src/affinity.cpp:1-191, src/hw_locality.cpp, python/bifrost/affinity.py).
+Uses Linux sched_setaffinity and the raw mbind syscall (no hwloc/libnuma
+dependency); every entry point no-ops gracefully where unsupported."""
 
 from __future__ import annotations
 
 import os
 
-__all__ = ['get_core', 'set_core', 'set_openmp_cores']
+__all__ = ['get_core', 'set_core', 'set_openmp_cores',
+           'numa_node_of_core', 'bind_memory_to_node',
+           'bind_memory_to_core']
+
+_MBIND_SYSCALL = {'x86_64': 237, 'aarch64': 235}
+_MPOL_BIND = 2
 
 
 def get_core():
@@ -29,3 +35,54 @@ def set_core(core):
 def set_openmp_cores(cores):
     os.environ['OMP_NUM_THREADS'] = str(len(cores)) \
         if not isinstance(cores, int) else str(cores)
+
+
+def numa_node_of_core(core):
+    """The NUMA node a CPU core belongs to, or None if unknown."""
+    try:
+        base = '/sys/devices/system/cpu/cpu%d' % core
+        for entry in os.listdir(base):
+            if entry.startswith('node') and entry[4:].isdigit():
+                return int(entry[4:])
+    except OSError:
+        pass
+    return None
+
+
+def bind_memory_to_node(addr, nbyte, node):
+    """Bind the pages of [addr, addr+nbyte) to a NUMA node via the raw
+    ``mbind`` syscall (the reference hwloc-binds ring memory the same
+    way: ring_impl.cpp:164-166).  Returns True on success, False when
+    NUMA binding is unavailable — callers treat this as advisory."""
+    import ctypes
+    import platform
+    nr = _MBIND_SYSCALL.get(platform.machine())
+    if nr is None or node is None or nbyte <= 0:
+        return False
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        page = os.sysconf('SC_PAGE_SIZE')
+        start = addr & ~(page - 1)
+        length = nbyte + (addr - start)
+        mask = ctypes.c_ulong(1 << node)
+        rc = libc.syscall(ctypes.c_long(nr), ctypes.c_void_p(start),
+                          ctypes.c_ulong(length),
+                          ctypes.c_int(_MPOL_BIND), ctypes.byref(mask),
+                          ctypes.c_ulong(8 * ctypes.sizeof(mask) + 1),
+                          ctypes.c_uint(0))
+        return rc == 0
+    except Exception:
+        return False
+
+
+def bind_memory_to_core(array, core):
+    """Bind a numpy buffer to the NUMA node of ``core`` (advisory).
+    Accepts an int or a list/tuple of cores (first one wins)."""
+    if isinstance(core, (list, tuple)):
+        core = core[0] if core else None
+    if core is None or core < 0:
+        return False
+    node = numa_node_of_core(core)
+    if node is None:
+        return False
+    return bind_memory_to_node(array.ctypes.data, array.nbytes, node)

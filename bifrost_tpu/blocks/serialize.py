@@ -1,10 +1,14 @@
 """Serialize/deserialize a ring stream to disk — the checkpoint/replay
 mechanism (reference: python/bifrost/blocks/serialize.py:45-279).
 
-On-disk layout per sequence:
-  <name>.bf.json              — the sequence header (JSON)
-  <name>.bf.<ringlet>.dat     — raw frame data (one file per ringlet,
-                                single file '0' when nringlet == 1)
+On-disk layout per sequence (reference-compatible):
+  <name>.bf.json                        — the sequence header (JSON)
+  <name>.bf.<frame_offset:012d>.dat     — raw frame data (nringlet == 1)
+  <name>.bf.<frame_offset>.<r>.dat      — one file per ringlet lane
+
+Data files rotate when they exceed ``max_file_size`` bytes (default
+1 GiB, like the reference blocks/serialize.py:173-179); the
+frame-offset filename component makes segments self-describing.
 
 A serialized stream can be re-ingested with DeserializeBlock, giving
 pipeline checkpoint/resume of buffered data (SURVEY.md §5
@@ -13,6 +17,7 @@ checkpoint/resume notes).
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 
@@ -33,42 +38,67 @@ class SerializeBlock(SinkBlock):
     def __init__(self, iring, path=None, max_file_size=None,
                  *args, **kwargs):
         super(SerializeBlock, self).__init__(iring, *args, **kwargs)
-        if max_file_size is not None:
-            raise NotImplementedError(
-                "max_file_size (file splitting) is not implemented yet")
         self.path = path or ''
+        # reference default: 1 GiB per data file (serialize.py:166)
+        self.max_file_size = max_file_size if max_file_size is not None \
+            else 1024 ** 3
         self._files = None
 
     def define_valid_input_spaces(self):
         return ('system',)
 
+    def _data_filenames(self, frame_offset):
+        if self._nringlet == 1:
+            return ['%s.bf.%012i.dat' % (self._base, frame_offset)]
+        ndigit = max(len(str(self._nringlet - 1)), 1)
+        return [('%s.bf.%012i.%0' + str(ndigit) + 'i.dat')
+                % (self._base, frame_offset, r)
+                for r in range(self._nringlet)]
+
+    def _open_files(self, frame_offset):
+        self._close_files()
+        self._bytes_written = 0
+        self._files = [open(f, 'wb')
+                       for f in self._data_filenames(frame_offset)]
+
+    def _close_files(self):
+        if self._files:
+            for f in self._files:
+                f.close()
+        self._files = None
+
     def on_sequence(self, iseq):
         hdr = iseq.header
         basename = _slug(hdr.get('name', 'sequence'))
-        base = os.path.join(self.path, basename)
-        with open(base + '.bf.json', 'w') as f:
-            json.dump(hdr, f)
+        self._base = os.path.join(self.path, basename)
+        with open(self._base + '.bf.json', 'w') as f:
+            json.dump(hdr, f, indent=4, sort_keys=True)
         tensor = hdr['_tensor']
         ringlet_shape, _ = split_shape(tensor['shape'])
-        nringlet = int(np.prod(ringlet_shape)) if ringlet_shape else 1
-        self._nringlet = nringlet
-        self._files = [open('%s.bf.%02i.dat' % (base, r), 'wb')
-                       for r in range(nringlet)]
+        self._nringlet = int(np.prod(ringlet_shape)) if ringlet_shape \
+            else 1
+        self._frame_offset = 0
+        self._open_files(0)
 
     def on_data(self, ispan):
         buf = np.ascontiguousarray(ispan.data.as_numpy())
+        per_lane = buf.nbytes // self._nringlet
+        # rotate at gulp granularity once the per-lane size limit is hit
+        # (reference: serialize.py:173-179)
+        if self._bytes_written and \
+                self._bytes_written + per_lane > self.max_file_size:
+            self._open_files(self._frame_offset)
         if self._nringlet == 1:
             self._files[0].write(buf.tobytes())
         else:
             flat = buf.reshape(self._nringlet, -1)
             for r, f in enumerate(self._files):
                 f.write(flat[r].tobytes())
+        self._bytes_written += per_lane
+        self._frame_offset += ispan.nframe
 
     def on_sequence_end(self, iseq):
-        if self._files:
-            for f in self._files:
-                f.close()
-            self._files = None
+        self._close_files()
 
 
 class _DeserializeReader(object):
@@ -83,16 +113,38 @@ class _DeserializeReader(object):
         dtype = DataType(tensor['dtype'])
         nelem = int(np.prod(frame_shape)) if frame_shape else 1
         self.frame_nbyte = nelem * dtype.itemsize_bits // 8
-        self.files = []
-        r = 0
-        while True:
-            path = '%s.bf.%02i.dat' % (basename, r)
-            if not os.path.exists(path):
-                break
-            self.files.append(open(path, 'rb'))
-            r += 1
-        if not self.files:
+        # discover data-file segments, ordered by frame offset
+        esc = glob.escape(basename)
+        if self.nringlet == 1:
+            groups = []
+            for p in sorted(glob.glob(esc + '.bf.*.dat')):
+                mid = p[len(basename) + 4:-4]
+                if '.' not in mid:      # skip ringlet-style lane files
+                    groups.append([p])
+        else:
+            offsets = sorted({p.rsplit('.', 3)[1]
+                              for p in glob.glob(esc + '.bf.*.*.dat')})
+            groups = []
+            for off in offsets:
+                lanes = sorted(glob.glob('%s.bf.%s.*.dat'
+                                         % (esc, off)))
+                groups.append(lanes)
+        if not groups:
             raise IOError("No .dat files found for %s" % basename)
+        self._segments = groups
+        self._seg_idx = 0
+        self.files = [open(p, 'rb') for p in groups[0]]
+
+    def _next_segment(self):
+        for f in self.files:
+            f.close()
+        self._seg_idx += 1
+        if self._seg_idx >= len(self._segments):
+            self.files = []
+            return False
+        self.files = [open(p, 'rb')
+                      for p in self._segments[self._seg_idx]]
+        return True
 
     def __enter__(self):
         return self
@@ -103,9 +155,20 @@ class _DeserializeReader(object):
         return False
 
     def read_frames(self, nframe):
-        chunks = [f.read(nframe * self.frame_nbyte) for f in self.files]
-        n = min(len(c) for c in chunks) // self.frame_nbyte
-        return [c[:n * self.frame_nbyte] for c in chunks], n
+        """Read up to nframe frames per lane, crossing segment-file
+        boundaries (reference: BifrostReader.readinto)."""
+        want = nframe * self.frame_nbyte
+        chunks = [b''] * max(self.nringlet, 1)
+        while want > 0 and self.files:
+            got = [f.read(want) for f in self.files]
+            n = min(len(c) for c in got)
+            n -= n % self.frame_nbyte
+            chunks = [c + g[:n] for c, g in zip(chunks, got)]
+            want -= n
+            if want > 0 and not self._next_segment():
+                break
+        nread = len(chunks[0]) // self.frame_nbyte
+        return chunks, nread
 
 
 class DeserializeBlock(SourceBlock):

@@ -132,8 +132,14 @@ class _HostStorage(object):
         self.ghost = 0
         self.nringlet = 1
 
-    def allocate(self, size, ghost, nringlet, tail, head, old=None):
+    def allocate(self, size, ghost, nringlet, tail, head, old=None,
+                 core=None):
         new = np.zeros((nringlet, size + ghost), dtype=np.uint8)
+        if core is not None:
+            # advisory NUMA bind of the ring pages to core's node
+            # (reference: ring_impl.cpp:164-166 hwloc bind)
+            from .affinity import bind_memory_to_core
+            bind_memory_to_core(new, core)
         if old is not None and old.buf is not None and head > tail:
             # preserve [tail, head) across the re-layout; when the ringlet
             # count grows, only the existing lanes carry data (matches the
@@ -229,7 +235,8 @@ class _DeviceStorage(object):
         self.ghost = 0
         self.nringlet = 1
 
-    def allocate(self, size, ghost, nringlet, tail, head, old=None):
+    def allocate(self, size, ghost, nringlet, tail, head, old=None,
+                 core=None):
         if old is not None and old is not self:
             self.chunks = dict(old.chunks)
             self._offsets = sorted(self.chunks)
@@ -404,7 +411,8 @@ class Ring(object):
             old = copy(self._storage)
             old.buf = getattr(self._storage, 'buf', None)
             self._storage.allocate(size, ghost, nringlet,
-                                   self._tail, self._head, old=old)
+                                   self._tail, self._head, old=old,
+                                   core=self.core)
             self._size, self._ghost, self._nringlet = size, ghost, nringlet
             self._write_cond.notify_all()
             self._read_cond.notify_all()

@@ -114,6 +114,12 @@ class NativeRing(Ring):
         native.check(self._lib.bft_ring_create(
             ctypes.byref(handle), self.name.encode()), 'create')
         self._handle = handle
+        if core is not None and not isinstance(core, (list, tuple)):
+            # NUMA-bind ring allocations to this core's node
+            # (reference: ring_impl.cpp:164-166)
+            self._lib.bft_ring_set_core(handle, int(core))
+        elif isinstance(core, (list, tuple)) and core:
+            self._lib.bft_ring_set_core(handle, int(core[0]))
         self._storage = _NativeStorage(self)
         self._seq_cache = {}    # native ptr -> _NativeSeq
         self._cache_lock = threading.Lock()

@@ -21,8 +21,14 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#if defined(__linux__)
+#include <dirent.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+#endif
 #include <deque>
 #include <limits>
 #include <map>
@@ -68,6 +74,43 @@ struct Reader {
     int64_t guarantee_offset = 0;   // only meaningful if guarantee
 };
 
+// Bind freshly allocated ring pages to the NUMA node of `core` via the
+// raw mbind syscall (reference binds ring memory with hwloc:
+// ring_impl.cpp:164-166).  Advisory: failures are ignored.
+#if defined(__linux__)
+static void numa_bind_to_core(void* addr, size_t len, int core) {
+#ifdef SYS_mbind
+    if (core < 0 || !addr || !len) return;
+    char path[96];
+    std::snprintf(path, sizeof(path),
+                  "/sys/devices/system/cpu/cpu%d", core);
+    DIR* d = opendir(path);
+    if (!d) return;
+    int node = -1;
+    while (struct dirent* e = readdir(d)) {
+        if (std::strncmp(e->d_name, "node", 4) == 0 &&
+            e->d_name[4] >= '0' && e->d_name[4] <= '9') {
+            node = std::atoi(e->d_name + 4);
+            break;
+        }
+    }
+    closedir(d);
+    if (node < 0) return;
+    const int MPOL_BIND_ = 2;
+    unsigned long mask = 1UL << node;
+    long page = sysconf(_SC_PAGESIZE);
+    uintptr_t start = (uintptr_t)addr & ~(uintptr_t)(page - 1);
+    size_t length = len + ((uintptr_t)addr - start);
+    syscall(SYS_mbind, (void*)start, length, MPOL_BIND_, &mask,
+            8 * sizeof(mask) + 1, 0);
+#else
+    (void)addr; (void)len; (void)core;
+#endif
+}
+#else
+static void numa_bind_to_core(void*, size_t, int) {}
+#endif
+
 struct Ring {
     std::mutex mtx;
     std::condition_variable read_cv;     // data committed / seq ended
@@ -101,6 +144,7 @@ struct Ring {
     int nread_open = 0;
     bool writing = false;
     bool eod = false;
+    int bind_core = -1;      // NUMA-bind new allocations to this core
     std::atomic<long long> total_written{0};
 
     int64_t lane_nbyte() const { return size + ghost; }
@@ -136,6 +180,9 @@ struct Ring {
         if (posix_memalign(reinterpret_cast<void**>(&nb), ALIGNMENT,
                            total ? total : ALIGNMENT) != 0)
             return BFT_ERR_ALLOC;
+        // bind BEFORE first touch: mbind without MPOL_MF_MOVE only
+        // steers future page faults, and memset faults every page
+        numa_bind_to_core(nb, total, bind_core);
         std::memset(nb, 0, total);
         if (buf && head > tail) {
             // preserve [tail, head) across the re-layout, per lane
@@ -205,6 +252,14 @@ int bft_ring_create(void** out, const char* name) {
 
 int bft_ring_destroy(void* ring) {
     delete static_cast<Ring*>(ring);
+    return BFT_OK;
+}
+
+int bft_ring_set_core(void* ring_, int core) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    r->bind_core = core;
     return BFT_OK;
 }
 

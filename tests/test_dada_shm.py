@@ -1,0 +1,113 @@
+"""PSRDADA-style shared-memory ring tests (VERDICT r1 item 8;
+reference analogue: python/bifrost/psrdada.py + blocks/psrdada.py)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu.io.dada_shm import IpcRing, DadaHDU, sysv_available
+
+from util import GatherSink
+
+pytestmark = pytest.mark.skipif(not sysv_available(),
+                                reason="System V shm unavailable")
+
+# distinct keys per test to dodge stale segments
+_KEY = 0x5bf0
+
+
+def test_ipcring_flow_control_and_eod():
+    ring = IpcRing(_KEY, nbufs=2, bufsz=64, create=True)
+    try:
+        reader = IpcRing(_KEY)       # attach
+        got = []
+
+        def read():
+            while True:
+                buf, n, eod = reader.open_read_buf()
+                got.append(bytes(buf[:n]))
+                reader.mark_cleared()
+                if eod:
+                    return
+
+        t = threading.Thread(target=read)
+        t.start()
+        for k in range(5):           # > nbufs: exercises EMPTY waits
+            w = ring.open_write_buf()
+            w[:] = k
+            ring.mark_filled()
+        w = ring.open_write_buf()
+        w[:3] = 9
+        ring.mark_filled(3, eod=True)
+        t.join(10)
+        assert not t.is_alive()
+        assert len(got) == 6
+        assert got[2] == bytes([2]) * 64
+        assert got[5] == bytes([9]) * 3
+    finally:
+        ring.destroy()
+
+
+def test_hdu_header_roundtrip():
+    hdu = DadaHDU(_KEY + 0x10, create=True, data_nbufs=2,
+                  data_bufsz=128)
+    try:
+        peer = DadaHDU(_KEY + 0x10)
+        hdu.write_header({'NBIT': 8, 'NCHAN': 4, 'NPOL': 2,
+                          'SOURCE': 'J0000+0000'})
+        raw = peer.read_header()
+        text = raw.decode('ascii')
+        assert 'NBIT 8' in text and 'SOURCE J0000+0000' in text
+    finally:
+        hdu.destroy()
+
+
+def test_psrdada_pipeline_ingest():
+    """Writer process-role fills the ring; the psrdada source block
+    streams it into a pipeline."""
+    key = _KEY + 0x20
+    hdu = DadaHDU(key, create=True, data_nbufs=4, data_bufsz=256)
+    try:
+        rng = np.random.RandomState(0)
+        data = rng.randint(0, 255, size=(64, 4, 2)).astype(np.uint8)
+
+        def writer():
+            hdu.write_header({'NBIT': 8, 'NCHAN': 4, 'NPOL': 2,
+                              'NDIM': 1, 'TSAMP': 10.0})
+            hdu.write_data(data, eod=True)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        with bf.Pipeline() as p:
+            b = bf.blocks.read_psrdada_buffer(key, gulp_nframe=16)
+            sink = GatherSink(b)
+            p.run()
+        t.join(10)
+        out = sink.result()
+        assert sink.headers[0]['dada_header']['NCHAN'] == 4
+        assert out.shape == (64, 4, 2)
+        np.testing.assert_array_equal(out.view(np.uint8), data)
+    finally:
+        hdu.destroy()
+
+
+def test_psrdada_shutdown_with_stalled_writer():
+    """A pipeline whose DADA producer never writes must still shut down
+    (timed semaphore waits observing shutdown_event)."""
+    import time
+    key = _KEY + 0x30
+    hdu = DadaHDU(key, create=True, data_nbufs=2, data_bufsz=64)
+    try:
+        with bf.Pipeline() as p:
+            b = bf.blocks.read_psrdada_buffer(key, gulp_nframe=4)
+            sink = GatherSink(b)
+            t = threading.Thread(target=p.run, daemon=True)
+            t.start()
+            time.sleep(0.5)          # source is now blocked on the sem
+            p.shutdown()
+            t.join(10)
+            assert not t.is_alive()
+    finally:
+        hdu.destroy()

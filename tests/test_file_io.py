@@ -130,3 +130,35 @@ def test_serialize_deserialize_roundtrip(tmp_path):
         p.run()
     np.testing.assert_array_equal(sink.result(), data)
     assert sink.headers[0]['_tensor']['labels'] == ['time', 'dim1']
+
+
+def test_serialize_max_file_size_splitting(tmp_path):
+    """Data files rotate at max_file_size with frame-offset filenames;
+    deserialize reads across segment boundaries (reference:
+    blocks/serialize.py:173-179)."""
+    rng = np.random.RandomState(5)
+    data = rng.randn(64, 8).astype(np.float32)
+    gulps = [data[i * 8:(i + 1) * 8] for i in range(8)]
+    hdr = simple_header([-1, 8], 'f32', name='splitme')
+    hdr['name'] = 'splitme'
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock(gulps, hdr, gulp_nframe=8)
+        # 8 frames * 8 chans * 4 B = 256 B per gulp; cap at 600 B
+        # -> rotate every 2-3 gulps
+        bf.blocks.serialize(src, path=str(tmp_path), max_file_size=600)
+        p.run()
+    import glob as glob_mod
+    dats = sorted(glob_mod.glob(str(tmp_path / 'splitme.bf.*.dat')))
+    assert len(dats) > 1, dats
+    # segment filenames carry the frame offset
+    offs = [int(d.rsplit('.', 2)[1]) for d in dats]
+    assert offs[0] == 0 and offs == sorted(offs)
+    total = sum(len(open(d, 'rb').read()) for d in dats)
+    assert total == data.nbytes
+    # read back across segments
+    with bf.Pipeline() as p:
+        b = bf.blocks.deserialize([str(tmp_path / 'splitme')],
+                                  gulp_nframe=16)
+        sink = GatherSink(b)
+        p.run()
+    np.testing.assert_array_equal(sink.result(), data)
