@@ -41,3 +41,81 @@ def test_gridding_wraps_at_edge():
     out = np.asarray(rom.execute(data))
     np.testing.assert_allclose(out, _oracle(data, pos, kern, ngrid),
                                rtol=1e-5)
+
+
+def test_set_positions_and_kernels_update():
+    """Plan updates between executes (reference: bfRomeinSetPositions /
+    SetKernels, src/romein.cu:533-566)."""
+    rng = np.random.RandomState(2)
+    npts, ksize, ngrid = 20, 3, 24
+    data = (rng.randn(npts) + 1j * rng.randn(npts)).astype(np.complex64)
+    pos1 = rng.randint(0, ngrid - ksize, size=(npts, 2)).astype(np.int32)
+    pos2 = rng.randint(0, ngrid - ksize, size=(npts, 2)).astype(np.int32)
+    k1 = (rng.randn(npts, ksize, ksize) +
+          1j * rng.randn(npts, ksize, ksize)).astype(np.complex64)
+    k2 = (rng.randn(npts, ksize, ksize) +
+          1j * rng.randn(npts, ksize, ksize)).astype(np.complex64)
+    rom = Romein().init(pos1, k1, ngrid)
+    np.testing.assert_allclose(np.asarray(rom.execute(data)),
+                               _oracle(data, pos1, k1, ngrid),
+                               rtol=1e-4, atol=1e-4)
+    rom.set_positions(pos2)
+    np.testing.assert_allclose(np.asarray(rom.execute(data)),
+                               _oracle(data, pos2, k1, ngrid),
+                               rtol=1e-4, atol=1e-4)
+    rom.set_kernels(k2)
+    np.testing.assert_allclose(np.asarray(rom.execute(data)),
+                               _oracle(data, pos2, k2, ngrid),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_accumulate_into_existing_grid():
+    """accumulate=True adds onto odata instead of zero-initializing
+    (reference: romein.cu grid accumulation semantics)."""
+    rng = np.random.RandomState(3)
+    npts, ksize, ngrid = 15, 3, 16
+    data = (rng.randn(npts) + 1j * rng.randn(npts)).astype(np.complex64)
+    pos = rng.randint(0, ngrid - ksize, size=(npts, 2)).astype(np.int32)
+    kern = (rng.randn(npts, ksize, ksize) +
+            1j * rng.randn(npts, ksize, ksize)).astype(np.complex64)
+    base = (rng.randn(ngrid, ngrid) +
+            1j * rng.randn(ngrid, ngrid)).astype(np.complex64)
+    rom = Romein().init(pos, kern, ngrid)
+    out = np.empty((ngrid, ngrid), np.complex64)
+    got = rom.execute(data, odata=base.copy(), accumulate=True)
+    np.testing.assert_allclose(np.asarray(got),
+                               base + _oracle(data, pos, kern, ngrid),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_batched_polarizations():
+    """Leading batch axes (e.g. polarization) grid independently with
+    shared positions/kernels."""
+    rng = np.random.RandomState(4)
+    npol, npts, ksize, ngrid = 2, 12, 3, 16
+    data = (rng.randn(npol, npts) +
+            1j * rng.randn(npol, npts)).astype(np.complex64)
+    pos = rng.randint(0, ngrid - ksize, size=(npts, 2)).astype(np.int32)
+    kern = (rng.randn(npts, ksize, ksize) +
+            1j * rng.randn(npts, ksize, ksize)).astype(np.complex64)
+    rom = Romein().init(pos, kern, ngrid)
+    out = np.asarray(rom.execute(data))
+    assert out.shape == (npol, ngrid, ngrid)
+    for p in range(npol):
+        np.testing.assert_allclose(out[p],
+                                   _oracle(data[p], pos, kern, ngrid),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_real_input_promotes():
+    """Real float data grids into a complex grid."""
+    rng = np.random.RandomState(5)
+    npts, ksize, ngrid = 10, 2, 8
+    data = rng.randn(npts).astype(np.float32)
+    pos = rng.randint(0, ngrid - ksize, size=(npts, 2)).astype(np.int32)
+    kern = np.ones((npts, ksize, ksize), np.complex64)
+    rom = Romein().init(pos, kern, ngrid)
+    out = np.asarray(rom.execute(data))
+    np.testing.assert_allclose(
+        out, _oracle(data.astype(np.complex64), pos, kern, ngrid),
+        rtol=1e-5, atol=1e-5)
