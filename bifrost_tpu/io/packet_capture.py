@@ -20,6 +20,7 @@ header tensor must describe the same frame size.
 
 from __future__ import annotations
 
+import ctypes
 import errno
 import socket as socket_mod
 
@@ -28,8 +29,8 @@ import numpy as np
 from .packet_formats import get_format, PacketDesc
 from ..ring import RingWriter
 
-__all__ = ['PacketCaptureCallback', 'UDPCapture', 'UDPSniffer',
-           'DiskReader',
+__all__ = ['PacketCaptureCallback', 'UDPCapture', 'NativeUDPCapture',
+           'UDPSniffer', 'DiskReader',
            'CAPTURE_STARTED', 'CAPTURE_CONTINUED', 'CAPTURE_ENDED',
            'CAPTURE_NO_DATA', 'CAPTURE_INTERRUPTED']
 
@@ -309,15 +310,46 @@ class _PacketCapture(object):
         self.end()
 
 
+#: wire formats with a native C++ decoder (native/capture.cpp)
+_NATIVE_FMT_IDS = {'simple': 0, 'chips': 1}
+
+
+def _native_capture_usable(fmt, sock, ring):
+    import os
+    if os.environ.get('BF_NO_NATIVE_CAPTURE'):
+        return False
+    try:
+        from ..ring_native import NativeRing
+    except Exception:
+        return False
+    if not isinstance(ring, NativeRing):
+        return False
+    base = fmt.split('_')[0] if isinstance(fmt, str) else \
+        getattr(fmt, 'name', None)
+    if base not in _NATIVE_FMT_IDS:
+        return False
+    return hasattr(sock, 'fileno')
+
+
 class UDPCapture(_PacketCapture):
     """Capture packets from a UDP socket (reference:
     bfUdpCaptureCreate, src/packet_capture.cpp:324).
 
-    Uses recvmmsg batching when the socket supports it (up to
-    ``batch`` datagrams per syscall — the reference's Socket.hpp:145-158
-    shim); falls back to per-packet recv otherwise."""
+    Dispatch: when the ring is native and the format has a C++ decoder,
+    construction returns a :class:`NativeUDPCapture` — the whole
+    recv/decode/scatter loop runs in native/capture.cpp like the
+    reference engine (set BF_NO_NATIVE_CAPTURE=1 to force Python).
+    The Python engine uses recvmmsg batching + vectorized decode when
+    the socket and format support it, per-packet recv otherwise."""
 
     BATCH = 128
+
+    def __new__(cls, fmt=None, sock=None, ring=None, *args, **kwargs):
+        if cls is UDPCapture and _native_capture_usable(fmt, sock, ring):
+            from ..native import available
+            if available():
+                return super(UDPCapture, cls).__new__(NativeUDPCapture)
+        return super(UDPCapture, cls).__new__(cls)
 
     def __init__(self, fmt, sock, ring, nsrc, src0, max_payload_size,
                  buffer_ntime, slot_ntime, sequence_callback, core=None,
@@ -366,6 +398,165 @@ class UDPCapture(_PacketCapture):
         pkt = self._pending[self._pending_idx]
         self._pending_idx += 1
         return pkt
+
+
+class _BftPktDesc(ctypes.Structure):
+    # mirrors bft_pkt_desc in native/capture.cpp
+    _fields_ = [('seq', ctypes.c_longlong),
+                ('time_tag', ctypes.c_longlong),
+                ('src', ctypes.c_int),
+                ('nsrc', ctypes.c_int),
+                ('nchan', ctypes.c_int),
+                ('chan0', ctypes.c_int),
+                ('tuning', ctypes.c_int),
+                ('gain', ctypes.c_int),
+                ('decimation', ctypes.c_int),
+                ('payload_size', ctypes.c_int)]
+
+
+class NativeUDPCapture(UDPCapture):
+    """UDP capture driven end-to-end by the native engine
+    (native/capture.cpp): recvmmsg batches, C++ header decode, scatter
+    straight into the native ring's buffer, loss accounting and
+    blanking — the reference's capture-thread architecture
+    (src/packet_capture.hpp:150-607).  Python is entered only once per
+    sequence to build the ring header (the same C->Python callback
+    boundary the reference has)."""
+
+    def __init__(self, fmt, sock, ring, nsrc, src0, max_payload_size,
+                 buffer_ntime, slot_ntime, sequence_callback, core=None,
+                 batch=None):
+        import json
+        from .. import native as native_mod
+        # shared setup (format/callback resolution, counters, proclog)
+        _PacketCapture.__init__(self, fmt, ring, nsrc, src0,
+                                max_payload_size, buffer_ntime,
+                                slot_ntime, sequence_callback, core)
+        self.sock = sock
+        self._lib = native_mod.load()
+        self._cb_error = None
+        handle = ctypes.c_void_p()
+        native_mod.check(self._lib.bft_capture_create(
+            ctypes.byref(handle), _NATIVE_FMT_IDS[self.fmt.name],
+            sock.fileno(), ring._handle, self.nsrc, src0,
+            max_payload_size, buffer_ntime, slot_ntime), 'capture')
+        self._handle = handle
+        self._applied_timeout = object()     # force first sync
+        self._sync_timeout()
+
+        CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                              ctypes.POINTER(_BftPktDesc),
+                              ctypes.POINTER(ctypes.c_longlong),
+                              ctypes.POINTER(ctypes.c_char),
+                              ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_char),
+                              ctypes.c_int)
+
+        def header_cb(user, desc_p, time_tag_out, name_buf, name_cap,
+                      hdr_buf, hdr_cap):
+            try:
+                d = desc_p.contents
+                desc = PacketDesc(seq=d.seq, src=d.src, nsrc=d.nsrc,
+                                  nchan=d.nchan, chan0=d.chan0,
+                                  time_tag=d.time_tag, tuning=d.tuning,
+                                  gain=d.gain,
+                                  decimation=max(d.decimation, 1))
+                time_tag, hdr = self.callback(desc)
+                hdr.setdefault('time_tag', time_tag)
+                hdr.setdefault('name', 'capture-%d' % time_tag)
+                hdr.setdefault('gulp_nframe', self.buffer_ntime)
+                name = str(hdr['name']).encode()[:name_cap - 1]
+                ctypes.memmove(name_buf, name + b'\x00', len(name) + 1)
+                raw = json.dumps(hdr).encode()
+                if len(raw) + 1 > hdr_cap:
+                    raise ValueError("header JSON too large")
+                ctypes.memmove(hdr_buf, raw + b'\x00', len(raw) + 1)
+                time_tag_out[0] = time_tag
+                return 0
+            except BaseException as e:
+                # surfaced by the next recv() on the Python side
+                self._cb_error = e
+                return -1
+
+        self._cb = CB(header_cb)     # keep a reference alive
+        self._lib.bft_capture_set_header_callback(
+            handle, ctypes.cast(self._cb, ctypes.c_void_p), None)
+        self.stats = _NativeCaptureStats(self)
+
+    def _sync_timeout(self):
+        """Mirror the socket's (possibly updated) timeout into the
+        native poll: None = block like the Python engine's select."""
+        t = getattr(self.sock, '_timeout', None)
+        if t != self._applied_timeout:
+            self._lib.bft_capture_set_timeout_ms(
+                self._handle, -1 if t is None else max(int(t * 1000), 1))
+            self._applied_timeout = t
+
+    def recv(self):
+        from .. import native as native_mod
+        self._sync_timeout()
+        status = ctypes.c_int(0)
+        native_mod.check(self._lib.bft_capture_recv(
+            self._handle, ctypes.byref(status)), 'recv')
+        if self._cb_error is not None:
+            err, self._cb_error = self._cb_error, None
+            raise err
+        if status.value in (CAPTURE_STARTED, CAPTURE_CONTINUED):
+            self._stats_proclog.update({
+                k: v for k, v in self.stats._read().items()
+                if k != 'src_ngood'})
+        return status.value
+
+    def flush(self):
+        self._lib.bft_capture_flush(self._handle)
+
+    def end(self):
+        self._lib.bft_capture_end(self._handle)
+        return CAPTURE_ENDED
+
+    def __del__(self):
+        try:
+            if getattr(self, '_handle', None) is not None:
+                self._lib.bft_capture_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class _NativeCaptureStats(object):
+    """Read-through view of the native engine's counters, dict-like to
+    match the Python engine's ``stats``."""
+
+    def __init__(self, cap):
+        self._cap = cap
+
+    def _read(self):
+        ll = ctypes.c_longlong
+        g, m, iv, ig = ll(0), ll(0), ll(0), ll(0)
+        self._cap._lib.bft_capture_stats(
+            self._cap._handle, ctypes.byref(g), ctypes.byref(m),
+            ctypes.byref(iv), ctypes.byref(ig))
+        src = (ll * self._cap.nsrc)()
+        self._cap._lib.bft_capture_src_ngood(
+            self._cap._handle, src, self._cap.nsrc)
+        return {'ngood_bytes': g.value, 'nmissing_bytes': m.value,
+                'ninvalid': iv.value, 'nignored': ig.value,
+                'src_ngood': np.asarray(list(src), np.int64)}
+
+    def __getitem__(self, key):
+        return self._read()[key]
+
+    def get(self, key, default=None):
+        return self._read().get(key, default)
+
+    def __repr__(self):
+        return repr(self._read())
 
 
 class UDPSniffer(_PacketCapture):

@@ -63,6 +63,19 @@ def _declare(lib):
         'bft_ring_reserve': ([c.c_void_p, ll, c.c_int, P(ll), P(ll)],
                              c.c_int),
         'bft_ring_commit': ([c.c_void_p, ll, ll], c.c_int),
+        'bft_capture_create': ([P(c.c_void_p), c.c_int, c.c_int,
+                                c.c_void_p, c.c_int, c.c_int, c.c_int,
+                                c.c_int, c.c_int], c.c_int),
+        'bft_capture_set_header_callback': ([c.c_void_p, c.c_void_p,
+                                             c.c_void_p], c.c_int),
+        'bft_capture_set_timeout_ms': ([c.c_void_p, c.c_int], c.c_int),
+        'bft_capture_recv': ([c.c_void_p, P(c.c_int)], c.c_int),
+        'bft_capture_flush': ([c.c_void_p], c.c_int),
+        'bft_capture_end': ([c.c_void_p], c.c_int),
+        'bft_capture_stats': ([c.c_void_p, P(ll), P(ll), P(ll), P(ll)],
+                              c.c_int),
+        'bft_capture_src_ngood': ([c.c_void_p, P(ll), c.c_int], c.c_int),
+        'bft_capture_destroy': ([c.c_void_p], c.c_int),
         'bft_reader_create': ([c.c_void_p, c.c_int, P(ll)], c.c_int),
         'bft_reader_destroy': ([c.c_void_p, ll], c.c_int),
         'bft_reader_set_guarantee': ([c.c_void_p, ll, ll, c.c_int],
@@ -112,10 +125,12 @@ def load():
             return None
         path = _lib_path()
         try:
-            src = os.path.join(_repo_root(), 'native', 'ring.cpp')
+            srcs = [os.path.join(_repo_root(), 'native', f)
+                    for f in ('ring.cpp', 'capture.cpp')]
             stale = (not os.path.exists(path) or
-                     (os.path.exists(src) and
-                      os.path.getmtime(src) > os.path.getmtime(path)))
+                     any(os.path.exists(src) and
+                         os.path.getmtime(src) > os.path.getmtime(path)
+                         for src in srcs))
             if stale:
                 if os.path.exists(path):
                     os.unlink(path)

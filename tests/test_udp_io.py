@@ -47,7 +47,24 @@ def _run_capture(capture, max_iters=100):
     capture.end()
 
 
-def test_udp_loopback_chips():
+import pytest
+
+
+@pytest.fixture(params=['native', 'python'])
+def capture_engine(request, monkeypatch):
+    """Run loopback tests against BOTH capture engines: the native C++
+    engine (native/capture.cpp, auto-selected) and the Python engine
+    (BF_NO_NATIVE_CAPTURE=1)."""
+    if request.param == 'python':
+        monkeypatch.setenv('BF_NO_NATIVE_CAPTURE', '1')
+    else:
+        from bifrost_tpu import native
+        if not native.available():
+            pytest.skip('native library unavailable')
+    return request.param
+
+
+def test_udp_loopback_chips(capture_engine):
     addr = Address('127.0.0.1', 0)
     rx = UDPSocket().bind(addr)
     port = rx.sock.getsockname()[1]
@@ -101,9 +118,12 @@ def test_udp_loopback_chips():
     assert out.shape[0] >= NSEQ
     np.testing.assert_array_equal(out[:NSEQ], data)
     assert capture.stats['ngood_bytes'] > 0
+    from bifrost_tpu.io.packet_capture import NativeUDPCapture
+    is_native = isinstance(capture, NativeUDPCapture)
+    assert is_native == (capture_engine == 'native')
 
 
-def test_udp_loopback_with_packet_loss():
+def test_udp_loopback_with_packet_loss(capture_engine):
     """Dropped packets leave zeroed slots; loss is accounted per source."""
     addr = Address('127.0.0.1', 0)
     rx = UDPSocket().bind(addr)
