@@ -310,25 +310,35 @@ class _PacketCapture(object):
         self.end()
 
 
-#: wire formats with a native C++ decoder (native/capture.cpp)
-_NATIVE_FMT_IDS = {'simple': 0, 'chips': 1}
+#: wire formats with a native C++ decoder/filler (native/capture.cpp);
+#: ids must match the FMT_* enum there
+NATIVE_FMT_IDS = {'simple': 0, 'chips': 1}
+_NATIVE_FMT_IDS = NATIVE_FMT_IDS    # backwards-compat alias
 
 
-def _native_capture_usable(fmt, sock, ring):
+def native_io_usable(fmt, sock):
+    """Shared gate for the native IO engines: env opt-out, format has a
+    C++ codec, socket exposes a file descriptor, and the .so was built
+    with the (Linux-only) engines rather than portable stubs."""
     import os
     if os.environ.get('BF_NO_NATIVE_CAPTURE'):
         return False
+    base = fmt.split('_')[0] if isinstance(fmt, str) else \
+        getattr(fmt, 'name', None)
+    if base not in NATIVE_FMT_IDS or not hasattr(sock, 'fileno'):
+        return False
+    from ..native import io_engine_supported
+    return io_engine_supported()
+
+
+def _native_capture_usable(fmt, sock, ring):
     try:
         from ..ring_native import NativeRing
     except Exception:
         return False
     if not isinstance(ring, NativeRing):
         return False
-    base = fmt.split('_')[0] if isinstance(fmt, str) else \
-        getattr(fmt, 'name', None)
-    if base not in _NATIVE_FMT_IDS:
-        return False
-    return hasattr(sock, 'fileno')
+    return native_io_usable(fmt, sock)
 
 
 class UDPCapture(_PacketCapture):

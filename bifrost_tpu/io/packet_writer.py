@@ -14,7 +14,8 @@ import numpy as np
 
 from .packet_formats import get_format, PacketDesc
 
-__all__ = ['HeaderInfo', 'UDPTransmit', 'DiskWriter', 'RateLimiter']
+__all__ = ['HeaderInfo', 'UDPTransmit', 'NativeUDPTransmit',
+           'DiskWriter', 'RateLimiter']
 
 
 class HeaderInfo(object):
@@ -117,13 +118,83 @@ class _WriterBase(object):
         return False
 
 
+def _native_tx_usable(fmt, sock):
+    from .packet_capture import native_io_usable
+    return native_io_usable(fmt, sock)
+
+
 class UDPTransmit(_WriterBase):
+    """UDP packet transmitter.  When the format has a native filler
+    (native/capture.cpp transmit engine) the whole header-fill +
+    sendmmsg loop runs in C++ (set BF_NO_NATIVE_CAPTURE=1 to force
+    Python)."""
+
+    def __new__(cls, fmt=None, sock=None, *args, **kwargs):
+        if cls is UDPTransmit and _native_tx_usable(fmt, sock):
+            from ..native import available
+            if available():
+                return super(UDPTransmit, cls).__new__(NativeUDPTransmit)
+        return super(UDPTransmit, cls).__new__(cls)
+
     def __init__(self, fmt, sock, core=None):
         super(UDPTransmit, self).__init__(fmt, core)
         self.sock = sock
 
     def _send_bytes(self, data):
         self.sock.send(data)
+
+
+class NativeUDPTransmit(UDPTransmit):
+    """Native transmit engine: C++ header fill + sendmmsg batches +
+    in-engine token-bucket pacing (reference: packet_writer.hpp:59-580).
+    """
+
+    def __init__(self, fmt, sock, core=None):
+        import ctypes
+        from .. import native as native_mod
+        _WriterBase.__init__(self, fmt, core)
+        self.sock = sock
+        self._lib = native_mod.load()
+        handle = ctypes.c_void_p()
+        from .packet_capture import NATIVE_FMT_IDS
+        native_mod.check(self._lib.bft_transmit_create(
+            ctypes.byref(handle), NATIVE_FMT_IDS[self.fmt.name],
+            sock.fileno()), 'transmit')
+        self._handle = handle
+
+    def set_rate_limit(self, rate_pps):
+        self.limiter = RateLimiter(rate_pps)   # kept for introspection
+        self._lib.bft_transmit_set_rate(self._handle, int(rate_pps))
+
+    def send(self, headerinfo, seq, seq_increment, src, src_increment,
+             idata):
+        import ctypes
+        from .. import native as native_mod
+        arr = np.ascontiguousarray(np.asarray(idata))
+        if arr.ndim < 2:
+            arr = arr.reshape(1, 1, -1)
+        nseq, nsrc = arr.shape[0], arr.shape[1]
+        payloads = np.ascontiguousarray(
+            arr.reshape(nseq, nsrc, -1).view(np.uint8))
+        nsent = ctypes.c_longlong(0)
+        native_mod.check(self._lib.bft_transmit_send(
+            self._handle, int(seq), int(seq_increment), int(src),
+            int(src_increment), int(headerinfo.nsrc),
+            int(headerinfo.chan0), int(headerinfo.nchan),
+            int(headerinfo.tuning), int(headerinfo.gain),
+            payloads.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_ubyte)),
+            nseq, nsrc, payloads.shape[-1], ctypes.byref(nsent)),
+            'send')
+        self.npackets_sent += nsent.value
+
+    def __del__(self):
+        try:
+            if getattr(self, '_handle', None) is not None:
+                self._lib.bft_transmit_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass
 
 
 class DiskWriter(_WriterBase):

@@ -75,6 +75,14 @@ def _declare(lib):
         'bft_capture_stats': ([c.c_void_p, P(ll), P(ll), P(ll), P(ll)],
                               c.c_int),
         'bft_capture_src_ngood': ([c.c_void_p, P(ll), c.c_int], c.c_int),
+        'bft_transmit_create': ([P(c.c_void_p), c.c_int, c.c_int],
+                                c.c_int),
+        'bft_transmit_set_rate': ([c.c_void_p, ll], c.c_int),
+        'bft_transmit_send': ([c.c_void_p, ll, ll, c.c_int, c.c_int,
+                               c.c_int, c.c_int, c.c_int, c.c_int,
+                               c.c_int, P(c.c_ubyte), c.c_int, c.c_int,
+                               c.c_int, P(ll)], c.c_int),
+        'bft_transmit_destroy': ([c.c_void_p], c.c_int),
         'bft_capture_destroy': ([c.c_void_p], c.c_int),
         'bft_reader_create': ([c.c_void_p, c.c_int, P(ll)], c.c_int),
         'bft_reader_destroy': ([c.c_void_p, ll], c.c_int),
@@ -140,6 +148,27 @@ def load():
                 subprocess.CalledProcessError):
             _lib = None   # fall back to the pure-Python core
         return _lib
+
+
+_io_engine_supported = None
+
+
+def io_engine_supported():
+    """Whether the native IO engines (capture/transmit) are compiled in
+    (the .so builds portable stubs on non-Linux that return errors)."""
+    global _io_engine_supported
+    if _io_engine_supported is None:
+        lib = load()
+        ok = False
+        if lib is not None:
+            import ctypes
+            h = ctypes.c_void_p()
+            # fmt 0 / fd -1: create validates only engine availability
+            if lib.bft_transmit_create(ctypes.byref(h), 0, -1) == 0:
+                lib.bft_transmit_destroy(h)
+                ok = True
+        _io_engine_supported = ok
+    return _io_engine_supported
 
 
 def available():

@@ -27,6 +27,7 @@
 // return BFT_ERR_INVALID and Python auto-falls-back to its engine,
 // keeping the native RING portable.
 #if defined(__linux__)
+#include <ctime>
 #include <poll.h>
 #include <sys/socket.h>
 #include <sys/types.h>
@@ -93,6 +94,13 @@ static inline uint64_t be64(const uint8_t* p) {
 static inline uint16_t be16(const uint8_t* p) {
     return (uint16_t)((p[0] << 8) | p[1]);
 }
+static inline void wbe64(uint8_t* p, uint64_t v) {
+    for (int i = 7; i >= 0; --i) { p[i] = (uint8_t)v; v >>= 8; }
+}
+static inline void wbe16(uint8_t* p, uint16_t v) {
+    p[1] = (uint8_t)v;
+    p[0] = (uint8_t)(v >> 8);
+}
 
 static bool decode_packet(int fmt, const uint8_t* pkt, int len,
                           bft_pkt_desc* d, const uint8_t** payload,
@@ -132,6 +140,13 @@ struct Buf {
     long long span_id = -1;
     long long begin = 0;        // ring byte offset
     std::vector<uint8_t> got;   // ntime * nsrc
+};
+
+struct Transmit {
+    int fmt = FMT_SIMPLE;
+    int sockfd = -1;
+    long long rate_pps = 0;     // 0 = unlimited
+    double next_time = 0.0;
 };
 
 struct Capture {
@@ -438,6 +453,131 @@ int bft_capture_destroy(void* cap) {
     return BFT_OK;
 }
 
+// ---------------------------------------------------------------------------
+// Native packet writer: header fill + sendmmsg batches
+// (reference: src/packet_writer.hpp:59-580 — HeaderInfo + per-format
+// fillers + senders + token-bucket rate limiter)
+// ---------------------------------------------------------------------------
+
+int bft_transmit_create(void** out, int fmt, int sockfd) {
+    if (!out) return BFT_ERR_INVALID;
+    if (fmt != FMT_SIMPLE && fmt != FMT_CHIPS) return BFT_ERR_INVALID;
+    auto* t = new Transmit();
+    t->fmt = fmt;
+    t->sockfd = sockfd;
+    *out = t;
+    return BFT_OK;
+}
+
+int bft_transmit_set_rate(void* tr, long long pps) {
+    auto* t = static_cast<Transmit*>(tr);
+    if (!t) return BFT_ERR_INVALID;
+    t->rate_pps = pps;
+    t->next_time = 0.0;
+    return BFT_OK;
+}
+
+// Send nseq*nsrc packets: packet (i, j) carries seq0 + i*seq_inc and
+// src0 + j*src_inc with payload data[i, j, :payload_size].
+int bft_transmit_send(void* tr, long long seq0, long long seq_inc,
+                      int src0, int src_inc, int hdr_nsrc, int chan0,
+                      int nchan, int tuning, int gain,
+                      const unsigned char* data, int nseq, int nsrc,
+                      int payload_size, long long* nsent_out) {
+    auto* t = static_cast<Transmit*>(tr);
+    if (!t || !data || nseq <= 0 || nsrc <= 0 || payload_size <= 0)
+        return BFT_ERR_INVALID;
+    const int hdr_len = (t->fmt == FMT_SIMPLE) ? 8 : 16;
+    const int pkt_len = hdr_len + payload_size;
+    const int BATCH = 64;
+    std::vector<uint8_t> bufs((size_t)BATCH * pkt_len);
+    std::vector<mmsghdr> hdrs(BATCH);
+    std::vector<iovec> iovs(BATCH);
+    for (int k = 0; k < BATCH; ++k) {
+        iovs[k].iov_base = bufs.data() + (size_t)k * pkt_len;
+        iovs[k].iov_len = pkt_len;
+        std::memset(&hdrs[k], 0, sizeof(mmsghdr));
+        hdrs[k].msg_hdr.msg_iov = &iovs[k];
+        hdrs[k].msg_hdr.msg_iovlen = 1;
+    }
+    long long nsent = 0;
+    int k = 0;
+    auto flush = [&]() -> bool {
+        int off = 0;
+        while (off < k) {
+            int n = sendmmsg(t->sockfd, hdrs.data() + off, k - off, 0);
+            if (n < 0) {
+                if (errno == EINTR) continue;
+                if (errno == EAGAIN || errno == EWOULDBLOCK ||
+                    errno == ENOBUFS) {
+                    // wait for buffer space, then retry
+                    struct pollfd pfd = {t->sockfd, POLLOUT, 0};
+                    poll(&pfd, 1, 100);
+                    continue;
+                }
+                return false;
+            }
+            nsent += n;
+            off += n;
+            if (t->rate_pps > 0 && n > 0) {
+                // token bucket charged by packets ACTUALLY sent
+                timespec ts;
+                clock_gettime(CLOCK_MONOTONIC, &ts);
+                double now = ts.tv_sec + ts.tv_nsec * 1e-9;
+                if (t->next_time <= 0.0) t->next_time = now;
+                t->next_time += (double)n / (double)t->rate_pps;
+                double delay = t->next_time - now;
+                if (delay > 0) {
+                    timespec d;
+                    d.tv_sec = (time_t)delay;
+                    d.tv_nsec = (long)((delay - (time_t)delay) * 1e9);
+                    nanosleep(&d, nullptr);
+                }
+            }
+        }
+        k = 0;
+        return true;
+    };
+    for (int i = 0; i < nseq; ++i) {
+        for (int j = 0; j < nsrc; ++j) {
+            uint8_t* p = bufs.data() + (size_t)k * pkt_len;
+            long long seq = seq0 + i * seq_inc;
+            int src = src0 + j * src_inc;
+            if (t->fmt == FMT_SIMPLE) {
+                wbe64(p, (uint64_t)seq);
+            } else {  // FMT_CHIPS: mirror CHIPSHeaderFiller
+                p[0] = (uint8_t)(src + 1);
+                p[1] = (uint8_t)tuning;
+                p[2] = (uint8_t)nchan;
+                p[3] = 1;
+                p[4] = 0;
+                p[5] = (uint8_t)hdr_nsrc;
+                wbe16(p + 6, (uint16_t)chan0);
+                wbe64(p + 8, (uint64_t)seq);
+            }
+            std::memcpy(p + hdr_len,
+                        data + ((size_t)i * nsrc + j) * payload_size,
+                        (size_t)payload_size);
+            if (++k == BATCH && !flush()) {
+                if (nsent_out) *nsent_out = nsent;
+                return BFT_ERR_STATE;
+            }
+        }
+    }
+    if (k && !flush()) {
+        if (nsent_out) *nsent_out = nsent;
+        return BFT_ERR_STATE;
+    }
+    (void)gain;
+    if (nsent_out) *nsent_out = nsent;
+    return BFT_OK;
+}
+
+int bft_transmit_destroy(void* tr) {
+    delete static_cast<Transmit*>(tr);
+    return BFT_OK;
+}
+
 }  // extern "C"
 
 #else  // !BFT_HAVE_CAPTURE: portable stubs so the .so builds anywhere
@@ -458,6 +598,12 @@ int bft_capture_src_ngood(void*, long long*, int) {
     return BFT_ERR_INVALID;
 }
 int bft_capture_destroy(void*) { return BFT_OK; }
+int bft_transmit_create(void**, int, int) { return BFT_ERR_INVALID; }
+int bft_transmit_set_rate(void*, long long) { return BFT_ERR_INVALID; }
+int bft_transmit_send(void*, long long, long long, int, int, int, int,
+                      int, int, int, const unsigned char*, int, int,
+                      int, long long*) { return BFT_ERR_INVALID; }
+int bft_transmit_destroy(void*) { return BFT_OK; }
 }  // extern "C"
 
 #endif  // BFT_HAVE_CAPTURE

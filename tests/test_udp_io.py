@@ -361,3 +361,39 @@ def test_send_recv_mmsg_roundtrip():
     assert [bytes(g) for g in got] == pkts
     tx.close()
     rx.close()
+
+
+def test_native_transmit_wire_equivalence():
+    """The native chips/simple fillers produce byte-identical packets to
+    the Python codecs' pack()."""
+    from bifrost_tpu import native
+    if not native.available():
+        pytest.skip('native library unavailable')
+    from bifrost_tpu.io.packet_writer import (UDPTransmit,
+                                              NativeUDPTransmit)
+    from bifrost_tpu.io.packet_formats import get_format, PacketDesc
+    for fmt_name in ('simple', 'chips'):
+        rx = UDPSocket().bind(Address('127.0.0.1', 0))
+        rx.set_timeout(0.5)
+        tx_sock = UDPSocket().connect(
+            Address('127.0.0.1', rx.sock.getsockname()[1]))
+        hi = HeaderInfo()
+        hi.set_nsrc(4)
+        hi.set_nchan(16)
+        hi.set_chan0(32)
+        hi.set_tuning(7)
+        data = np.arange(2 * 2 * 24, dtype=np.uint8).reshape(2, 2, 24)
+        with UDPTransmit(fmt_name, tx_sock) as tx:
+            assert isinstance(tx, NativeUDPTransmit)
+            tx.send(hi, 100, 1, 1, 1, data)
+            assert tx.npackets_sent == 4
+        fmt = get_format(fmt_name)
+        for i in range(2):
+            for j in range(2):
+                wire = rx.recv(4096)
+                expect = fmt.pack(PacketDesc(
+                    seq=100 + i, src=1 + j, nsrc=4, nchan=16, chan0=32,
+                    tuning=7, payload=data[i, j].tobytes()))
+                assert wire == expect, (fmt_name, i, j)
+        tx_sock.close()
+        rx.close()
