@@ -310,13 +310,16 @@ class _PacketCapture(object):
         self.end()
 
 
-#: wire formats with a native C++ decoder/filler (native/capture.cpp);
+#: wire formats with a native C++ decoder (native/capture.cpp);
 #: ids must match the FMT_* enum there
-NATIVE_FMT_IDS = {'simple': 0, 'chips': 1}
+NATIVE_FMT_IDS = {'simple': 0, 'chips': 1, 'tbn': 2, 'drx': 3,
+                  'drx8': 4}
+#: formats the native TRANSMIT engine can fill headers for
+NATIVE_TX_FMT_IDS = {'simple': 0, 'chips': 1}
 _NATIVE_FMT_IDS = NATIVE_FMT_IDS    # backwards-compat alias
 
 
-def native_io_usable(fmt, sock):
+def native_io_usable(fmt, sock, fmt_ids=None):
     """Shared gate for the native IO engines: env opt-out, format has a
     C++ codec, socket exposes a file descriptor, and the .so was built
     with the (Linux-only) engines rather than portable stubs."""
@@ -325,7 +328,8 @@ def native_io_usable(fmt, sock):
         return False
     base = fmt.split('_')[0] if isinstance(fmt, str) else \
         getattr(fmt, 'name', None)
-    if base not in NATIVE_FMT_IDS or not hasattr(sock, 'fileno'):
+    ids = NATIVE_FMT_IDS if fmt_ids is None else fmt_ids
+    if base not in ids or not hasattr(sock, 'fileno'):
         return False
     from ..native import io_engine_supported
     return io_engine_supported()
@@ -451,6 +455,10 @@ class NativeUDPCapture(UDPCapture):
             sock.fileno(), ring._handle, self.nsrc, src0,
             max_payload_size, buffer_ntime, slot_ntime), 'capture')
         self._handle = handle
+        if getattr(self.fmt, 'decimation', None):
+            # TBN derives seq from time_tag via the stream decimation
+            self._lib.bft_capture_set_decimation(
+                handle, int(self.fmt.decimation))
         self._applied_timeout = object()     # force first sync
         self._sync_timeout()
 

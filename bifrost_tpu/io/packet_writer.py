@@ -119,8 +119,8 @@ class _WriterBase(object):
 
 
 def _native_tx_usable(fmt, sock):
-    from .packet_capture import native_io_usable
-    return native_io_usable(fmt, sock)
+    from .packet_capture import native_io_usable, NATIVE_TX_FMT_IDS
+    return native_io_usable(fmt, sock, NATIVE_TX_FMT_IDS)
 
 
 class UDPTransmit(_WriterBase):
@@ -156,9 +156,9 @@ class NativeUDPTransmit(UDPTransmit):
         self.sock = sock
         self._lib = native_mod.load()
         handle = ctypes.c_void_p()
-        from .packet_capture import NATIVE_FMT_IDS
+        from .packet_capture import NATIVE_TX_FMT_IDS
         native_mod.check(self._lib.bft_transmit_create(
-            ctypes.byref(handle), NATIVE_FMT_IDS[self.fmt.name],
+            ctypes.byref(handle), NATIVE_TX_FMT_IDS[self.fmt.name],
             sock.fileno()), 'transmit')
         self._handle = handle
 

@@ -69,6 +69,7 @@ def _declare(lib):
         'bft_capture_set_header_callback': ([c.c_void_p, c.c_void_p,
                                              c.c_void_p], c.c_int),
         'bft_capture_set_timeout_ms': ([c.c_void_p, c.c_int], c.c_int),
+        'bft_capture_set_decimation': ([c.c_void_p, c.c_int], c.c_int),
         'bft_capture_recv': ([c.c_void_p, P(c.c_int)], c.c_int),
         'bft_capture_flush': ([c.c_void_p], c.c_int),
         'bft_capture_end': ([c.c_void_p], c.c_int),

@@ -81,7 +81,8 @@ typedef int (*bft_header_cb)(void* user, const bft_pkt_desc* desc,
 #if BFT_HAVE_CAPTURE
 namespace {
 
-enum Format { FMT_SIMPLE = 0, FMT_CHIPS = 1 };
+enum Format { FMT_SIMPLE = 0, FMT_CHIPS = 1, FMT_TBN = 2,
+              FMT_DRX = 3, FMT_DRX8 = 4 };
 
 // Decode one datagram; mirrors the Python codecs in
 // bifrost_tpu/io/packet_formats.py (themselves mirrors of the
@@ -102,9 +103,15 @@ static inline void wbe16(uint8_t* p, uint16_t v) {
     p[0] = (uint8_t)(v >> 8);
 }
 
+static inline uint32_t le32(const uint8_t* p) {
+    return (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+           ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+}
+
 static bool decode_packet(int fmt, const uint8_t* pkt, int len,
                           bft_pkt_desc* d, const uint8_t** payload,
-                          int* payload_len) {
+                          int* payload_len, int decimation) {
+    const uint32_t SYNC = 0x5CDEC0DE;
     switch (fmt) {
     case FMT_SIMPLE:
         // simple.hpp:33: u64be seq
@@ -131,6 +138,53 @@ static bool decode_packet(int fmt, const uint8_t* pkt, int len,
         *payload = pkt + 16;
         *payload_len = len - 16;
         return d->seq >= 0 && d->chan0 >= 0;
+    case FMT_TBN: {
+        // tbn_hdr_type (tbn.hpp:35-42): u32le sync, u32be framecount,
+        // u32be tuning, u16be tbn_id(1-based), u16be gain,
+        // u64be time_tag; frame size 1048
+        if (len != 1048) return false;
+        if (le32(pkt) != SYNC) return false;
+        std::memset(d, 0, sizeof(*d));
+        uint16_t id = be16(pkt + 12);
+        d->src = (int)(id & 1023) - 1;
+        d->tuning = (int)be16(pkt + 8) << 16 | be16(pkt + 10);
+        d->gain = be16(pkt + 14);
+        d->time_tag = (long long)be64(pkt + 16);
+        d->decimation = decimation > 0 ? decimation : 1;
+        d->seq = d->time_tag / d->decimation / 512;
+        d->nchan = 1;
+        *payload = pkt + 24;
+        *payload_len = len - 24;
+        return d->time_tag >= 0 && ((id >> 15) & 1) == 0;
+    }
+    case FMT_DRX:
+    case FMT_DRX8: {
+        // drx_hdr_type (drx.hpp:36-45): u32le sync, ID in first byte
+        // of the frame_count_word, u32be secs, u16be decim, u16be
+        // time_offset, u64be time_tag, u32be tuning_word, u32be flags
+        int frame = (fmt == FMT_DRX) ? 4128 : 8224;
+        if (len != frame) return false;
+        if (le32(pkt) != SYNC) return false;
+        std::memset(d, 0, sizeof(*d));
+        int id = pkt[4];
+        int tune = ((id >> 3) & 0x7) - 1;
+        int pol = (id >> 7) & 0x1;
+        d->src = (tune << 1) | pol;
+        d->decimation = be16(pkt + 12);
+        if (d->decimation <= 0) d->decimation = 1;
+        d->time_tag = (long long)be64(pkt + 16) - be16(pkt + 14);
+        d->seq = d->time_tag / d->decimation / 4096;
+        // like the Python decoder, tuning_word belongs to tuning slot 0
+        // only for the first tuning pair (drx.hpp:88-92)
+        if (d->src / 2 == 0)
+            d->tuning = (int)((uint32_t)be16(pkt + 24) << 16 |
+                              be16(pkt + 26));
+        d->nchan = 1;
+        *payload = pkt + 32;
+        *payload_len = len - 32;
+        return d->src >= 0 && d->time_tag >= 0 &&
+               ((id >> 6) & 0x1) == 0;
+    }
     }
     return false;
 }
@@ -160,6 +214,7 @@ struct Capture {
     int slot_ntime = 0;
     int timeout_ms = 200;
     int batch = 128;
+    int decimation = 1;        // TBN seq derivation (stream parameter)
 
     bft_header_cb header_cb = nullptr;
     void* cb_user = nullptr;
@@ -319,7 +374,7 @@ int bft_capture_create(void** out, int fmt, int sockfd, void* ring,
     if (!out || !ring || nsrc <= 0 || payload_size <= 0 ||
         buffer_ntime <= 0 || slot_ntime <= 0)
         return BFT_ERR_INVALID;
-    if (fmt != FMT_SIMPLE && fmt != FMT_CHIPS) return BFT_ERR_INVALID;
+    if (fmt < FMT_SIMPLE || fmt > FMT_DRX8) return BFT_ERR_INVALID;
     auto* c = new Capture();
     c->fmt = fmt;
     c->sockfd = sockfd;
@@ -353,6 +408,13 @@ int bft_capture_set_header_callback(void* cap, bft_header_cb fn,
     if (!c) return BFT_ERR_INVALID;
     c->header_cb = fn;
     c->cb_user = user;
+    return BFT_OK;
+}
+
+int bft_capture_set_decimation(void* cap, int decim) {
+    auto* c = static_cast<Capture*>(cap);
+    if (!c || decim <= 0) return BFT_ERR_INVALID;
+    c->decimation = decim;
     return BFT_OK;
 }
 
@@ -394,7 +456,8 @@ int bft_capture_recv(void* cap, int* status_out) {
             bft_pkt_desc d;
             const uint8_t* payload = nullptr;
             int plen = 0;
-            if (!decode_packet(c->fmt, pkt, len, &d, &payload, &plen)) {
+            if (!decode_packet(c->fmt, pkt, len, &d, &payload, &plen,
+                               c->decimation)) {
                 ++c->ninvalid;
                 continue;
             }
@@ -589,6 +652,7 @@ int bft_capture_set_header_callback(void*, bft_header_cb, void*) {
     return BFT_ERR_INVALID;
 }
 int bft_capture_set_timeout_ms(void*, int) { return BFT_ERR_INVALID; }
+int bft_capture_set_decimation(void*, int) { return BFT_ERR_INVALID; }
 int bft_capture_recv(void*, int*) { return BFT_ERR_INVALID; }
 int bft_capture_flush(void*) { return BFT_ERR_INVALID; }
 int bft_capture_end(void*) { return BFT_ERR_INVALID; }
