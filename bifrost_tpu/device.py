@@ -44,6 +44,13 @@ def get_device():
     return dev
 
 
+def get_bound_device():
+    """The explicitly bound device for this thread, or None — lets
+    transfer paths honor BlockScope(device=N) without forcing a
+    placement when none was requested."""
+    return getattr(_tls, 'device', None)
+
+
 def get_device_index():
     return get_device().id
 

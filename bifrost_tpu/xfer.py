@@ -49,6 +49,10 @@ def to_device(arr, device=None):
     """
     import jax
     import jax.numpy as jnp
+    if device is None:
+        # honor the block thread's BlockScope(device=N) binding
+        from .device import get_bound_device
+        device = get_bound_device()
     arr = np.asarray(arr)
     if np.iscomplexobj(arr):
         ft = np.float64 if arr.dtype == np.complex128 else np.float32

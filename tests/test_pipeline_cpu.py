@@ -199,3 +199,31 @@ def test_sync_gulp_out_of_order_waits_on_all(monkeypatch):
     waits, gulps = _drive_sync_gulp(monkeypatch, depth=4, in_order=False)
     # without the in-order guarantee every popped gulp must be waited on
     assert [w[0] for w in waits['sync']] == [gulps[0], gulps[1]]
+
+
+def test_block_scope_device_placement():
+    """BlockScope(device=N) routes the block's transfers to device N
+    (the reference analogue: per-block gpu= placement,
+    reference: pipeline.py:365-366)."""
+    import jax
+    if len(jax.devices()) < 4:
+        import pytest
+        pytest.skip('needs multi-device backend')
+    devices_seen = []
+
+    class Probe(bf.pipeline.SinkBlock):
+        def on_sequence(self, iseq):
+            pass
+
+        def on_data(self, ispan):
+            devices_seen.append(list(ispan.data.devices())[0].id)
+
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 4], 'f32')
+        src = NumpySourceBlock([np.ones((8, 4), np.float32)], hdr,
+                               gulp_nframe=8)
+        with bf.block_scope(device=3):
+            b = bf.blocks.copy(src, space='tpu')
+        Probe(b)
+        p.run()
+    assert devices_seen == [3], devices_seen
