@@ -84,6 +84,7 @@ def _declare(lib):
                                c.c_int, P(c.c_ubyte), c.c_int, c.c_int,
                                c.c_int, P(ll)], c.c_int),
         'bft_transmit_destroy': ([c.c_void_p], c.c_int),
+        'bft_selftest': ([], c.c_int),
         'bft_capture_destroy': ([c.c_void_p], c.c_int),
         'bft_reader_create': ([c.c_void_p, c.c_int, P(ll)], c.c_int),
         'bft_reader_destroy': ([c.c_void_p, ll], c.c_int),

@@ -336,3 +336,12 @@ def test_reserve_after_partial_commit_rejected():
                 seq.reserve(8)
             s1.commit(8)
             s1.close()              # barrier applies s1 full, s2 partial
+
+
+def test_native_library_selftest():
+    """The in-library C++ self-test (reference analogue: bfTestSuite,
+    src/testsuite.cpp) passes through the ABI."""
+    from bifrost_tpu import native
+    if not native.available():
+        pytest.skip('native library unavailable')
+    assert native.load().bft_selftest() == 0
