@@ -54,7 +54,7 @@ class Fft(object):
             else:                               # c2c
                 x = x.astype(jnp.complex64 if idt.nbits <= 32
                              else jnp.complex128)
-                y = jnp.fft.fftn(x, axes=axes)
+                y = fftn_dispatch(x, axes)
             if apply_fftshift:
                 y = jnp.fft.fftshift(y, axes=axes)
             target = jnp.dtype(odt.as_jax_dtype())
@@ -72,8 +72,7 @@ class Fft(object):
             else:
                 # cuFFT inverse is unnormalized (reference: fft.cu uses
                 # CUFFT_INVERSE without scaling)
-                y = jnp.fft.ifftn(x, axes=axes)
-                y = y * np.prod([x.shape[a] for a in axes])
+                y = fftn_dispatch(x, axes, inverse=True)
             return y.astype(odt.as_jax_dtype())
 
         self._fn = jax.jit(plan)
@@ -114,3 +113,112 @@ def fft(iarray, oarray=None, axes=None, inverse=False, apply_fftshift=False):
     plan = Fft().init(iarray, oarray, axes=axes,
                       apply_fftshift=apply_fftshift)
     return plan.execute(iarray, oarray, inverse=inverse)
+
+# ---------------------------------------------------------------------------
+# DFT-as-matmul alternative (MXU path)
+# ---------------------------------------------------------------------------
+
+def _split_factor(n):
+    """Factor n = n1 * n2 with n1 ~ sqrt(n) (radix split)."""
+    import math
+    n1 = int(math.isqrt(n))
+    while n1 > 1 and n % n1:
+        n1 -= 1
+    return n1, n // n1
+
+
+_dft_cache = {}
+
+
+def _dft_matrices(n1, n2, inverse, dtype_name):
+    """Twiddle/DFT factor matrices for the four-step transform, cached
+    host-side per (n1, n2, direction, dtype)."""
+    import numpy as np_
+    key = (n1, n2, inverse, dtype_name)
+    hit = _dft_cache.get(key)
+    if hit is not None:
+        return hit
+    sgn = +1 if inverse else -1
+    f1 = np_.exp(sgn * 2j * np_.pi *
+                 np_.outer(np_.arange(n1), np_.arange(n1)) / n1)
+    f2 = np_.exp(sgn * 2j * np_.pi *
+                 np_.outer(np_.arange(n2), np_.arange(n2)) / n2)
+    tw = np_.exp(sgn * 2j * np_.pi *
+                 np_.outer(np_.arange(n1), np_.arange(n2)) / (n1 * n2))
+    out = tuple(m.astype(np_.complex64) for m in (f1, f2, tw))
+    _dft_cache[key] = out
+    return out
+
+
+def dft_matmul_fft(x, axis=-1, inverse=False, compute_dtype=None):
+    """c2c FFT along one axis as two MXU matmuls (Cooley-Tukey
+    four-step: reshape N -> (N1, N2), DFT_N1, twiddle, DFT_N2).
+
+    The FLOP count is ~N*(N1+N2) complex MACs vs the FFT's ~5N log2 N —
+    more arithmetic, but it rides the MXU systolic array instead of the
+    VPU.  On hardware where matmul throughput dwarfs vector throughput
+    this wins; select with BF_FFT_IMPL=dftmm (per-axis unnormalized
+    forward/inverse, cuFFT conventions, like the rest of ops.fft).
+    ``compute_dtype``: 'bf16' runs the matmuls in bfloat16 (faster,
+    ~2-3 decimal digits) — BF_FFT_DFT_DTYPE=bf16.
+    """
+    import jax.numpy as jnp
+    n = x.shape[axis]
+    n1, n2 = _split_factor(n)
+    if n1 == 1:            # prime length: plain DFT matmul
+        f, _, _ = _dft_matrices(1, n, inverse, 'c64')
+        fn = _dft_matrices(n, 1, inverse, 'c64')[0]
+        xm = jnp.moveaxis(x, axis, -1)
+        y = jnp.einsum('...k,kj->...j', xm, jnp.asarray(fn),
+                       preferred_element_type=jnp.complex64)
+        return jnp.moveaxis(y, -1, axis)
+    f1, f2, tw = _dft_matrices(n1, n2, inverse, 'c64')
+    xm = jnp.moveaxis(x, axis, -1)
+    shp = xm.shape[:-1]
+    xm = xm.reshape(shp + (n1, n2))
+
+    def mm(a, b):
+        if compute_dtype == 'bf16':
+            ar, ai = jnp.real(a).astype(jnp.bfloat16), \
+                jnp.imag(a).astype(jnp.bfloat16)
+            br, bi = jnp.real(b).astype(jnp.bfloat16), \
+                jnp.imag(b).astype(jnp.bfloat16)
+            rr = jnp.matmul(ar, br, preferred_element_type=jnp.float32)
+            ii = jnp.matmul(ai, bi, preferred_element_type=jnp.float32)
+            ri = jnp.matmul(ar, bi, preferred_element_type=jnp.float32)
+            ir = jnp.matmul(ai, br, preferred_element_type=jnp.float32)
+            return (rr - ii) + 1j * (ri + ir)
+        return jnp.matmul(a, b, preferred_element_type=jnp.complex64)
+
+    # DFT over the n1 axis: contract with F1 on the left
+    y = mm(jnp.swapaxes(xm, -1, -2), jnp.asarray(f1.T))   # (..., n2, n1)
+    y = jnp.swapaxes(y, -1, -2) * jnp.asarray(tw)          # twiddle
+    y = mm(y, jnp.asarray(f2))                             # (..., n1, n2)
+    # output index k = k1*n2 + k2? four-step ordering: k = k2*n1 + k1
+    y = jnp.swapaxes(y, -1, -2).reshape(shp + (n,))
+    return jnp.moveaxis(y, -1, axis)
+
+
+def fft_impl_choice():
+    import os
+    return os.environ.get('BF_FFT_IMPL', '').strip().lower()
+
+
+def fftn_dispatch(x, axes, inverse=False):
+    """jnp.fft.fftn/ifftn (unnormalized inverse), or the DFT-matmul
+    path when BF_FFT_IMPL=dftmm (per axis; MXU-bound)."""
+    import os
+    import jax.numpy as jnp
+    if fft_impl_choice() == 'dftmm':
+        cdt = os.environ.get('BF_FFT_DFT_DTYPE', '').strip().lower() \
+            or None
+        y = x
+        for ax in axes:
+            y = dft_matmul_fft(y, ax, inverse=inverse,
+                               compute_dtype=cdt)
+        return y
+    if inverse:
+        y = jnp.fft.ifftn(x, axes=axes)
+        import numpy as np_
+        return y * np_.prod([x.shape[a] for a in axes])
+    return jnp.fft.fftn(x, axes=axes)

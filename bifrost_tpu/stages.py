@@ -150,13 +150,13 @@ class FftStage(Stage):
                 y = jnp.fft.irfftn(x, s=sizes, axes=axes)
                 y = y * np.prod(sizes)
             else:
+                from .ops.fft import fftn_dispatch
                 if inverse:
                     if shift:
                         x = jnp.fft.ifftshift(x, axes=axes)
-                    y = jnp.fft.ifftn(x, axes=axes)
-                    y = y * np.prod([x.shape[a] for a in axes])
+                    y = fftn_dispatch(x, axes, inverse=True)
                 else:
-                    y = jnp.fft.fftn(x, axes=axes)
+                    y = fftn_dispatch(x, axes)
                     if shift:
                         y = jnp.fft.fftshift(y, axes=axes)
             return y.astype(odt)
