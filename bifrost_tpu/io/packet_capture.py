@@ -234,7 +234,9 @@ class _PacketCapture(object):
     def _process_one(self, pkt):
         """Single-packet slow path used by recv() and mixed batches."""
         desc = self.fmt.unpack(pkt)
-        if desc is None:
+        if desc is None or desc.valid_mode:
+            # reference decoders gate on valid_mode (tbn.hpp:64,
+            # drx.hpp:64); the native engine does the same
             self.stats['ninvalid'] += 1
             return False, False
         desc.src -= self.src0

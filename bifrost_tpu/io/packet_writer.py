@@ -177,16 +177,17 @@ class NativeUDPTransmit(UDPTransmit):
         payloads = np.ascontiguousarray(
             arr.reshape(nseq, nsrc, -1).view(np.uint8))
         nsent = ctypes.c_longlong(0)
-        native_mod.check(self._lib.bft_transmit_send(
+        rc = self._lib.bft_transmit_send(
             self._handle, int(seq), int(seq_increment), int(src),
             int(src_increment), int(headerinfo.nsrc),
             int(headerinfo.chan0), int(headerinfo.nchan),
             int(headerinfo.tuning), int(headerinfo.gain),
             payloads.ctypes.data_as(
                 ctypes.POINTER(ctypes.c_ubyte)),
-            nseq, nsrc, payloads.shape[-1], ctypes.byref(nsent)),
-            'send')
+            nseq, nsrc, payloads.shape[-1], ctypes.byref(nsent))
+        # count packets that made it out even on a partial failure
         self.npackets_sent += nsent.value
+        native_mod.check(rc, 'send')
 
     def __del__(self):
         try:

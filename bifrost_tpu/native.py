@@ -136,7 +136,8 @@ def load():
         path = _lib_path()
         try:
             srcs = [os.path.join(_repo_root(), 'native', f)
-                    for f in ('ring.cpp', 'capture.cpp')]
+                    for f in ('ring.cpp', 'capture.cpp',
+                              'selftest.cpp')]
             stale = (not os.path.exists(path) or
                      any(os.path.exists(src) and
                          os.path.getmtime(src) > os.path.getmtime(path)

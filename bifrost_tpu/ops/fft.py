@@ -145,7 +145,8 @@ def _dft_matrices(n1, n2, inverse, dtype_name):
                  np_.outer(np_.arange(n2), np_.arange(n2)) / n2)
     tw = np_.exp(sgn * 2j * np_.pi *
                  np_.outer(np_.arange(n1), np_.arange(n2)) / (n1 * n2))
-    out = tuple(m.astype(np_.complex64) for m in (f1, f2, tw))
+    cdt = np_.complex128 if dtype_name == 'c128' else np_.complex64
+    out = tuple(m.astype(cdt) for m in (f1, f2, tw))
     _dft_cache[key] = out
     return out
 
@@ -165,14 +166,16 @@ def dft_matmul_fft(x, axis=-1, inverse=False, compute_dtype=None):
     import jax.numpy as jnp
     n = x.shape[axis]
     n1, n2 = _split_factor(n)
+    # preserve double precision end to end for complex128 inputs
+    dtn = 'c128' if x.dtype == jnp.complex128 else 'c64'
+    acc = jnp.complex128 if dtn == 'c128' else jnp.complex64
     if n1 == 1:            # prime length: plain DFT matmul
-        f, _, _ = _dft_matrices(1, n, inverse, 'c64')
-        fn = _dft_matrices(n, 1, inverse, 'c64')[0]
+        fn = _dft_matrices(n, 1, inverse, dtn)[0]
         xm = jnp.moveaxis(x, axis, -1)
         y = jnp.einsum('...k,kj->...j', xm, jnp.asarray(fn),
-                       preferred_element_type=jnp.complex64)
+                       preferred_element_type=acc)
         return jnp.moveaxis(y, -1, axis)
-    f1, f2, tw = _dft_matrices(n1, n2, inverse, 'c64')
+    f1, f2, tw = _dft_matrices(n1, n2, inverse, dtn)
     xm = jnp.moveaxis(x, axis, -1)
     shp = xm.shape[:-1]
     xm = xm.reshape(shp + (n1, n2))
@@ -188,7 +191,7 @@ def dft_matmul_fft(x, axis=-1, inverse=False, compute_dtype=None):
             ri = jnp.matmul(ar, bi, preferred_element_type=jnp.float32)
             ir = jnp.matmul(ai, br, preferred_element_type=jnp.float32)
             return (rr - ii) + 1j * (ri + ir)
-        return jnp.matmul(a, b, preferred_element_type=jnp.complex64)
+        return jnp.matmul(a, b, preferred_element_type=acc)
 
     # DFT over the n1 axis: contract with F1 on the left
     y = mm(jnp.swapaxes(xm, -1, -2), jnp.asarray(f1.T))   # (..., n2, n1)

@@ -26,7 +26,7 @@ int bft_reader_destroy(void*, long long);
 int bft_ring_open_sequence(void*, int, const char*, long long, void**);
 int bft_reader_acquire(void*, long long, void*, long long, long long,
                        long long, long long*, long long*);
-int bft_reader_release(void*, long long, long long, long long);
+int bft_reader_release(void*, long long, long long);
 
 int bft_selftest(void) {
     void* ring = nullptr;
@@ -87,7 +87,7 @@ int bft_selftest(void) {
                            &got_begin, &got_nbyte) != 0)
         return 16;
     if (got_nbyte <= 0) return 17;
-    bft_reader_release(ring, reader, got_begin, got_nbyte);
+    bft_reader_release(ring, reader, got_begin);
     bft_reader_destroy(ring, reader);
     return 0;
 }
