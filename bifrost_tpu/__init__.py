@@ -39,6 +39,12 @@ from . import device
 from . import memory
 from . import proclog
 from .ops.map import map  # noqa: A001  (shadows builtin by design, like bf.map)
+from .ops.map import clear_map_cache, list_map_cache
+from .ops.reduce import reduce  # noqa: A001  (bf.reduce, like the reference)
+from .ops.transpose import transpose
+from .ops.quantize import quantize, unpack
+from .io import udp_socket
+from .io.udp_socket import Address as address  # bf.address alias
 
 from . import ops
 from . import blocks

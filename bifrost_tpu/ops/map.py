@@ -25,7 +25,8 @@ from .map_lang import (compile_map, MapSyntaxError, Num, Name, BinOp, UnOp,
                        Ternary, CallIndex, Subscript, Method, Attr, Cast,
                        Ctor, Decl, Assign, AssignCall, If)
 
-__all__ = ['map', 'map_compute', 'clear_map_cache', 'MapSyntaxError']
+__all__ = ['map', 'map_compute', 'clear_map_cache',
+           'list_map_cache', 'MapSyntaxError']
 
 from ..utils import ObjectCache
 
@@ -37,6 +38,12 @@ _cache = ObjectCache(capacity=256)
 
 def clear_map_cache():
     _cache.clear()
+
+
+def list_map_cache():
+    """Keys of cached map executors (reference: bfMapQuery /
+    list_map_cache, python/bifrost/map.py)."""
+    return list(_cache.keys())
 
 
 # ---------------------------------------------------------------------------

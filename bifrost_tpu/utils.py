@@ -52,6 +52,10 @@ class ObjectCache(object):
                 self._items.popitem(last=False)
         return value
 
+    def keys(self):
+        with self._lock:
+            return list(self._items.keys())
+
     def __contains__(self, key):
         with self._lock:
             return key in self._items
