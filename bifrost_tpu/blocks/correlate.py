@@ -18,6 +18,38 @@ from ..pipeline import TransformBlock
 __all__ = ['CorrelateBlock', 'correlate']
 
 
+def _cross_block(x, xg, reim):
+    """Cross-multiply a local station-row block against the full
+    (gathered) station axis: x (T, F, Sr, P[,2]), xg (T, F, S, P[,2])
+    -> (F, Sr, P, S, P)."""
+    import jax.numpy as jnp
+    if reim:
+        t, f, sr, p = x.shape[:4]
+        s = xg.shape[2]
+        re_i = x[..., 0].reshape(t, f, sr * p)
+        im_i = x[..., 1].reshape(t, f, sr * p)
+        re_j = xg[..., 0].reshape(t, f, s * p)
+        im_j = xg[..., 1].reshape(t, f, s * p)
+        rr = jnp.einsum('tfi,tfj->fij', re_i, re_j,
+                        preferred_element_type=jnp.int32)
+        ii = jnp.einsum('tfi,tfj->fij', im_i, im_j,
+                        preferred_element_type=jnp.int32)
+        ir = jnp.einsum('tfi,tfj->fij', im_i, re_j,
+                        preferred_element_type=jnp.int32)
+        ri = jnp.einsum('tfi,tfj->fij', re_i, im_j,
+                        preferred_element_type=jnp.int32)
+        vis = (rr + ii).astype(jnp.float32) + \
+            1j * (ir - ri).astype(jnp.float32)
+        return vis.reshape(f, sr, p, s, p)
+    t, f, sr, p = x.shape
+    s = xg.shape[2]
+    xi = x.reshape(t, f, sr * p)
+    xj = xg.reshape(t, f, s * p)
+    vis = jnp.einsum('tfi,tfj->fij', xi, jnp.conj(xj),
+                     preferred_element_type=jnp.complex64)
+    return vis.reshape(f, sr, p, s, p)
+
+
 class CorrelateBlock(TransformBlock):
     def __init__(self, iring, nframe_per_integration, *args, **kwargs):
         super(CorrelateBlock, self).__init__(iring, *args, **kwargs)
@@ -96,38 +128,68 @@ class CorrelateBlock(TransformBlock):
         if mesh is not None:
             # Time-parallel integration over the mesh: each shard
             # cross-multiplies its time slice, partial visibilities meet
-            # in a psum over the time axis (the pattern of
-            # parallel.ops._local_correlate).
+            # in a psum over the time axis.  On a 2-D mesh with a
+            # station axis ('tp') that divides the station count, the
+            # stations shard too: each rank computes its antenna-ROW
+            # block against the all_gathered antenna axis, so the
+            # visibility matrix itself is distributed (the pattern of
+            # parallel.ops._local_correlate; reference per-GPU
+            # correlator analogue: src/linalg.cu:210-226).
             from ..parallel.ops import _shard_map
-            from ..parallel.scope import (time_axis_name, shardable_nframe,
+            from ..parallel.scope import (time_axis_name,
+                                          station_axis_name,
+                                          shardable_nframe,
                                           shard_gulp, replicated_sharding)
+            sname = station_axis_name(mesh)
+            nstation = shape[2]
+            shard_stations = (sname is not None and
+                              mesh.shape[sname] > 1 and
+                              nstation % mesh.shape[sname] == 0)
             if shardable_nframe(mesh, shape[0]):
+                from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
                 tname = time_axis_name(mesh)
-                in_spec = P(*([tname] + [None] * (len(shape) - 1)))
-                rep = P()
+                spec = [None] * len(shape)
+                spec[0] = tname
+                if shard_stations:
+                    spec[2] = sname
+                in_spec = P(*spec)
+                in_sharding = NamedSharding(mesh, in_spec)
+                # output (F, S_row, P, S, P): rows sharded over sname
+                out_spec = P(None, sname, None, None, None) \
+                    if shard_stations else P()
+                acc_spec = out_spec
                 shard_map = _shard_map()
 
                 def local_fn(x, acc):
-                    vis = jax.lax.psum(local_vis(x), tname)
+                    if shard_stations:
+                        # gather the antenna COLUMN axis; rows stay local
+                        xg = jax.lax.all_gather(x, sname, axis=2,
+                                                tiled=True)
+                        vis = _cross_block(x, xg, reim)
+                    else:
+                        vis = local_vis(x)
+                    vis = jax.lax.psum(vis, tname)
                     return vis if acc is None else acc + vis
 
                 if acc_is_none:
                     sharded = jax.jit(shard_map(
                         lambda x: local_fn(x, None), mesh=mesh,
-                        in_specs=in_spec, out_specs=rep))
+                        in_specs=in_spec, out_specs=out_spec))
 
                     def mesh_fn(x, acc):
-                        return sharded(shard_gulp(x, mesh, 0))
+                        return sharded(jax.device_put(x, in_sharding))
                 else:
                     sharded = jax.jit(shard_map(
                         local_fn, mesh=mesh,
-                        in_specs=(in_spec, rep), out_specs=rep))
+                        in_specs=(in_spec, acc_spec),
+                        out_specs=out_spec))
+                    acc_sharding = NamedSharding(mesh, acc_spec)
 
                     def mesh_fn(x, acc):
-                        acc = jax.device_put(acc,
-                                             replicated_sharding(mesh))
-                        return sharded(shard_gulp(x, mesh, 0), acc)
+                        acc = jax.device_put(acc, acc_sharding)
+                        return sharded(jax.device_put(x, in_sharding),
+                                       acc)
                 return mesh_fn
 
         jfn = jax.jit(fn)

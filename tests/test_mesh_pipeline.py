@@ -216,3 +216,35 @@ def test_fir_on_mesh_with_decimation():
     base = run(None)
     meshed = run(create_mesh({'sp': 8}))
     np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-5)
+
+
+def test_correlate_2d_mesh_station_sharding():
+    """On a 2-D mesh the correlator also shards the station axis: each
+    rank computes its antenna-row block against the all_gathered
+    column axis (distributed visibility matrix)."""
+    rng = np.random.RandomState(21)
+    gulps = [(rng.randn(8, 3, 4, 2) + 1j * rng.randn(8, 3, 4, 2))
+             .astype(np.complex64) for _ in range(2)]
+    hdr = simple_header([-1, 3, 4, 2], 'cf32',
+                        labels=['time', 'freq', 'station', 'pol'],
+                        gulp_nframe=8)
+    base = _run_correlate(None, gulps, hdr, 16)
+    meshed = _run_correlate(create_mesh({'sp': 4, 'tp': 2}),
+                            gulps, hdr, 16)
+    assert base is not None and meshed is not None
+    np.testing.assert_allclose(meshed, base, rtol=1e-4, atol=1e-3)
+
+
+def test_correlate_2d_mesh_ci8_station_sharding():
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    rng = np.random.RandomState(22)
+    raw = np.zeros((16, 2, 4, 2), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-16, 16, size=raw.shape)
+    raw['im'] = rng.randint(-16, 16, size=raw.shape)
+    hdr = simple_header([-1, 2, 4, 2], 'ci8',
+                        labels=['time', 'freq', 'station', 'pol'],
+                        gulp_nframe=16)
+    base = _run_correlate(None, [raw], hdr, 16)
+    meshed = _run_correlate(create_mesh({'sp': 4, 'tp': 2}),
+                            [raw], hdr, 16)
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-5)
