@@ -368,6 +368,14 @@ def main(argv=None):
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
     need_dev = any(c in (2, 3, 4, 5) for c in todo)
+    if need_dev:
+        from bench import _backend_alive
+        if not _backend_alive():
+            print(json.dumps({'error': 'jax backend failed to '
+                              'initialize within 180s; running host-only '
+                              'configs'}))
+            todo = [c for c in todo if c in (1, 6)]
+            need_dev = False
     ceil = measure_ceilings() if need_dev else {}
     if ceil:
         print(json.dumps({'chip_ceilings': {
