@@ -33,6 +33,7 @@ DADA_HEADER_SIZE = 4096
 DEFAULT_KEY = 0xdada
 
 IPC_CREAT = 0o1000
+IPC_EXCL = 0o2000
 IPC_RMID = 0
 SETVAL = 16
 
@@ -72,8 +73,16 @@ def sysv_available():
 
 
 def _shm_create(key, size):
+    """Create a fresh segment; a stale one (crashed previous run) is
+    removed first so counters/semaphores never carry over."""
+    import errno as errno_mod
     libc = _get_libc()
-    shmid = libc.shmget(key, size, IPC_CREAT | 0o666)
+    shmid = libc.shmget(key, size, IPC_CREAT | IPC_EXCL | 0o666)
+    if shmid < 0 and ctypes.get_errno() == errno_mod.EEXIST:
+        old = libc.shmget(key, 0, 0o666)
+        if old >= 0:
+            libc.shmctl(old, IPC_RMID, None)
+        shmid = libc.shmget(key, size, IPC_CREAT | IPC_EXCL | 0o666)
     if shmid < 0:
         raise OSError(ctypes.get_errno(), 'shmget(create) failed')
     return shmid
@@ -161,6 +170,11 @@ class IpcRing(object):
                 bid = _shm_create(self._buf_key(i), bufsz)
                 self._buf_ids.append(bid)
                 self._bufs.append(_shm_map(bid, bufsz)[0])
+            # recreate the semaphore set too, in case a stale one
+            # holds nonzero counts
+            old_sem = libc.semget(key, 2, 0o666)
+            if old_sem >= 0:
+                libc.semctl(old_sem, 0, IPC_RMID)
             self._semid = libc.semget(key, 2, IPC_CREAT | 0o666)
             if self._semid < 0:
                 raise OSError(ctypes.get_errno(), 'semget failed')

@@ -111,3 +111,18 @@ def test_psrdada_shutdown_with_stalled_writer():
             assert not t.is_alive()
     finally:
         hdu.destroy()
+
+
+def test_stale_segment_recreation():
+    """Re-creating a ring at a key left by a crashed run must start
+    fresh (no leaked counters/semaphores)."""
+    key = _KEY + 0x40
+    r1 = IpcRing(key, nbufs=2, bufsz=32, create=True)
+    w = r1.open_write_buf()
+    w[:] = 7
+    r1.mark_filled()                 # leave FULL=1, no destroy (crash)
+    r2 = IpcRing(key, nbufs=2, bufsz=32, create=True)
+    try:
+        assert r2.open_read_buf(timeout=0.2) is None
+    finally:
+        r2.destroy()
