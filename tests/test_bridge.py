@@ -113,3 +113,78 @@ def test_ring_bridge_multi_sequence_ringlets():
     srv.close()
     for s, d in enumerate(datasets):
         np.testing.assert_array_equal(got['seq%d' % s], d)
+
+
+def test_ring_bridge_cross_process():
+    """Sender in a SEPARATE PROCESS (the real multi-host topology):
+    ring -> TCP -> ring across a process boundary."""
+    import subprocess
+    import sys
+    import os
+
+    dst_ring = Ring(space='system', name='bridge_xproc_dst')
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(('127.0.0.1', 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    SENDER = (
+        "import sys, socket, numpy as np\n"
+        "sys.path.insert(0, %r)\n"
+        "sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from bifrost_tpu.ring import Ring\n"
+        "from bifrost_tpu.io.bridge import RingSender\n"
+        "from util import simple_header\n"
+        "import threading\n"
+        "port = int(sys.argv[1])\n"
+        "ring = Ring(space='system', name='xproc_src')\n"
+        "hdr = simple_header([-1, 6], 'f32', name='xproc',\n"
+        "                    gulp_nframe=8)\n"
+        "rng = np.random.RandomState(3)\n"
+        "data = rng.randn(24, 6).astype(np.float32)\n"
+        "def writer():\n"
+        "    with ring.begin_writing() as wr:\n"
+        "        with wr.begin_sequence(hdr, gulp_nframe=8,\n"
+        "                               buf_nframe=32) as seq:\n"
+        "            for k in range(3):\n"
+        "                with seq.reserve(8) as span:\n"
+        "                    span.data.as_numpy()[...] = \\\n"
+        "                        data[k * 8:(k + 1) * 8]\n"
+        "                    span.commit(8)\n"
+        "t = threading.Thread(target=writer)\n"
+        "t.start()\n"
+        "sock = socket.create_connection(('127.0.0.1', port))\n"
+        "RingSender(ring, sock).run()\n"
+        "t.join()\n"
+        "sock.close()\n"
+    ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         os.path.dirname(os.path.abspath(__file__)))
+
+    proc = subprocess.Popen([sys.executable, '-c', SENDER, str(port)])
+    try:
+        conn, _ = srv.accept()
+        got = []
+
+        def reader():
+            for seq in dst_ring.read(guarantee=True):
+                assert seq.header['name'] == 'xproc'
+                for span in seq.read(8):
+                    got.append(np.array(span.data.as_numpy(),
+                                        copy=True))
+
+        rt = threading.Thread(target=reader)
+        rt.start()
+        RingReceiver(conn, dst_ring).run()
+        rt.join(15)
+        assert not rt.is_alive()
+        out = np.concatenate(got, axis=0)
+        rng = np.random.RandomState(3)
+        expect = rng.randn(24, 6).astype(np.float32)
+        np.testing.assert_array_equal(out, expect)
+        conn.close()
+    finally:
+        proc.wait(20)
+        srv.close()
