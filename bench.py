@@ -56,6 +56,7 @@ def build_and_run():
     import jax
     import jax.numpy as jnp
     import bifrost_tpu as bf
+    bf.enable_compilation_cache()    # reuse XLA programs across runs
     from bifrost_tpu.pipeline import SourceBlock, SinkBlock
     from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
 

@@ -376,6 +376,9 @@ def main(argv=None):
                               'configs'}))
             todo = [c for c in todo if c in (1, 6)]
             need_dev = False
+    if need_dev:
+        import bifrost_tpu as _bf
+        _bf.enable_compilation_cache()
     ceil = measure_ceilings() if need_dev else {}
     if ceil:
         print(json.dumps({'chip_ceilings': {

@@ -54,5 +54,5 @@ from . import parallel
 from . import io
 from . import trace
 from . import telemetry
-from .utils import EnvVars, ObjectCache
+from .utils import EnvVars, ObjectCache, enable_compilation_cache
 from .header_standard import enforce_header_standard

@@ -67,3 +67,24 @@ class ObjectCache(object):
     def clear(self):
         with self._lock:
             self._items.clear()
+
+
+def enable_compilation_cache(path=None):
+    """Persist XLA compilations to disk (the analogue of the
+    reference's on-disk map-kernel cache, src/map.cpp DiskCacheMgr):
+    restarting a pipeline reuses compiled programs instead of paying
+    first-compile latency again.  ``path`` defaults to $BF_CACHE_DIR or
+    ~/.cache/bifrost_tpu/xla.  Safe to call more than once."""
+    import os
+    path = path or os.environ.get('BF_CACHE_DIR') or \
+        os.path.join(os.path.expanduser('~'), '.cache', 'bifrost_tpu',
+                     'xla')
+    os.makedirs(path, exist_ok=True)
+    import jax
+    jax.config.update('jax_compilation_cache_dir', path)
+    try:
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          0.5)
+    except Exception:
+        pass
+    return path
