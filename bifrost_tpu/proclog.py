@@ -52,10 +52,23 @@ def _gc_stale():
 
 
 class ProcLog(object):
+    #: minimum seconds between file writes per log (BF_PROCLOG_INTERVAL;
+    #: 0 writes every update).  like_top & co. poll at ~1 Hz, so
+    #: throttling saves an open+rename in every block's per-gulp hot
+    #: loop without losing observability.
+    MIN_INTERVAL = None
+
     def __init__(self, name):
         global _gc_done
         self.name = name
         self.path = os.path.join(proclog_dir(), str(os.getpid()), name)
+        if ProcLog.MIN_INTERVAL is None:
+            try:
+                ProcLog.MIN_INTERVAL = float(
+                    os.environ.get('BF_PROCLOG_INTERVAL', '0.1'))
+            except ValueError:
+                ProcLog.MIN_INTERVAL = 0.1
+        self._last_write = 0.0
         with _lock:
             if not _gc_done:
                 try:
@@ -68,8 +81,15 @@ class ProcLog(object):
         except OSError:
             pass
 
-    def update(self, contents):
-        """Write ``key : value`` lines (dict) or a raw string."""
+    def update(self, contents, force=False):
+        """Write ``key : value`` lines (dict) or a raw string.  Writes
+        are rate-limited to MIN_INTERVAL per log unless ``force``."""
+        import time as time_mod
+        now = time_mod.monotonic()
+        if not force and ProcLog.MIN_INTERVAL and \
+                now - self._last_write < ProcLog.MIN_INTERVAL:
+            return
+        self._last_write = now
         if isinstance(contents, dict):
             text = ''.join('%s : %s\n' % (k, v) for k, v in contents.items())
         else:

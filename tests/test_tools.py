@@ -100,3 +100,21 @@ def test_object_cache_and_envvars():
     os.environ['BF_TEST_VAR'] = 'hello'
     EnvVars.clear()
     assert EnvVars.get('BF_TEST_VAR') == 'hello'
+
+
+def test_proclog_throttling(tmp_path, monkeypatch):
+    """ProcLog rate-limits file writes (BF_PROCLOG_INTERVAL) but
+    force=True always writes."""
+    monkeypatch.setenv('BF_PROCLOG_DIR', str(tmp_path))
+    from bifrost_tpu import proclog as plmod
+    monkeypatch.setattr(plmod, '_gc_done', True)
+    monkeypatch.setattr(plmod.ProcLog, 'MIN_INTERVAL', None)
+    monkeypatch.setenv('BF_PROCLOG_INTERVAL', '100')
+    log = plmod.ProcLog('throttle/perf')
+    log.update({'n': 1})
+    log.update({'n': 2})          # throttled away
+    text = open(log.path).read()
+    assert 'n : 1' in text
+    log.update({'n': 3}, force=True)
+    assert 'n : 3' in open(log.path).read()
+    monkeypatch.setattr(plmod.ProcLog, 'MIN_INTERVAL', None)
