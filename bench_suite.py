@@ -374,6 +374,8 @@ def main(argv=None):
             print(json.dumps({'error': 'jax backend failed to '
                               'initialize within 180s; running host-only '
                               'configs'}))
+            if args.config:          # explicit device config requested
+                return 2
             todo = [c for c in todo if c in (1, 6)]
             need_dev = False
     if need_dev:

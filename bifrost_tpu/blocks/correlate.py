@@ -138,8 +138,7 @@ class CorrelateBlock(TransformBlock):
             from ..parallel.ops import _shard_map
             from ..parallel.scope import (time_axis_name,
                                           station_axis_name,
-                                          shardable_nframe,
-                                          shard_gulp, replicated_sharding)
+                                          shardable_nframe)
             sname = station_axis_name(mesh)
             nstation = shape[2]
             shard_stations = (sname is not None and

@@ -72,13 +72,36 @@ def sysv_available():
         return False
 
 
+def _shm_nattch(key):
+    """Number of processes attached to the segment at ``key`` (from
+    /proc/sysvipc/shm), or None if no such segment."""
+    try:
+        with open('/proc/sysvipc/shm') as f:
+            next(f)
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 7 and int(parts[0]) == key:
+                    return int(parts[6])   # nattch column
+    except (OSError, ValueError, StopIteration):
+        pass
+    return None
+
+
 def _shm_create(key, size):
-    """Create a fresh segment; a stale one (crashed previous run) is
-    removed first so counters/semaphores never carry over."""
+    """Create a fresh segment.  A STALE segment at the key (crashed
+    previous run, zero attachments) is removed first so counters never
+    carry over; a LIVE one (attached processes) is an error rather
+    than silently destroyed out from under its owner."""
     import errno as errno_mod
     libc = _get_libc()
     shmid = libc.shmget(key, size, IPC_CREAT | IPC_EXCL | 0o666)
     if shmid < 0 and ctypes.get_errno() == errno_mod.EEXIST:
+        nattch = _shm_nattch(key)
+        if nattch:
+            raise OSError(
+                errno_mod.EEXIST,
+                'DADA segment 0x%x is in use by %d process(es); '
+                'destroy it first or use another key' % (key, nattch))
         old = libc.shmget(key, 0, 0o666)
         if old >= 0:
             libc.shmctl(old, IPC_RMID, None)
@@ -86,6 +109,34 @@ def _shm_create(key, size):
     if shmid < 0:
         raise OSError(ctypes.get_errno(), 'shmget(create) failed')
     return shmid
+
+
+def _destroy_stale_ring(key):
+    """Remove ALL IPC objects of a stale ring at ``key`` (sync, every
+    buffer segment per its recorded nbufs, semaphores) so a recovery
+    run with fewer buffers does not leak the crashed run's extras."""
+    import struct as struct_mod
+    libc = _get_libc()
+    old = libc.shmget(key, 0, 0o666)
+    if old < 0:
+        return
+    try:
+        head, addr = _shm_map(old, _SYNC_FIXED.size)
+        magic, nbufs, _bufsz = struct_mod.unpack_from('<3Q', head)
+        del head
+        libc.shmdt(ctypes.c_void_p(addr))
+        if magic == _MAGIC:
+            for i in range(int(nbufs)):
+                bid = libc.shmget(((key << 8) | i) & 0x7FFFFFFF, 0,
+                                  0o666)
+                if bid >= 0:
+                    libc.shmctl(bid, IPC_RMID, None)
+        libc.shmctl(old, IPC_RMID, None)
+        sem = libc.semget(key, 2, 0o666)
+        if sem >= 0:
+            libc.semctl(sem, 0, IPC_RMID)
+    except OSError:
+        pass
 
 
 def _shm_attach(key, size=0):
@@ -159,6 +210,8 @@ class IpcRing(object):
                 raise ValueError("create=True requires nbufs and bufsz")
             if nbufs > self.MAX_NBUFS:
                 raise ValueError("nbufs is limited to %d" % self.MAX_NBUFS)
+            if _shm_nattch(key) in (0,):
+                _destroy_stale_ring(key)
             self.nbufs, self.bufsz = nbufs, bufsz
             sync_size = _SYNC_FIXED.size + 8 * nbufs
             self._sync_id = _shm_create(key, sync_size)

@@ -296,6 +296,12 @@ class _PacketCapture(object):
 
     def end(self):
         self.flush()
+        # final cumulative stats must land regardless of throttling
+        self._stats_proclog.update({
+            'ngood_bytes': self.stats['ngood_bytes'],
+            'nmissing_bytes': self.stats['nmissing_bytes'],
+            'ninvalid': self.stats['ninvalid'],
+            'nignored': self.stats['nignored']}, force=True)
         if self._wseq is not None:
             self._wseq.end()
             self._wseq = None
@@ -532,6 +538,9 @@ class NativeUDPCapture(UDPCapture):
 
     def end(self):
         self._lib.bft_capture_end(self._handle)
+        self._stats_proclog.update(
+            {k: v for k, v in self.stats._read().items()
+             if k != 'src_ngood'}, force=True)
         return CAPTURE_ENDED
 
     def __del__(self):

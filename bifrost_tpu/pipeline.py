@@ -591,7 +591,8 @@ class MultiTransformBlock(Block):
             if self.shutdown_event.is_set():
                 break
             for i, iseq in enumerate(iseqs):
-                self.sequence_proclogs[i].update(iseq.header)
+                self.sequence_proclogs[i].update(iseq.header,
+                                                 force=True)
             oheaders = self._on_sequence(iseqs)
             for ohdr in oheaders:
                 ohdr.setdefault('time_tag', self._seq_count)

@@ -164,6 +164,7 @@ def test_ring_bridge_cross_process():
          os.path.dirname(os.path.abspath(__file__)))
 
     proc = subprocess.Popen([sys.executable, '-c', SENDER, str(port)])
+    srv.settimeout(30)
     try:
         conn, _ = srv.accept()
         got = []
@@ -186,5 +187,9 @@ def test_ring_bridge_cross_process():
         np.testing.assert_array_equal(out, expect)
         conn.close()
     finally:
-        proc.wait(20)
+        try:
+            proc.wait(20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
         srv.close()

@@ -114,15 +114,46 @@ def test_psrdada_shutdown_with_stalled_writer():
 
 
 def test_stale_segment_recreation():
-    """Re-creating a ring at a key left by a crashed run must start
-    fresh (no leaked counters/semaphores)."""
+    """Re-creating a ring at a key left by a CRASHED run (creator
+    process gone, zero attachments) must start fresh — no leaked
+    counters/semaphores; a ring still attached by a live process is
+    refused instead of destroyed."""
+    import os
+    import subprocess
+    import sys
     key = _KEY + 0x40
-    r1 = IpcRing(key, nbufs=2, bufsz=32, create=True)
-    w = r1.open_write_buf()
-    w[:] = 7
-    r1.mark_filled()                 # leave FULL=1, no destroy (crash)
+    crasher = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from bifrost_tpu.io.dada_shm import IpcRing\n"
+        "r = IpcRing(%d, nbufs=4, bufsz=32, create=True)\n"
+        "w = r.open_write_buf()\n"
+        "w[:] = 7\n"
+        "r.mark_filled()\n"          # leave FULL=1 and exit uncleanly
+    ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         key)
+    subprocess.run([sys.executable, '-c', crasher], check=True,
+                   timeout=60)
+    # recovery run with FEWER buffers: must clean up all 4 stale ones
     r2 = IpcRing(key, nbufs=2, bufsz=32, create=True)
     try:
         assert r2.open_read_buf(timeout=0.2) is None
+        from bifrost_tpu.io.dada_shm import _shm_nattch, _get_libc
+        # crashed run's extra buffer segments were removed
+        libc = _get_libc()
+        assert libc.shmget(((key << 8) | 3) & 0x7FFFFFFF, 0,
+                           0o666) < 0
     finally:
         r2.destroy()
+
+
+def test_live_ring_not_destroyed():
+    """create=True at a key with LIVE attachments refuses rather than
+    destroying the ring out from under its owner."""
+    key = _KEY + 0x50
+    r1 = IpcRing(key, nbufs=2, bufsz=32, create=True)
+    try:
+        with pytest.raises(OSError):
+            IpcRing(key, nbufs=2, bufsz=32, create=True)
+    finally:
+        r1.destroy()
