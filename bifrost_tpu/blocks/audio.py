@@ -1,21 +1,63 @@
-"""Live audio capture block (gated: requires PortAudio, which this
-environment does not ship; reference: python/bifrost/blocks/audio.py,
-portaudio.py)."""
+"""Live audio capture block (reference: python/bifrost/blocks/audio.py,
+portaudio.py).
+
+The PortAudio binding lives in :mod:`bifrost_tpu.io.portaudio` (ctypes,
+no compiled extension).  The block is fully implemented; the only gate
+is libportaudio's presence on the host (the binding is injectable for
+tests — io.portaudio.set_library)."""
 
 from __future__ import annotations
 
-import ctypes.util
+from ..pipeline import SourceBlock
+from ..io import portaudio as audio
 
-__all__ = ['read_audio', 'HAVE_PORTAUDIO']
+__all__ = ['AudioSourceBlock', 'read_audio', 'HAVE_PORTAUDIO']
 
-HAVE_PORTAUDIO = ctypes.util.find_library('portaudio') is not None
+HAVE_PORTAUDIO = audio.available()
 
 
-def read_audio(*args, **kwargs):
-    """Block: capture live audio via PortAudio."""
-    if not HAVE_PORTAUDIO:
+class AudioSourceBlock(SourceBlock):
+    """Stream gulps from audio input devices; one sequence per device
+    (reference: blocks/audio.py AudioSourceBlock)."""
+
+    def create_reader(self, kwargs):
+        kwargs = dict(kwargs)
+        kwargs.setdefault('frames_per_buffer', self.gulp_nframe)
+        self.reader = audio.open(mode='r', **kwargs)
+        return self.reader
+
+    def on_sequence(self, reader, kwargs):
+        return [{
+            '_tensor': {
+                'dtype': 'i%d' % reader.nbits,
+                'shape': [-1, reader.channels],
+                'labels': ['time', 'pol'],
+                'scales': [[0, 1. / reader.rate], None],
+                'units': ['s', None],
+            },
+            'frame_rate': reader.rate,
+            'input_device': reader.input_device,
+            'name': 'audio-%d' % id(reader),
+        }]
+
+    def on_data(self, reader, ospans):
+        ospan = ospans[0]
+        try:
+            reader.readinto(ospan.data.as_numpy())
+        except audio.PortAudioError:
+            return [0]
+        return [ospan.nframe]
+
+    def stop(self):
+        self.reader.stop()
+
+
+def read_audio(audio_kwargs, gulp_nframe, *args, **kwargs):
+    """Block: capture live audio via PortAudio.  ``audio_kwargs`` is a
+    list of parameter dicts (rate/channels/nbits/input_device), one
+    sequence each (reference: blocks/audio.py read_audio)."""
+    if not audio.available():
         raise ImportError(
             "libportaudio is not available in this environment; "
             "use blocks.read_wav for audio files")
-    raise NotImplementedError(
-        "Live PortAudio capture is not implemented yet")
+    return AudioSourceBlock(audio_kwargs, gulp_nframe, *args, **kwargs)

@@ -104,3 +104,73 @@ def test_numa_binding_helpers():
     from bifrost_tpu.ring import Ring
     r = Ring(space='system', core=0)
     r.resize(1024, 4096)
+
+
+def test_audio_block_with_fake_portaudio(monkeypatch):
+    """The PortAudio block logic end-to-end against an injected fake
+    library (no audio hardware in CI; reference analogue:
+    blocks/audio.py + portaudio.py)."""
+    import ctypes
+    from bifrost_tpu.io import portaudio as pa_mod
+
+    class FakePA(object):
+        def __init__(self):
+            self.reads = 0
+
+        def Pa_Initialize(self):
+            return 0
+
+        def Pa_OpenDefaultStream(self, stream_p, channels, out_ch, fmt,
+                                 rate, fpb, cb, user):
+            return 0
+
+        def Pa_StartStream(self, stream):
+            return 0
+
+        def Pa_ReadStream(self, stream, buf, nframe):
+            self.reads += 1
+            if self.reads > 3:
+                return -9988              # input overflowed -> stop
+            n = len(bytes(buf)) // 2
+            samples = np.arange(n, dtype=np.int16) + 1000 * self.reads
+            buf[:] = samples.tobytes()
+            return 0
+
+        def Pa_StopStream(self, stream):
+            return 0
+
+        def Pa_CloseStream(self, stream):
+            return 0
+
+        @property
+        def Pa_GetErrorText(self):
+            class F(object):
+                restype = None
+
+                def __call__(self, err):
+                    return b'fake overflow'
+            return F()
+
+    fake = FakePA()
+    pa_mod.set_library(fake)
+    try:
+        import importlib
+        from bifrost_tpu.blocks import audio as audio_blocks
+        importlib.reload(audio_blocks)
+        with bf.Pipeline() as p:
+            src = audio_blocks.read_audio(
+                [{'rate': 8000, 'channels': 2, 'nbits': 16}],
+                gulp_nframe=8)
+            sink = GatherSink(src)
+            p.run()
+        hdr = sink.headers[0]
+        assert hdr['_tensor']['dtype'] == 'i16'
+        assert hdr['_tensor']['shape'] == [-1, 2]
+        assert hdr['frame_rate'] == 8000
+        out = sink.result()
+        assert out.shape == (24, 2)       # 3 good reads x 8 frames
+        np.testing.assert_array_equal(
+            out[:8].reshape(-1), np.arange(16, dtype=np.int16) + 1000)
+    finally:
+        pa_mod.set_library(None)
+        importlib.reload(audio_blocks)
