@@ -20,6 +20,8 @@ class AudioSourceBlock(SourceBlock):
     """Stream gulps from audio input devices; one sequence per device
     (reference: blocks/audio.py AudioSourceBlock)."""
 
+    reader = None
+
     def create_reader(self, kwargs):
         kwargs = dict(kwargs)
         kwargs.setdefault('frames_per_buffer', self.gulp_nframe)
@@ -49,7 +51,8 @@ class AudioSourceBlock(SourceBlock):
         return [ospan.nframe]
 
     def stop(self):
-        self.reader.stop()
+        if self.reader is not None:
+            self.reader.stop()
 
 
 def read_audio(audio_kwargs, gulp_nframe, *args, **kwargs):

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import ctypes.util
+import threading
 
 __all__ = ['available', 'open', 'Stream', 'PortAudioError',
            'set_library']
@@ -23,6 +24,8 @@ _FORMATS = {8: paInt8, 16: paInt16, 32: paInt32}
 
 _pa = None
 _initialized = False
+_found = None          # cached find_library result
+_init_lock = threading.Lock()
 
 
 class PortAudioError(RuntimeError):
@@ -32,15 +35,23 @@ class PortAudioError(RuntimeError):
 def set_library(lib):
     """Inject a (real or fake) libportaudio handle; None resets to
     lazy discovery."""
-    global _pa, _initialized
+    global _pa, _initialized, _found
     _pa = lib
     _initialized = False
+    _found = None
+
+
+def _find():
+    global _found
+    if _found is None:
+        _found = (ctypes.util.find_library('portaudio'),)
+    return _found[0]
 
 
 def _load():
     global _pa
     if _pa is None:
-        name = ctypes.util.find_library('portaudio')
+        name = _find()
         if name is None:
             raise ImportError(
                 "libportaudio is not available; install portaudio19 or "
@@ -52,7 +63,7 @@ def _load():
 def available():
     if _pa is not None:
         return True
-    return ctypes.util.find_library('portaudio') is not None
+    return _find() is not None
 
 
 def _check(err):
@@ -69,9 +80,10 @@ def _check(err):
 
 def _ensure_init():
     global _initialized
-    if not _initialized:
-        _check(_load().Pa_Initialize())
-        _initialized = True
+    with _init_lock:
+        if not _initialized:
+            _check(_load().Pa_Initialize())
+            _initialized = True
 
 
 class PaStreamParameters(ctypes.Structure):
@@ -98,6 +110,7 @@ class Stream(object):
         self.input_device = input_device
         self._frame_nbyte = channels * nbits // 8
         self._stream = ctypes.c_void_p()
+        self._open = False
         if input_device is None:
             _check(pa.Pa_OpenDefaultStream(
                 ctypes.byref(self._stream), ctypes.c_int(channels),
@@ -111,8 +124,13 @@ class Stream(object):
                 ctypes.byref(self._stream), ctypes.byref(params), None,
                 ctypes.c_double(rate), ctypes.c_ulong(frames_per_buffer),
                 ctypes.c_ulong(0), None, None))
-        _check(pa.Pa_StartStream(self._stream))
-        self._open = True
+        self._open = True          # opened: close() now cleans up
+        try:
+            _check(pa.Pa_StartStream(self._stream))
+        except PortAudioError:
+            pa.Pa_CloseStream(self._stream)
+            self._open = False
+            raise
 
     def readinto(self, buf):
         """Blocking read filling ``buf`` (any writable buffer whose
