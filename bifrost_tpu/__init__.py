@@ -23,7 +23,7 @@ Usage mirrors the reference::
     bf.get_default_pipeline().run()
 """
 
-__version__ = '0.1.0'
+__version__ = '0.2.0'
 
 from .dtype import DataType
 from .space import Space, SPACES
