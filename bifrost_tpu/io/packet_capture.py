@@ -76,6 +76,24 @@ class _PacketCapture(object):
         else:
             self.fmt = get_format(fmt)
         self.ring = ring
+        if getattr(self.fmt, 'applies_src0', False):
+            # pbeam/cor apply src0 in composed (beam/baseline) units
+            # inside the decoder, like the reference (pbeam.hpp:70,
+            # cor.hpp:77); the engine must not rebase again.  Copy the
+            # codec first: get_format() may hand back the shared
+            # registry singleton.  A src0 already configured on a
+            # passed-in format object wins over the engine default 0;
+            # conflicting nonzero values are an error.
+            import copy as _copy
+            fmt_src0 = getattr(self.fmt, 'src0', 0)
+            if src0 and fmt_src0 and src0 != fmt_src0:
+                raise ValueError(
+                    "conflicting src0: capture got %d but the %s codec "
+                    "was built with src0=%d" % (src0, self.fmt.name,
+                                                fmt_src0))
+            self.fmt = _copy.copy(self.fmt)
+            self.fmt.src0 = src0 or fmt_src0
+            src0 = 0
         self.src0 = src0
         self.payload_size = max_payload_size
         self.buffer_ntime = buffer_ntime
@@ -431,6 +449,7 @@ class _BftPktDesc(ctypes.Structure):
                 ('nchan', ctypes.c_int),
                 ('chan0', ctypes.c_int),
                 ('tuning', ctypes.c_int),
+                ('tuning1', ctypes.c_int),
                 ('gain', ctypes.c_int),
                 ('decimation', ctypes.c_int),
                 ('payload_size', ctypes.c_int)]
@@ -485,7 +504,7 @@ class NativeUDPCapture(UDPCapture):
                 desc = PacketDesc(seq=d.seq, src=d.src, nsrc=d.nsrc,
                                   nchan=d.nchan, chan0=d.chan0,
                                   time_tag=d.time_tag, tuning=d.tuning,
-                                  gain=d.gain,
+                                  tuning1=d.tuning1, gain=d.gain,
                                   decimation=max(d.decimation, 1))
                 time_tag, hdr = self.callback(desc)
                 hdr.setdefault('time_tag', time_tag)

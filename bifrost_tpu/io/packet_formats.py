@@ -85,6 +85,14 @@ class PacketDesc(object):
 class _FormatBase(object):
     name = None
     header_struct = None
+    # Formats whose decoded src composes multiple wire fields (e.g.
+    # pbeam's (beam, server) pair) must apply the capture's src0 in
+    # *composed* units inside unpack(), like the reference decoders do
+    # (pbeam.hpp:70, cor.hpp:77: (beam - src0) * nserver + server - 1).
+    # When True the engine pushes its src0 into the codec and skips its
+    # own flat rebase.
+    applies_src0 = False
+    src0 = 0
 
     @property
     def header_size(self):
@@ -163,9 +171,13 @@ class PBeamFormat(_FormatBase):
 
     name = 'pbeam'
     header_struct = struct.Struct('>BBBBBBHHQ')
+    applies_src0 = True
 
-    def __init__(self, nbeam=1):
+    def __init__(self, nbeam=1, src0=0):
         self.nbeam = nbeam
+        # src0 is in wire-beam (1-based) units, not composed-source
+        # units (reference: pbeam.hpp:70)
+        self.src0 = src0
 
     def pack(self, desc, framecount=0):
         # mirror PBeamHeaderFiller (pbeam.hpp:126-147)
@@ -185,7 +197,7 @@ class PBeamFormat(_FormatBase):
         server, beam, gbe, nchan, nbeam, nserver, navg, chan0, wseq = \
             self.header_struct.unpack_from(buf)
         navg = max(navg, 1)
-        src = beam * max(nserver, 1) + (server - 1)
+        src = (beam - self.src0) * max(nserver, 1) + (server - 1)
         return PacketDesc(seq=wseq // navg, time_tag=wseq,
                           decimation=navg, src=src, beam=nbeam,
                           tuning=gbe, nchan=nchan,
@@ -357,8 +369,11 @@ class CorFormat(_FormatBase):
     name = 'cor'
     header_struct = struct.Struct('<I')
     _rest = struct.Struct('>IIHHQIHH')
+    applies_src0 = True
 
-    def __init__(self, nsrc=1):
+    def __init__(self, nsrc=1, src0=0):
+        # src0 is in baseline units (reference: cor.hpp:77-78)
+        self.src0 = src0
         # total number of (baseline, server) sources; sets the stand
         # count used to (de)compose baseline indices, like the
         # reference's decoder nsrc (cor.hpp:74)
@@ -404,7 +419,7 @@ class CorFormat(_FormatBase):
         nstand = int((math.isqrt(8 * self.nsrc // nserver + 1) - 1) // 2)
         navg = max(navg, 1)
         src = (stand0 * (2 * (nstand - 1) + 1 - stand0) // 2 +
-               stand1 + 1) * nserver + (server - 1)
+               stand1 + 1 - self.src0) * nserver + (server - 1)
         return PacketDesc(
             seq=time_tag // 196000000 // max(navg // 100, 1),
             time_tag=time_tag, decimation=navg, src=src,

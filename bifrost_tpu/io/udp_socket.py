@@ -11,6 +11,7 @@ bytes-object to an amortized slice of a preallocated buffer.
 from __future__ import annotations
 
 import ctypes
+import os
 import select
 import socket
 
@@ -203,8 +204,42 @@ class UDPSocket(object):
         for i, p in enumerate(packets):
             view[offs[i]:offs[i] + sizes[i]] = bytes(p) \
                 if not isinstance(p, (bytes, bytearray, memoryview)) else p
-        n = _get_libc().sendmmsg(self.sock.fileno(), hdrs, vlen, 0)
-        return max(n, 0)
+        # Loop on partial sends and retry EAGAIN/EINTR, mirroring the
+        # native transmit engine's flush(); other errnos raise instead
+        # of silently dropping the batch tail.
+        import errno as errno_mod
+        import time as time_mod
+        libc = _get_libc()
+        fd = self.sock.fileno()
+        hdr_size = ctypes.sizeof(_mmsghdr)
+        base = ctypes.addressof(hdrs)
+        # honor the socket timeout like recv_mmsg_raw does: on expiry
+        # return the partial count instead of spinning on EAGAIN
+        deadline = (time_mod.monotonic() + self._timeout) \
+            if self._timeout is not None else None
+        sent = 0
+        while sent < vlen:
+            ctypes.set_errno(0)
+            n = libc.sendmmsg(
+                fd, ctypes.cast(base + sent * hdr_size,
+                                ctypes.POINTER(_mmsghdr)),
+                vlen - sent, 0)
+            if n < 0:
+                err = ctypes.get_errno()
+                if err in (errno_mod.EAGAIN, errno_mod.EWOULDBLOCK):
+                    wait = 0.01
+                    if deadline is not None:
+                        wait = deadline - time_mod.monotonic()
+                        if wait <= 0:
+                            break
+                        wait = min(wait, 0.01)
+                    select.select([], [fd], [], wait)
+                    continue
+                if err == errno_mod.EINTR:
+                    continue
+                raise OSError(err, "sendmmsg: " + os.strerror(err))
+            sent += n
+        return sent
 
     def send(self, data):
         return self.sock.send(data)

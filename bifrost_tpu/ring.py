@@ -230,7 +230,10 @@ class _DeviceStorage(object):
     def __init__(self):
         self.chunks = {}   # abs byte offset -> (nbyte, jax.Array, time_axis)
         self._offsets = []          # sorted keys of self.chunks
-        self._stitchers = {}        # piece plan -> jitted stitcher
+        from .utils import ObjectCache
+        # piece plan -> jitted stitcher; LRU-bounded so shifting
+        # gulp/overlap patterns can't accumulate compiled programs
+        self._stitchers = ObjectCache(capacity=64)
         self.size = 0
         self.ghost = 0
         self.nringlet = 1
@@ -291,7 +294,7 @@ class _DeviceStorage(object):
         key = (tuple(plan), taxis)
         fn = self._stitchers.get(key)
         if fn is None:
-            fn = self._stitchers[key] = _build_stitcher(plan, taxis)
+            fn = self._stitchers.put(key, _build_stitcher(plan, taxis))
         return fn(*arrs)
 
     def discard_before(self, offset):

@@ -66,6 +66,7 @@ typedef struct {
     int nchan;
     int chan0;
     int tuning;
+    int tuning1;
     int gain;
     int decimation;
     int payload_size;
@@ -175,10 +176,13 @@ static bool decode_packet(int fmt, const uint8_t* pkt, int len,
         d->time_tag = (long long)be64(pkt + 16) - be16(pkt + 14);
         d->seq = d->time_tag / d->decimation / 4096;
         // like the Python decoder, tuning_word belongs to tuning slot 0
-        // only for the first tuning pair (drx.hpp:88-92)
+        // for the first tuning pair and slot 1 otherwise (drx.hpp:88-92)
         if (d->src / 2 == 0)
             d->tuning = (int)((uint32_t)be16(pkt + 24) << 16 |
                               be16(pkt + 26));
+        else
+            d->tuning1 = (int)((uint32_t)be16(pkt + 24) << 16 |
+                               be16(pkt + 26));
         d->nchan = 1;
         *payload = pkt + 32;
         *payload_len = len - 32;

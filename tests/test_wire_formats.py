@@ -296,3 +296,46 @@ def test_cor_pack_stand_recovery():
     stand0, stand1 = struct.unpack_from('>HH', pkt, 28)
     # nsrc=3 baselines -> N=2; src=2 -> (1,1) -> wire (2,2)
     assert (stand0, stand1) == (2, 2)
+
+
+def test_pbeam_src0_in_beam_units():
+    """The reference subtracts src0 from the wire beam BEFORE scaling
+    by nserver (pbeam.hpp:70: (beam - src0) * nserver + server - 1)."""
+    pld = b'\x01' * 32
+    # server=2, beam=2, nserver=3
+    wire = (bytes([2, 2, 0, 8, 2, 3]) +
+            struct.pack('>HHQ', 24, 0, 24 * 5) + pld)
+    assert PBeamFormat().unpack(wire).src == 2 * 3 + 1
+    assert PBeamFormat(src0=1).unpack(wire).src == (2 - 1) * 3 + 1
+    # a flat post-decode rebase would have produced 2*3+1-1 == 6
+    assert PBeamFormat(src0=1).unpack(wire).src != 6
+
+
+def test_cor_src0_in_baseline_units():
+    """cor.hpp:77-78: src = (baseline + 1 - src0)*nserver + server-1."""
+    fmt0 = CorFormat(nsrc=6)
+    pkt = fmt0.pack(PacketDesc(seq=0, src=2, nsrc=3,
+                               tuning=(2 << 8) | 1, decimation=200,
+                               payload=b''))
+    base = fmt0.unpack(pkt).src
+    shifted = CorFormat(nsrc=6, src0=1).unpack(pkt).src
+    # one baseline unit = nserver composed sources
+    assert base - shifted == 2
+
+
+def test_capture_engine_delegates_src0_to_composed_formats():
+    """_PacketCapture must push src0 into pbeam/cor codecs (which apply
+    it in composed units) instead of flat-rebasing afterwards."""
+    from bifrost_tpu.io.packet_capture import _PacketCapture
+
+    class _FakeRing:
+        name = 'src0-delegation-test'
+
+    cap = _PacketCapture('pbeam', _FakeRing(), nsrc=8, src0=2,
+                         max_payload_size=64, buffer_ntime=4,
+                         slot_ntime=4, sequence_callback=lambda d: None)
+    assert cap.src0 == 0
+    assert cap.fmt.src0 == 2
+    # the registry singleton must not have been mutated
+    from bifrost_tpu.io.packet_formats import get_format
+    assert get_format('pbeam').src0 == 0
