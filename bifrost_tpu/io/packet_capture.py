@@ -339,9 +339,10 @@ class _PacketCapture(object):
 #: wire formats with a native C++ decoder (native/capture.cpp);
 #: ids must match the FMT_* enum there
 NATIVE_FMT_IDS = {'simple': 0, 'chips': 1, 'tbn': 2, 'drx': 3,
-                  'drx8': 4}
+                  'drx8': 4, 'ibeam': 5, 'cor': 6, 'pbeam': 7,
+                  'snap2': 8, 'vdif': 9, 'tbf': 10, 'vbeam': 11}
 #: formats the native TRANSMIT engine can fill headers for
-NATIVE_TX_FMT_IDS = {'simple': 0, 'chips': 1}
+NATIVE_TX_FMT_IDS = dict(NATIVE_FMT_IDS)
 _NATIVE_FMT_IDS = NATIVE_FMT_IDS    # backwards-compat alias
 
 
@@ -452,6 +453,11 @@ class _BftPktDesc(ctypes.Structure):
                 ('tuning1', ctypes.c_int),
                 ('gain', ctypes.c_int),
                 ('decimation', ctypes.c_int),
+                ('beam', ctypes.c_int),
+                ('npol', ctypes.c_int),
+                ('npol_tot', ctypes.c_int),
+                ('pol0', ctypes.c_int),
+                ('nchan_tot', ctypes.c_int),
                 ('payload_size', ctypes.c_int)]
 
 
@@ -477,6 +483,11 @@ class NativeUDPCapture(UDPCapture):
         self._lib = native_mod.load()
         self._cb_error = None
         handle = ctypes.c_void_p()
+        # composed-src formats (pbeam/cor) apply src0 in the C decoder
+        # in beam/baseline units; the base init has already folded the
+        # engine src0 into the codec, so forward the codec's value
+        if getattr(self.fmt, 'applies_src0', False):
+            src0 = int(self.fmt.src0)
         native_mod.check(self._lib.bft_capture_create(
             ctypes.byref(handle), _NATIVE_FMT_IDS[self.fmt.name],
             sock.fileno(), ring._handle, self.nsrc, src0,
@@ -486,6 +497,10 @@ class NativeUDPCapture(UDPCapture):
             # TBN derives seq from time_tag via the stream decimation
             self._lib.bft_capture_set_decimation(
                 handle, int(self.fmt.decimation))
+        elif getattr(self.fmt, 'frames_per_second', None):
+            # VDIF: seq = secs * fps + frame; fps rides the same slot
+            self._lib.bft_capture_set_decimation(
+                handle, int(self.fmt.frames_per_second))
         self._applied_timeout = object()     # force first sync
         self._sync_timeout()
 
@@ -505,7 +520,10 @@ class NativeUDPCapture(UDPCapture):
                                   nchan=d.nchan, chan0=d.chan0,
                                   time_tag=d.time_tag, tuning=d.tuning,
                                   tuning1=d.tuning1, gain=d.gain,
-                                  decimation=max(d.decimation, 1))
+                                  decimation=max(d.decimation, 1),
+                                  beam=d.beam, npol=d.npol,
+                                  npol_tot=d.npol_tot, pol0=d.pol0,
+                                  nchan_tot=d.nchan_tot)
                 time_tag, hdr = self.callback(desc)
                 hdr.setdefault('time_tag', time_tag)
                 hdr.setdefault('name', 'capture-%d' % time_tag)

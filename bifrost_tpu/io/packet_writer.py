@@ -161,6 +161,16 @@ class NativeUDPTransmit(UDPTransmit):
             ctypes.byref(handle), NATIVE_TX_FMT_IDS[self.fmt.name],
             sock.fileno()), 'transmit')
         self._handle = handle
+        # codec parameters the C fillers need beyond HeaderInfo
+        if getattr(self.fmt, 'nbeam', 0):
+            self._lib.bft_transmit_set_nbeam(handle, int(self.fmt.nbeam))
+        if self.fmt.name == 'vdif':
+            f = self.fmt
+            self._lib.bft_transmit_set_vdif(
+                handle, int(f.frames_per_second), int(bool(f.legacy)),
+                int(f.log2_nchan), int(f.nbit),
+                int(bool(f.is_complex)), int(f.station_id),
+                int(f.ref_epoch))
 
     def set_rate_limit(self, rate_pps):
         self.limiter = RateLimiter(rate_pps)   # kept for introspection
@@ -182,6 +192,7 @@ class NativeUDPTransmit(UDPTransmit):
             int(src_increment), int(headerinfo.nsrc),
             int(headerinfo.chan0), int(headerinfo.nchan),
             int(headerinfo.tuning), int(headerinfo.gain),
+            int(headerinfo.decimation), int(self.npackets_sent),
             payloads.ctypes.data_as(
                 ctypes.POINTER(ctypes.c_ubyte)),
             nseq, nsrc, payloads.shape[-1], ctypes.byref(nsent))

@@ -79,9 +79,14 @@ def _declare(lib):
         'bft_transmit_create': ([P(c.c_void_p), c.c_int, c.c_int],
                                 c.c_int),
         'bft_transmit_set_rate': ([c.c_void_p, ll], c.c_int),
+        'bft_transmit_set_nbeam': ([c.c_void_p, c.c_int], c.c_int),
+        'bft_transmit_set_vdif': ([c.c_void_p, c.c_int, c.c_int,
+                                   c.c_int, c.c_int, c.c_int, c.c_int,
+                                   c.c_int], c.c_int),
         'bft_transmit_send': ([c.c_void_p, ll, ll, c.c_int, c.c_int,
                                c.c_int, c.c_int, c.c_int, c.c_int,
-                               c.c_int, P(c.c_ubyte), c.c_int, c.c_int,
+                               c.c_int, c.c_int, ll,
+                               P(c.c_ubyte), c.c_int, c.c_int,
                                c.c_int, P(ll)], c.c_int),
         'bft_transmit_destroy': ([c.c_void_p], c.c_int),
         'bft_selftest': ([], c.c_int),

@@ -11,12 +11,15 @@
 // for header construction (the C->Python callback boundary the
 // reference also has, packet_capture.hpp:535-540).
 //
-// Formats: decoders are implemented here for the formats whose wire
-// layouts are hot capture paths ('simple': u64be seq + payload,
-// simple.hpp:33; 'chips': chips_hdr_type, chips.hpp:33).  Other
-// formats use the Python engine (identical semantics, shared tests).
+// Formats: all 12 wire formats decode natively here, mirroring the
+// Python codecs in bifrost_tpu/io/packet_formats.py (themselves
+// mirrors of the reference decoders, src/formats/*.hpp); the transmit
+// engine below fills all 12 headers (packet_writer.hpp:366-580).
+// Engine equivalence is pinned by tests/test_udp_io.py, which runs
+// every format through both engines.
 
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -69,6 +72,11 @@ typedef struct {
     int tuning1;
     int gain;
     int decimation;
+    int beam;       // nbeam for pbeam/ibeam sequence headers
+    int npol;       // snap2 / vbeam
+    int npol_tot;   // snap2
+    int pol0;       // snap2
+    int nchan_tot;  // snap2
     int payload_size;
 } bft_pkt_desc;
 
@@ -83,7 +91,17 @@ typedef int (*bft_header_cb)(void* user, const bft_pkt_desc* desc,
 namespace {
 
 enum Format { FMT_SIMPLE = 0, FMT_CHIPS = 1, FMT_TBN = 2,
-              FMT_DRX = 3, FMT_DRX8 = 4 };
+              FMT_DRX = 3, FMT_DRX8 = 4, FMT_IBEAM = 5, FMT_COR = 6,
+              FMT_PBEAM = 7, FMT_SNAP2 = 8, FMT_VDIF = 9,
+              FMT_TBF = 10, FMT_VBEAM = 11 };
+
+// pbeam/cor compose src from multiple wire fields, and the reference
+// applies src0 in beam/baseline units INSIDE the decoder
+// (pbeam.hpp:70, cor.hpp:77); for those the engine's flat rebase is
+// skipped (matching bifrost_tpu.io.packet_capture._PacketCapture).
+static inline bool src0_in_decoder(int fmt) {
+    return fmt == FMT_PBEAM || fmt == FMT_COR;
+}
 
 // Decode one datagram; mirrors the Python codecs in
 // bifrost_tpu/io/packet_formats.py (themselves mirrors of the
@@ -108,10 +126,37 @@ static inline uint32_t le32(const uint8_t* p) {
     return (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
            ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
 }
+static inline uint64_t le64(const uint8_t* p) {
+    return (uint64_t)le32(p) | ((uint64_t)le32(p + 4) << 32);
+}
+static inline uint32_t be32(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+static inline void wbe32(uint8_t* p, uint32_t v) {
+    p[0] = (uint8_t)(v >> 24); p[1] = (uint8_t)(v >> 16);
+    p[2] = (uint8_t)(v >> 8); p[3] = (uint8_t)v;
+}
+static inline void wle32(uint8_t* p, uint32_t v) {
+    p[0] = (uint8_t)v; p[1] = (uint8_t)(v >> 8);
+    p[2] = (uint8_t)(v >> 16); p[3] = (uint8_t)(v >> 24);
+}
+static inline void wle64(uint8_t* p, uint64_t v) {
+    wle32(p, (uint32_t)v);
+    wle32(p + 4, (uint32_t)(v >> 32));
+}
+static inline long long isqrt_ll(long long v) {
+    if (v <= 0) return 0;
+    long long r = (long long)std::sqrt((double)v);
+    while (r * r > v) --r;
+    while ((r + 1) * (r + 1) <= v) ++r;
+    return r;
+}
 
 static bool decode_packet(int fmt, const uint8_t* pkt, int len,
                           bft_pkt_desc* d, const uint8_t** payload,
-                          int* payload_len, int decimation) {
+                          int* payload_len, int decimation,
+                          int cap_nsrc, int cap_src0) {
     const uint32_t SYNC = 0x5CDEC0DE;
     switch (fmt) {
     case FMT_SIMPLE:
@@ -189,6 +234,188 @@ static bool decode_packet(int fmt, const uint8_t* pkt, int len,
         return d->src >= 0 && d->time_tag >= 0 &&
                ((id >> 6) & 0x1) == 0;
     }
+    case FMT_IBEAM: {
+        // ibeam.hpp:56-81 (IBeamFormat): u8 server(1-based), u8 gbe,
+        // u8 nchan, u8 nbeam, u8 nserver, u16be chan0(global),
+        // u64be seq(1-based); 15 bytes total
+        if (len < 15) return false;
+        std::memset(d, 0, sizeof(*d));
+        d->src = (int)pkt[0] - 1;
+        d->tuning = pkt[1];
+        d->nchan = pkt[2];
+        d->beam = pkt[3];
+        d->nsrc = pkt[4];
+        d->chan0 = (int)be16(pkt + 5) - d->nchan * d->src;
+        d->seq = (long long)be64(pkt + 7) - 1;
+        *payload = pkt + 15;
+        *payload_len = len - 15;
+        return d->seq >= 0;
+    }
+    case FMT_COR: {
+        // cor.hpp:62-97 (CorFormat): u32le sync, u32be fcw
+        // (flag|nchan_decim|nserver|server), u32be secs, u16be
+        // first_chan, u16be gain, u64be time_tag, u32be navg,
+        // u16be stand0(1b), u16be stand1(1b); src0 in baseline units
+        if (len < 32) return false;
+        if (le32(pkt) != SYNC) return false;
+        std::memset(d, 0, sizeof(*d));
+        uint32_t fcw = be32(pkt + 4);
+        int nchan_decim = (fcw >> 16) & 0xFF;
+        int nserver = (fcw >> 8) & 0xFF;
+        if (nserver < 1) nserver = 1;
+        int server = fcw & 0xFF;
+        d->gain = be16(pkt + 14);
+        d->time_tag = (long long)be64(pkt + 16);
+        long long navg = (long long)be32(pkt + 24);
+        if (navg < 1) navg = 1;
+        int stand0 = (int)be16(pkt + 28) - 1;
+        int stand1 = (int)be16(pkt + 30) - 1;
+        int nchan_pkt = (len - 32) / (8 * 4);
+        long long nstand =
+            (isqrt_ll(8LL * cap_nsrc / nserver + 1) - 1) / 2;
+        long long navg100 = navg / 100;
+        if (navg100 < 1) navg100 = 1;
+        d->seq = d->time_tag / 196000000LL / navg100;
+        d->decimation = (int)navg;
+        d->src = (int)((stand0 * (2 * (nstand - 1) + 1 - stand0) / 2 +
+                        stand1 + 1 - cap_src0) * nserver + (server - 1));
+        d->nsrc = cap_nsrc;
+        d->nchan = nchan_pkt;
+        d->chan0 = (int)be16(pkt + 12) -
+                   nchan_decim * nchan_pkt * (server - 1);
+        int srv1 = server - 1;
+        d->tuning = (nserver << 8) | (srv1 > 0 ? srv1 : 0);
+        *payload = pkt + 32;
+        *payload_len = len - 32;
+        return true;
+    }
+    case FMT_PBEAM: {
+        // pbeam.hpp:58-84 (PBeamFormat): u8 server(1b), u8 beam(1b),
+        // u8 gbe, u8 nchan, u8 nbeam, u8 nserver, u16be navg,
+        // u16be chan0, u64be wire_seq; src0 in wire-beam units
+        if (len < 18) return false;
+        std::memset(d, 0, sizeof(*d));
+        int server = pkt[0];
+        int beam = pkt[1];
+        d->tuning = pkt[2];
+        d->nchan = pkt[3];
+        d->beam = pkt[4];
+        int nserver = pkt[5];
+        if (nserver < 1) nserver = 1;
+        int navg = be16(pkt + 6);
+        if (navg < 1) navg = 1;
+        uint64_t wseq = be64(pkt + 10);
+        d->seq = (long long)(wseq / (uint64_t)navg);
+        d->time_tag = (long long)wseq;
+        d->decimation = navg;
+        d->src = (beam - cap_src0) * nserver + (server - 1);
+        d->chan0 = (int)be16(pkt + 8) - d->nchan * d->src;
+        *payload = pkt + 18;
+        *payload_len = len - 18;
+        return true;
+    }
+    case FMT_SNAP2: {
+        // snap2.hpp:70-103 (Snap2Format, big-endian as the decoder's
+        // be*toh reads): u64 seq, u32 sync_time, u16 npol, u16
+        // npol_tot, u16 nchan, u16 nchan_tot, u32 chan_block_id,
+        // u32 chan0, u32 pol0
+        if (len < 32) return false;
+        std::memset(d, 0, sizeof(*d));
+        d->seq = (long long)be64(pkt);
+        d->time_tag = (long long)be32(pkt + 8);
+        int npol = be16(pkt + 12);
+        if (npol < 1) npol = 1;
+        int npol_tot = be16(pkt + 14);
+        int nchan = be16(pkt + 16);
+        if (nchan < 1) nchan = 1;
+        int nchan_tot = be16(pkt + 18);
+        uint32_t chan_block_id = be32(pkt + 20);
+        uint32_t chan0w = be32(pkt + 24);
+        uint32_t pol0 = be32(pkt + 28);
+        int npol_blocks = npol_tot / npol;
+        if (npol_blocks < 1) npol_blocks = 1;
+        int nchan_blocks = nchan_tot / nchan;
+        if (nchan_blocks < 1) nchan_blocks = 1;
+        d->tuning = (int)chan0w;
+        d->nsrc = npol_blocks * nchan_blocks;
+        d->nchan = nchan;
+        d->chan0 = (int)chan_block_id * nchan;
+        d->nchan_tot = nchan_tot;
+        d->npol = npol;
+        d->npol_tot = npol_tot;
+        d->pol0 = (int)pol0;
+        d->src = (int)(pol0 / (uint32_t)npol) +
+                 (int)chan_block_id * npol_blocks;
+        *payload = pkt + 32;
+        *payload_len = len - 32;
+        return true;
+    }
+    case FMT_VDIF: {
+        // vdif.hpp:119-168 (VdifFormat): 4 u32le words with LSB-first
+        // bitfields; non-legacy frames carry a 16-byte extended header.
+        // seq = secs*fps + frame_in_second; fps rides the capture's
+        // decimation parameter (stream-learned in the reference)
+        if (len < 16) return false;
+        uint32_t w0 = le32(pkt), w1 = le32(pkt + 4);
+        uint32_t w2 = le32(pkt + 8), w3 = le32(pkt + 12);
+        if (w0 & 0x80000000u) return false;    // invalid flag
+        int legacy = (w0 >> 30) & 1;
+        int off = legacy ? 16 : 32;
+        if (len < off) return false;
+        std::memset(d, 0, sizeof(*d));
+        long long secs = (long long)(w0 & 0x3FFFFFFFu);
+        long long fnum = (long long)(w1 & 0xFFFFFFu);
+        int ref_epoch = (w1 >> 24) & 0x3F;
+        int log2_nchan = (w2 >> 24) & 0x1F;
+        if (log2_nchan > 30) return false;   // wire-controlled field;
+                                             // 1<<31 would overflow int
+        int thread_id = (w3 >> 16) & 0x3FF;
+        int nbit = ((w3 >> 26) & 0x1F) + 1;
+        int is_complex = (int)((w3 >> 31) & 1);
+        long long fps = decimation > 0 ? decimation : 1;
+        d->seq = secs * fps + fnum;
+        d->time_tag = secs;
+        d->src = thread_id;
+        d->chan0 = 1 << log2_nchan;
+        d->nchan = (len - off) / 8;
+        d->tuning = (ref_epoch << 16) | (nbit << 8) | is_complex;
+        *payload = pkt + off;
+        *payload_len = len - off;
+        return true;
+    }
+    case FMT_TBF: {
+        // tbf.hpp (TbfFormat): u32le sync, u32be fcw(flag 0x01),
+        // u32be secs, u16be first_chan, u16be nstand, u64be time_tag;
+        // 'src' rides first_chan
+        if (len < 24) return false;
+        if (le32(pkt) != SYNC) return false;
+        std::memset(d, 0, sizeof(*d));
+        d->src = be16(pkt + 12);
+        d->nsrc = be16(pkt + 14);
+        d->time_tag = (long long)be64(pkt + 16);
+        d->seq = d->time_tag;
+        d->nchan = 1;
+        *payload = pkt + 24;
+        *payload_len = len - 24;
+        return d->seq >= 0;
+    }
+    case FMT_VBEAM: {
+        // vbeam.hpp (VBeamFormat): u64le sync 0xAABBCCDD00000000,
+        // u64le sync_time, u64be time_tag, f64le bw, f64le sfreq,
+        // u32le nchan, u32le chan0, u32le npol
+        if (len < 52) return false;
+        if (le64(pkt) != 0xAABBCCDD00000000ull) return false;
+        std::memset(d, 0, sizeof(*d));
+        d->time_tag = (long long)le64(pkt + 8);
+        d->seq = (long long)be64(pkt + 16);
+        int nchan = (int)le32(pkt + 40);
+        d->nchan = nchan < 1 ? 1 : nchan;
+        d->chan0 = (int)le32(pkt + 44);
+        d->npol = (int)le32(pkt + 48);
+        *payload = pkt + 52;
+        *payload_len = len - 52;
+        return d->seq >= 0;
+    }
     }
     return false;
 }
@@ -205,7 +432,35 @@ struct Transmit {
     int sockfd = -1;
     long long rate_pps = 0;     // 0 = unlimited
     double next_time = 0.0;
+    int nbeam = 1;              // pbeam/ibeam filler parameter
+    // vdif filler parameters (mirror VdifFormat defaults)
+    int vdif_fps = 25600;
+    int vdif_legacy = 0;
+    int vdif_log2_nchan = 0;
+    int vdif_nbit = 8;
+    int vdif_complex = 1;
+    int vdif_station = 0;
+    int vdif_epoch = 0;
 };
+
+// wire header length the filler writes for each format
+static int tx_header_len(const Transmit* t) {
+    switch (t->fmt) {
+    case FMT_SIMPLE: return 8;
+    case FMT_CHIPS:  return 16;
+    case FMT_TBN:    return 24;
+    case FMT_DRX:
+    case FMT_DRX8:   return 32;
+    case FMT_IBEAM:  return 15;
+    case FMT_COR:    return 32;
+    case FMT_PBEAM:  return 18;
+    case FMT_SNAP2:  return 32;
+    case FMT_VDIF:   return t->vdif_legacy ? 16 : 32;
+    case FMT_TBF:    return 24;
+    case FMT_VBEAM:  return 52;
+    }
+    return -1;
+}
 
 struct Capture {
     int fmt = FMT_SIMPLE;
@@ -302,8 +557,9 @@ static int begin_sequence(Capture* c, const bft_pkt_desc* d) {
     char name[256];
     hdr[0] = 0;
     // the callback sees src rebased by src0, like the Python engine
+    // (composed-src formats already applied src0 in the decoder)
     bft_pkt_desc dd = *d;
-    dd.src -= c->src0;
+    if (!src0_in_decoder(c->fmt)) dd.src -= c->src0;
     std::snprintf(name, sizeof(name), "capture-%lld", d->seq);
     if (c->header_cb) {
         if (c->header_cb(c->cb_user, &dd, &time_tag, name,
@@ -324,7 +580,7 @@ static bool process_packet(Capture* c, const bft_pkt_desc* d,
                            const uint8_t* payload, int plen,
                            bool* started) {
     bool committed = false;
-    int src = d->src - c->src0;
+    int src = d->src - (src0_in_decoder(c->fmt) ? 0 : c->src0);
     if (src < 0 || src >= c->nsrc) {
         ++c->nignored;
         return false;
@@ -378,7 +634,7 @@ int bft_capture_create(void** out, int fmt, int sockfd, void* ring,
     if (!out || !ring || nsrc <= 0 || payload_size <= 0 ||
         buffer_ntime <= 0 || slot_ntime <= 0)
         return BFT_ERR_INVALID;
-    if (fmt < FMT_SIMPLE || fmt > FMT_DRX8) return BFT_ERR_INVALID;
+    if (fmt < FMT_SIMPLE || fmt > FMT_VBEAM) return BFT_ERR_INVALID;
     auto* c = new Capture();
     c->fmt = fmt;
     c->sockfd = sockfd;
@@ -461,7 +717,7 @@ int bft_capture_recv(void* cap, int* status_out) {
             const uint8_t* payload = nullptr;
             int plen = 0;
             if (!decode_packet(c->fmt, pkt, len, &d, &payload, &plen,
-                               c->decimation)) {
+                               c->decimation, c->nsrc, c->src0)) {
                 ++c->ninvalid;
                 continue;
             }
@@ -528,11 +784,33 @@ int bft_capture_destroy(void* cap) {
 
 int bft_transmit_create(void** out, int fmt, int sockfd) {
     if (!out) return BFT_ERR_INVALID;
-    if (fmt != FMT_SIMPLE && fmt != FMT_CHIPS) return BFT_ERR_INVALID;
+    if (fmt < FMT_SIMPLE || fmt > FMT_VBEAM) return BFT_ERR_INVALID;
     auto* t = new Transmit();
     t->fmt = fmt;
     t->sockfd = sockfd;
     *out = t;
+    return BFT_OK;
+}
+
+int bft_transmit_set_nbeam(void* tr, int nbeam) {
+    auto* t = static_cast<Transmit*>(tr);
+    if (!t || nbeam <= 0) return BFT_ERR_INVALID;
+    t->nbeam = nbeam;
+    return BFT_OK;
+}
+
+int bft_transmit_set_vdif(void* tr, int fps, int legacy, int log2_nchan,
+                          int nbit, int is_complex, int station_id,
+                          int ref_epoch) {
+    auto* t = static_cast<Transmit*>(tr);
+    if (!t || fps <= 0 || nbit <= 0) return BFT_ERR_INVALID;
+    t->vdif_fps = fps;
+    t->vdif_legacy = legacy ? 1 : 0;
+    t->vdif_log2_nchan = log2_nchan;
+    t->vdif_nbit = nbit;
+    t->vdif_complex = is_complex ? 1 : 0;
+    t->vdif_station = station_id;
+    t->vdif_epoch = ref_epoch;
     return BFT_OK;
 }
 
@@ -548,13 +826,17 @@ int bft_transmit_set_rate(void* tr, long long pps) {
 // src0 + j*src_inc with payload data[i, j, :payload_size].
 int bft_transmit_send(void* tr, long long seq0, long long seq_inc,
                       int src0, int src_inc, int hdr_nsrc, int chan0,
-                      int nchan, int tuning, int gain,
+                      int nchan, int tuning, int gain, int decimation,
+                      long long framecount0,
                       const unsigned char* data, int nseq, int nsrc,
                       int payload_size, long long* nsent_out) {
     auto* t = static_cast<Transmit*>(tr);
     if (!t || !data || nseq <= 0 || nsrc <= 0 || payload_size <= 0)
         return BFT_ERR_INVALID;
-    const int hdr_len = (t->fmt == FMT_SIMPLE) ? 8 : 16;
+    const int hdr_len = tx_header_len(t);
+    if (hdr_len < 0) return BFT_ERR_INVALID;
+    if (decimation < 1) decimation = 1;
+    long long framecount = framecount0;
     const int pkt_len = hdr_len + payload_size;
     const int BATCH = 64;
     std::vector<uint8_t> bufs((size_t)BATCH * pkt_len);
@@ -610,9 +892,12 @@ int bft_transmit_send(void* tr, long long seq0, long long seq_inc,
             uint8_t* p = bufs.data() + (size_t)k * pkt_len;
             long long seq = seq0 + i * seq_inc;
             int src = src0 + j * src_inc;
-            if (t->fmt == FMT_SIMPLE) {
+            const uint32_t SYNC = 0x5CDEC0DE;
+            switch (t->fmt) {
+            case FMT_SIMPLE:
                 wbe64(p, (uint64_t)seq);
-            } else {  // FMT_CHIPS: mirror CHIPSHeaderFiller
+                break;
+            case FMT_CHIPS:   // mirror CHIPSHeaderFiller
                 p[0] = (uint8_t)(src + 1);
                 p[1] = (uint8_t)tuning;
                 p[2] = (uint8_t)nchan;
@@ -621,7 +906,142 @@ int bft_transmit_send(void* tr, long long seq0, long long seq_inc,
                 p[5] = (uint8_t)hdr_nsrc;
                 wbe16(p + 6, (uint16_t)chan0);
                 wbe64(p + 8, (uint64_t)seq);
+                break;
+            case FMT_TBN:     // TBNHeaderFiller (tbn.hpp:124-141)
+                wle32(p, SYNC);
+                wbe32(p + 4, (uint32_t)(framecount & 0xFFFFFF));
+                wbe32(p + 8, (uint32_t)tuning);
+                wbe16(p + 12, (uint16_t)((src + 1) & 0x3FFF));
+                wbe16(p + 14, (uint16_t)gain);
+                wbe64(p + 16, (uint64_t)seq);
+                break;
+            case FMT_DRX:     // DRXHeaderFiller (drx.hpp:156-172):
+            case FMT_DRX8:    // src carries the raw wire ID byte
+                wle32(p, SYNC);
+                p[4] = (uint8_t)(src & 0xBF);
+                p[5] = p[6] = p[7] = 0;      // frame count
+                wbe32(p + 8, 0);             // seconds
+                wbe16(p + 12, (uint16_t)decimation);
+                wbe16(p + 14, 0);            // time offset
+                wbe64(p + 16, (uint64_t)seq);
+                wbe32(p + 24, (uint32_t)tuning);
+                wbe32(p + 28, 0);            // flags
+                break;
+            case FMT_IBEAM: { // IBeamHeaderFiller (ibeam.hpp:92-109)
+                p[0] = (uint8_t)(src + 1);
+                p[1] = (uint8_t)tuning;
+                p[2] = (uint8_t)nchan;
+                p[3] = (uint8_t)t->nbeam;
+                p[4] = (uint8_t)hdr_nsrc;
+                wbe16(p + 5, (uint16_t)((chan0 + nchan * src) &
+                                        0xFFFF));
+                wbe64(p + 7, (uint64_t)seq);
+                break;
             }
+            case FMT_COR: {   // CORHeaderFiller (cor.hpp:117-146):
+                // recover the 1-based stand pair from the flat
+                // baseline index (matches CorFormat.pack)
+                long long n = (isqrt_ll(8LL * hdr_nsrc + 1) - 1) / 2;
+                double b = (double)(2 + 2 * (n - 1) + 1);
+                double rad = b * b - 8.0 * src;
+                if (rad < 0.0) {
+                    // src outside the baseline range for hdr_nsrc;
+                    // the Python codec raises here — fail the batch
+                    // instead of emitting NaN-derived stand indices
+                    if (nsent_out) *nsent_out = nsent;
+                    return BFT_ERR_INVALID;
+                }
+                long long s0 = (long long)((b - std::sqrt(rad)) / 2.0);
+                long long s1 = src -
+                    s0 * (2 * (n - 1) + 1 - s0) / 2;
+                wle32(p, SYNC);
+                wbe32(p + 4, (0x02u << 24) |
+                             ((uint32_t)tuning & 0xFFFFFF));
+                wbe32(p + 8, 0);
+                wbe16(p + 12, (uint16_t)chan0);
+                wbe16(p + 14, (uint16_t)gain);
+                wbe64(p + 16, (uint64_t)seq);
+                wbe32(p + 24, (uint32_t)decimation);
+                wbe16(p + 28, (uint16_t)((s0 + 1) & 0xFFFF));
+                wbe16(p + 30, (uint16_t)((s1 + 1) & 0xFFFF));
+                break;
+            }
+            case FMT_PBEAM: { // PBeamHeaderFiller (pbeam.hpp:126-147)
+                int nserver = hdr_nsrc / t->nbeam;
+                if (nserver < 1) nserver = 1;
+                p[0] = (uint8_t)((src % nserver) + 1);
+                p[1] = (uint8_t)((src / nserver) + 1);
+                p[2] = (uint8_t)tuning;
+                p[3] = (uint8_t)nchan;
+                p[4] = (uint8_t)t->nbeam;
+                p[5] = (uint8_t)nserver;
+                wbe16(p + 6, (uint16_t)decimation);
+                wbe16(p + 8, (uint16_t)chan0);
+                wbe64(p + 10, (uint64_t)seq);
+                break;
+            }
+            case FMT_SNAP2: { // Snap2Format.pack (decoder-readable
+                // big-endian; npol defaults to 2 like the Python side)
+                int npol = 2, npol_tot = 2;
+                int nchan_tot = nchan * hdr_nsrc;
+                wbe64(p, (uint64_t)seq);
+                wbe32(p + 8, 0);             // sync_time
+                wbe16(p + 12, (uint16_t)npol);
+                wbe16(p + 14, (uint16_t)npol_tot);
+                wbe16(p + 16, (uint16_t)nchan);
+                wbe16(p + 18, (uint16_t)nchan_tot);
+                wbe32(p + 20, (uint32_t)src);    // chan_block_id
+                wbe32(p + 24, (uint32_t)chan0);
+                wbe32(p + 28, 0);            // pol0
+                break;
+            }
+            case FMT_VDIF: {  // VdifFormat.pack (LSB-first bitfields
+                // in u32le words; 16-byte zero extended header unless
+                // legacy)
+                long long secs = seq / t->vdif_fps;
+                long long fnum = seq % t->vdif_fps;
+                uint32_t w0 = (uint32_t)(secs & 0x3FFFFFFF) |
+                              (t->vdif_legacy ? (1u << 30) : 0);
+                uint32_t w1 = (uint32_t)(fnum & 0xFFFFFF) |
+                              (((uint32_t)t->vdif_epoch & 0x3F) << 24);
+                uint32_t frame_len8 =
+                    (uint32_t)((hdr_len + payload_size) / 8);
+                uint32_t w2 = (frame_len8 & 0xFFFFFF) |
+                              (((uint32_t)t->vdif_log2_nchan & 0x1F)
+                               << 24);
+                uint32_t w3 = ((uint32_t)t->vdif_station & 0xFFFF) |
+                              (((uint32_t)src & 0x3FF) << 16) |
+                              ((((uint32_t)t->vdif_nbit - 1) & 0x1F)
+                               << 26) |
+                              (t->vdif_complex ? (1u << 31) : 0);
+                wle32(p, w0);
+                wle32(p + 4, w1);
+                wle32(p + 8, w2);
+                wle32(p + 12, w3);
+                if (!t->vdif_legacy) std::memset(p + 16, 0, 16);
+                break;
+            }
+            case FMT_TBF:     // TBFHeaderFiller (tbf.hpp:42-59):
+                // 'src' rides first_chan
+                wle32(p, SYNC);
+                wbe32(p + 4, (0x01u << 24) |
+                             (uint32_t)(framecount & 0xFFFFFF));
+                wbe32(p + 8, 0);
+                wbe16(p + 12, (uint16_t)(src & 0xFFFF));
+                wbe16(p + 14, (uint16_t)(hdr_nsrc & 0xFFFF));
+                wbe64(p + 16, (uint64_t)seq);
+                break;
+            case FMT_VBEAM:   // VBeamHeaderFiller (vbeam.hpp:44-57)
+                wle64(p, 0xAABBCCDD00000000ull);
+                wle64(p + 8, 0);             // sync_time / time_tag
+                wbe64(p + 16, (uint64_t)seq);
+                std::memset(p + 24, 0, 16);  // bw, sfreq (f64le zeros)
+                wle32(p + 40, (uint32_t)nchan);
+                wle32(p + 44, (uint32_t)chan0);
+                wle32(p + 48, 0);            // npol
+                break;
+            }
+            ++framecount;
             std::memcpy(p + hdr_len,
                         data + ((size_t)i * nsrc + j) * payload_size,
                         (size_t)payload_size);
@@ -668,8 +1088,13 @@ int bft_capture_src_ngood(void*, long long*, int) {
 int bft_capture_destroy(void*) { return BFT_OK; }
 int bft_transmit_create(void**, int, int) { return BFT_ERR_INVALID; }
 int bft_transmit_set_rate(void*, long long) { return BFT_ERR_INVALID; }
+int bft_transmit_set_nbeam(void*, int) { return BFT_ERR_INVALID; }
+int bft_transmit_set_vdif(void*, int, int, int, int, int, int, int) {
+    return BFT_ERR_INVALID;
+}
 int bft_transmit_send(void*, long long, long long, int, int, int, int,
-                      int, int, int, const unsigned char*, int, int,
+                      int, int, int, int, long long,
+                      const unsigned char*, int, int,
                       int, long long*) { return BFT_ERR_INVALID; }
 int bft_transmit_destroy(void*) { return BFT_OK; }
 }  // extern "C"

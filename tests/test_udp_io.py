@@ -16,6 +16,8 @@ from bifrost_tpu.io.packet_capture import (UDPCapture, DiskReader,
                                            PacketCaptureCallback,
                                            CAPTURE_NO_DATA,
                                            CAPTURE_INTERRUPTED)
+from bifrost_tpu.io.packet_formats import (TbnFormat, CorFormat,
+                                            VdifFormat)
 from bifrost_tpu.io.packet_writer import HeaderInfo, UDPTransmit, DiskWriter
 from bifrost_tpu.ring import Ring
 
@@ -549,3 +551,184 @@ def test_native_capture_stress():
     assert consumed[0] > 0
     tx.close()
     rx.close()
+
+
+# ---------------------------------------------------------------------------
+# All-format loopback through BOTH engines (native C++ decode/fill and
+# the Python codecs), VERDICT r2 items 3+8: every wire format runs
+# transmit -> UDP -> capture -> ring in the same suite on both engines.
+# Each case maps logical (slot i, source j) onto the format's wire
+# conventions so that decoded seq == i and decoded src == j.
+# ---------------------------------------------------------------------------
+
+def _fmt_case(fmt, nsrc, payload, wire_seq, tx_src, src0=0,
+              hi_setup=None, tx_fmt=None):
+    return dict(fmt=fmt, nsrc=nsrc, payload=payload, wire_seq=wire_seq,
+                tx_src=tx_src, src0=src0, hi_setup=hi_setup,
+                tx_fmt=tx_fmt if tx_fmt is not None else fmt)
+
+
+def _drx_wire_id(j):
+    # beam 1, tuning (j//2)+1, pol j&1  ->  decoded src = j
+    return 1 | (((j >> 1) + 1) << 3) | ((j & 1) << 7)
+
+
+ALL_FORMAT_CASES = {
+    'simple': _fmt_case('simple', 1, 64, lambda i: i, lambda j: 0),
+    'chips': _fmt_case('chips', 2, 64, lambda i: i + 1, lambda j: j),
+    'tbn': _fmt_case(lambda: TbnFormat(decimation=10), 2, 1024,
+        lambda i: 512 * 10 * i, lambda j: j,
+        hi_setup=lambda hi: hi.set_decimation(10)),
+    'drx': _fmt_case('drx', 4, 4096, lambda i: 4096 * 10 * i,
+                     _drx_wire_id,
+                     hi_setup=lambda hi: hi.set_decimation(10)),
+    'drx8': _fmt_case('drx8', 4, 8192, lambda i: 4096 * 10 * i,
+                      _drx_wire_id,
+                      hi_setup=lambda hi: hi.set_decimation(10)),
+    'ibeam': _fmt_case('ibeam', 2, 64, lambda i: i + 1, lambda j: j),
+    # pbeam: filler beam = src//nserver + 1 (1-based wire), decoder
+    # src = (beam - src0)*nserver + server-1 -> identity with src0=1
+    'pbeam': _fmt_case('pbeam', 2, 64, lambda i: i, lambda j: j,
+                       src0=1,
+                       hi_setup=lambda hi: hi.set_decimation(1)),
+    # cor: tuning rides (nserver<<8)|server on the wire; navg=100 makes
+    # seq = time_tag // 196e6; src0=1 (baseline units) gives identity
+    'cor': _fmt_case(lambda: CorFormat(nsrc=3), 3, 64,
+        lambda i: 196000000 * i,
+        lambda j: j, src0=1,
+        hi_setup=lambda hi: (hi.set_tuning((1 << 8) | 1),
+                             hi.set_decimation(100)),
+        tx_fmt='cor'),
+    'snap2': _fmt_case('snap2', 2, 64, lambda i: i, lambda j: j),
+    'vdif': _fmt_case(lambda: VdifFormat(frames_per_second=100), 2, 64,
+        lambda i: i, lambda j: j,
+        tx_fmt=lambda: VdifFormat(frames_per_second=100)),
+    'tbf': _fmt_case('tbf', 2, 64, lambda i: i, lambda j: j),
+    'vbeam': _fmt_case('vbeam', 1, 64, lambda i: i, lambda j: 0),
+}
+
+
+@pytest.mark.parametrize('fmt_name', sorted(ALL_FORMAT_CASES))
+def test_loopback_all_formats_both_engines(fmt_name, capture_engine):
+    """Every wire format round-trips transmit->UDP->capture->ring with
+    identical placement on the native and Python engines
+    (reference: src/packet_capture.hpp:609-1390,
+    packet_writer.hpp:366-580)."""
+    case = ALL_FORMAT_CASES[fmt_name]
+    fmt = case['fmt']() if callable(case['fmt']) else case['fmt']
+    tx_fmt = case['tx_fmt']() if callable(case['tx_fmt']) \
+        else case['tx_fmt']
+    nsrc, payload = case['nsrc'], case['payload']
+    NSEQ, PAD, BUF = 8, 8, 4
+
+    rx = UDPSocket().bind(Address('127.0.0.1', 0))
+    port = rx.sock.getsockname()[1]
+    rx.set_timeout(0.4)
+    tx_sock = UDPSocket().connect(Address('127.0.0.1', port))
+    ring = Ring(space='system', name='loop_%s_%s' % (
+        fmt_name, capture_engine))
+
+    def cb(desc):
+        return 0, {'name': fmt_name, '_tensor': {
+            'shape': [-1, nsrc, payload], 'dtype': 'u8',
+            'labels': ['time', 'src', 'byte'],
+            'scales': [[0, 1]] * 3, 'units': [None] * 3}}
+
+    cap = UDPCapture(fmt, rx, ring, nsrc, case['src0'], payload,
+                     BUF, BUF, cb)
+    from bifrost_tpu.io.packet_capture import NativeUDPCapture
+    assert isinstance(cap, NativeUDPCapture) == \
+        (capture_engine == 'native'), capture_engine
+
+    rng = np.random.RandomState(hash(fmt_name) % 2**31)
+    data = rng.randint(1, 255, (NSEQ, nsrc, payload)).astype(np.uint8)
+
+    got = []
+
+    def read_ring():
+        for seq in ring.read(guarantee=True):
+            for span in seq.read(BUF):
+                got.append(np.array(span.data.as_numpy(), copy=True))
+
+    reader = threading.Thread(target=read_ring)
+    reader.start()
+    cap_thread = threading.Thread(target=_run_capture, args=(cap,))
+    cap_thread.start()
+
+    hi = HeaderInfo()
+    hi.set_nsrc(nsrc)
+    hi.set_nchan(16)
+    hi.set_chan0(0)
+    if case['hi_setup']:
+        case['hi_setup'](hi)
+    with UDPTransmit(tx_fmt, tx_sock) as tx:
+        from bifrost_tpu.io.packet_writer import NativeUDPTransmit
+        assert isinstance(tx, NativeUDPTransmit) == \
+            (capture_engine == 'native')
+        for i in range(NSEQ + PAD):
+            for j in range(nsrc):
+                pld = data[i, j] if i < NSEQ \
+                    else np.zeros(payload, np.uint8)
+                tx.send(hi, case['wire_seq'](i), 1, case['tx_src'](j),
+                        1, pld.reshape(1, 1, -1))
+    cap_thread.join()
+    reader.join()
+
+    out = np.concatenate(got, axis=0)
+    assert out.shape[0] >= NSEQ, (fmt_name, out.shape)
+    np.testing.assert_array_equal(out[:NSEQ], data, err_msg=fmt_name)
+    assert cap.stats['ngood_bytes'] >= NSEQ * nsrc * payload
+    tx_sock.close()
+    rx.close()
+
+
+def test_native_transmit_wire_equivalence_all_formats():
+    """Every native filler produces byte-identical packets to the
+    Python codec's pack() for the same HeaderInfo/seq/src inputs
+    (reference: packet_writer.hpp:366-580)."""
+    from bifrost_tpu import native
+    if not native.available():
+        pytest.skip('native library unavailable')
+    from bifrost_tpu.io.packet_writer import (UDPTransmit,
+                                              NativeUDPTransmit)
+    from bifrost_tpu.io.packet_formats import get_format, PacketDesc
+
+    for fmt_name in sorted(ALL_FORMAT_CASES):
+        case = ALL_FORMAT_CASES[fmt_name]
+        tx_fmt = case['tx_fmt']() if callable(case['tx_fmt']) \
+            else case['tx_fmt']
+        fmt = get_format(tx_fmt)
+        nsrc, payload = case['nsrc'], case['payload']
+        rx = UDPSocket().bind(Address('127.0.0.1', 0))
+        rx.set_timeout(0.5)
+        tx_sock = UDPSocket().connect(
+            Address('127.0.0.1', rx.sock.getsockname()[1]))
+        hi = HeaderInfo()
+        hi.set_nsrc(nsrc)
+        hi.set_nchan(16)
+        hi.set_chan0(0)
+        hi.set_gain(3)
+        if case['hi_setup']:
+            case['hi_setup'](hi)
+        data = np.arange(2 * nsrc * payload,
+                         dtype=np.uint8).reshape(2, nsrc, payload)
+        with UDPTransmit(tx_fmt, tx_sock) as tx:
+            assert isinstance(tx, NativeUDPTransmit), fmt_name
+            for i in range(2):
+                for j in range(nsrc):
+                    tx.send(hi, case['wire_seq'](i), 1,
+                            case['tx_src'](j), 1,
+                            data[i, j].reshape(1, 1, -1))
+        k = 0
+        for i in range(2):
+            for j in range(nsrc):
+                wire = rx.recv(16384)
+                expect = fmt.pack(PacketDesc(
+                    seq=case['wire_seq'](i), src=case['tx_src'](j),
+                    nsrc=nsrc, nchan=16, chan0=0, tuning=hi.tuning,
+                    gain=3, decimation=hi.decimation,
+                    payload=data[i, j].tobytes()), framecount=k)
+                assert wire == expect, (fmt_name, i, j)
+                k += 1
+        tx_sock.close()
+        rx.close()
