@@ -158,7 +158,8 @@ class _PacketCapture(object):
             'ngood_bytes': self.stats['ngood_bytes'],
             'nmissing_bytes': self.stats['nmissing_bytes'],
             'ninvalid': self.stats['ninvalid'],
-            'nignored': self.stats['nignored']})
+            'nignored': self.stats['nignored'],
+            'npackets': self.stats['ngood_bytes'] // self.payload_size})
 
     # -- vectorized batch path (recvmmsg + decode_batch formats) -----------
     def _assign_batch(self, offs, srcs, payloads):
@@ -319,7 +320,9 @@ class _PacketCapture(object):
             'ngood_bytes': self.stats['ngood_bytes'],
             'nmissing_bytes': self.stats['nmissing_bytes'],
             'ninvalid': self.stats['ninvalid'],
-            'nignored': self.stats['nignored']}, force=True)
+            'nignored': self.stats['nignored'],
+            'npackets': self.stats['ngood_bytes'] // self.payload_size},
+            force=True)
         if self._wseq is not None:
             self._wseq.end()
             self._wseq = None
@@ -565,9 +568,11 @@ class NativeUDPCapture(UDPCapture):
             err, self._cb_error = self._cb_error, None
             raise err
         if status.value in (CAPTURE_STARTED, CAPTURE_CONTINUED):
+            st = self.stats._read()
+            st['npackets'] = st.get('ngood_bytes', 0) // \
+                self.payload_size
             self._stats_proclog.update({
-                k: v for k, v in self.stats._read().items()
-                if k != 'src_ngood'})
+                k: v for k, v in st.items() if k != 'src_ngood'})
         return status.value
 
     def flush(self):
@@ -575,9 +580,11 @@ class NativeUDPCapture(UDPCapture):
 
     def end(self):
         self._lib.bft_capture_end(self._handle)
+        st = self.stats._read()
+        st['npackets'] = st.get('ngood_bytes', 0) // self.payload_size
         self._stats_proclog.update(
-            {k: v for k, v in self.stats._read().items()
-             if k != 'src_ngood'}, force=True)
+            {k: v for k, v in st.items() if k != 'src_ngood'},
+            force=True)
         return CAPTURE_ENDED
 
     def __del__(self):

@@ -68,18 +68,34 @@ class RateLimiter(object):
             time.sleep(delay)
 
 
+_WRITER_SEQ = [0]
+
+
 class _WriterBase(object):
     def __init__(self, fmt, core=None):
         self.fmt = get_format(fmt)
         self.core = core
         self.limiter = RateLimiter(0)
         self.npackets_sent = 0
+        self.nbytes_sent = 0
+        # observable like the reference's udp_transmit proclogs
+        # (tools/like_bmon.py reads these for the TX pane)
+        from ..proclog import ProcLog
+        _WRITER_SEQ[0] += 1
+        self._stats_proclog = ProcLog(
+            '%s_transmit_%d/stats' % (self.fmt.name, _WRITER_SEQ[0]))
+
+    def _log_stats(self, force=False):
+        self._stats_proclog.update(
+            {'npackets': self.npackets_sent,
+             'nbytes': self.nbytes_sent}, force=force)
 
     def set_rate_limit(self, rate_pps):
         self.limiter = RateLimiter(rate_pps)
 
     def reset_counter(self):
         self.npackets_sent = 0
+        self.nbytes_sent = 0
 
     def _send_bytes(self, data):
         raise NotImplementedError
@@ -107,9 +123,11 @@ class _WriterBase(object):
                 self.limiter.wait()
                 # frame counter rides the wire frame_count_word where the
                 # format has one (reference: packet_writer.hpp framecount)
-                self._send_bytes(self.fmt.pack(
-                    desc, framecount=self.npackets_sent))
+                raw = self.fmt.pack(desc, framecount=self.npackets_sent)
+                self._send_bytes(raw)
                 self.npackets_sent += 1
+                self.nbytes_sent += len(raw)
+        self._log_stats()
 
     def __enter__(self):
         return self
@@ -198,6 +216,9 @@ class NativeUDPTransmit(UDPTransmit):
             nseq, nsrc, payloads.shape[-1], ctypes.byref(nsent))
         # count packets that made it out even on a partial failure
         self.npackets_sent += nsent.value
+        self.nbytes_sent += nsent.value * (
+            payloads.shape[-1] + self.fmt.header_size)
+        self._log_stats()
         native_mod.check(rc, 'send')
 
     def __del__(self):

@@ -378,6 +378,18 @@ class Block(BlockScope):
             affinity.set_core(self.core if isinstance(self.core, int)
                               else self.core[0])
         self.bind_proclog.update({'ncore': 1, 'core0': affinity.get_core()})
+        # Re-publish ring wiring now that it is final: subclasses may
+        # replace self.orings after construction (copy to another
+        # space, SinkBlock dropping outputs), and the monitor tools
+        # (like_ps/pipeline2dot) reconstruct the graph from these.
+        for log, rings in ((self.in_proclog, self.irings),
+                           (getattr(self, 'out_proclog', None),
+                            self.orings)):
+            if log is not None:
+                rnames = {'nring': len(rings)}
+                for i, r in enumerate(rings):
+                    rnames['ring%i' % i] = r.name
+                log.update(rnames, force=True)
         if self.device is not None:
             device.set_device(self.device)
         self.cache_scope_hierarchy()

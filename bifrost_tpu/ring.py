@@ -419,6 +419,26 @@ class Ring(object):
             self._size, self._ghost, self._nringlet = size, ghost, nringlet
             self._write_cond.notify_all()
             self._read_cond.notify_all()
+        self._write_ring_proclog()
+
+    def _write_ring_proclog(self):
+        """Record this ring's geometry under rings/<name> for the
+        monitor tools (reference: ring_impl.cpp:476-489 'size' log:
+        space/binding/ghost/span/stride/nringlet)."""
+        try:
+            from .proclog import ProcLog
+            if getattr(self, '_geom_proclog', None) is None:
+                self._geom_proclog = ProcLog('rings/%s' % self.name)
+            self._geom_proclog.update({
+                'space': self.space,
+                'core': -1 if self.core is None else self.core,
+                'ghost': self._ghost,
+                'span': self._ghost,
+                'stride': self._size,
+                'nringlet': self._nringlet,
+            }, force=True)
+        except Exception:
+            pass
 
     @property
     def total_span(self):

@@ -153,6 +153,32 @@ class NativeRing(Ring):
             self._handle, contiguous_bytes,
             -1 if total_bytes is None else total_bytes, nringlet),
             'resize')
+        self._write_ring_proclog()
+
+    def _write_ring_proclog(self):
+        """Geometry proclog for the monitor tools; queries the native
+        core (overrides Ring._write_ring_proclog, which reads the
+        Python core's attributes)."""
+        try:
+            from .proclog import ProcLog
+            size = ctypes.c_longlong()
+            ghost = ctypes.c_longlong()
+            nringlet = ctypes.c_longlong()
+            native.check(self._lib.bft_ring_geometry(
+                self._handle, None, ctypes.byref(size),
+                ctypes.byref(ghost), ctypes.byref(nringlet)))
+            if getattr(self, '_geom_proclog', None) is None:
+                self._geom_proclog = ProcLog('rings/%s' % self.name)
+            self._geom_proclog.update({
+                'space': self.space,
+                'core': -1 if self.core is None else self.core,
+                'ghost': ghost.value,
+                'span': ghost.value,
+                'stride': size.value,
+                'nringlet': max(nringlet.value, 1),
+            }, force=True)
+        except Exception:
+            pass
 
     @property
     def total_span(self):

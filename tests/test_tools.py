@@ -31,32 +31,99 @@ def _tool(name, *args):
 
 
 def test_like_top_once():
+    """like_top renders the reference's panes: load average, process
+    counts, CPU/memory/swap, and per-block perf rows with core + %CPU
+    columns (reference: tools/like_top.py:52-200)."""
     _run_pipeline_and_leave_proclogs()
-    res = _tool('like_top.py', str(os.getpid()), '--once')
+    res = _tool('like_top.py', '--once')
     assert res.returncode == 0, res.stderr
-    assert 'block' in res.stdout
+    assert 'load average:' in res.stdout
+    assert 'Processes:' in res.stdout and 'running' in res.stdout
+    assert 'CPU(s):' in res.stdout and '%us' in res.stdout
+    assert 'Mem:' in res.stdout and 'Swap:' in res.stdout
+    assert 'Block' in res.stdout and 'Core' in res.stdout
+    assert '%CPU' in res.stdout and 'Cmd' in res.stdout
+    assert 'Acquire' in res.stdout and 'Reserve' in res.stdout
     assert 'CopyBlock' in res.stdout
 
 
 def test_like_ps():
+    """like_ps lists process details, rings with space/size, and block
+    ring wiring (reference: tools/like_ps.py:120-196)."""
     _run_pipeline_and_leave_proclogs()
-    res = _tool('like_ps.py')
+    res = _tool('like_ps.py', str(os.getpid()))
     assert res.returncode == 0, res.stderr
-    assert str(os.getpid()) in res.stdout
+    assert 'PID: %d' % os.getpid() in res.stdout
+    assert 'User:' in res.stdout and 'CPU Usage:' in res.stdout
+    assert 'Thread Count:' in res.stdout
+    assert 'Rings:' in res.stdout and 'Blocks:' in res.stdout
+    assert 'on system of size' in res.stdout     # ring geometry pane
+    assert 'read ring(s):' in res.stdout
+    assert 'write ring(s):' in res.stdout
+    assert 'log(s):' in res.stdout
 
 
 def test_pipeline2dot():
+    """pipeline2dot annotates blocks with CPU binding and shape, rings
+    with space/size, and emits association edges
+    (reference: tools/pipeline2dot.py:97-330)."""
     _run_pipeline_and_leave_proclogs()
     res = _tool('pipeline2dot.py', str(os.getpid()))
     assert res.returncode == 0, res.stderr
-    assert 'digraph pipeline' in res.stdout
-    assert '->' in res.stdout
+    assert 'digraph graph%d' % os.getpid() in res.stdout
+    assert 'label="Pipeline:' in res.stdout
+    assert 'CPU' in res.stdout or 'Unbound' in res.stdout
+    assert 'shape="box"' in res.stdout
+    assert 'ring:' in res.stdout and '->' in res.stdout
+    assert 'system' in res.stdout          # ring space annotation
 
 
 def test_like_bmon_once():
+    """like_bmon renders per-PID RX/TX rate summaries and per-block
+    loss detail (reference: tools/like_bmon.py:108-330)."""
     res = _tool('like_bmon.py', '--once')
     assert res.returncode == 0, res.stderr
-    assert 'GOOD_BYTES' in res.stdout
+    assert 'RX Rate' in res.stdout and 'TX Rate' in res.stdout
+    assert 'RX pkt/s' in res.stdout and 'TX pkt/s' in res.stdout
+
+
+def test_like_bmon_rates_from_capture(tmp_path, monkeypatch):
+    """A real capture's proclog stats appear in like_bmon's panes with
+    good/missing/loss columns."""
+    monkeypatch.setenv('BF_PROCLOG_DIR', str(tmp_path))
+    base = os.path.join(str(tmp_path), str(os.getpid()),
+                        'rx_capture')
+    os.makedirs(base)
+    with open(os.path.join(base, 'stats'), 'w') as f:
+        f.write('ngood_bytes : 8192\nnmissing_bytes : 1024\n'
+                'ninvalid : 3\nnignored : 1\nnpackets : 128\n')
+    tx = os.path.join(str(tmp_path), str(os.getpid()),
+                      'chips_transmit_1')
+    os.makedirs(tx)
+    with open(os.path.join(tx, 'stats'), 'w') as f:
+        f.write('npackets : 64\nnbytes : 4096\n')
+    res = _tool('like_bmon.py', '--once')
+    assert res.returncode == 0, res.stderr
+    assert 'rx_capture' in res.stdout
+    assert 'chips_transmit_1' in res.stdout
+    assert 'good_bytes' in res.stdout and 'missing' in res.stdout
+    assert '8192' in res.stdout and '1024' in res.stdout
+    assert 'loss' in res.stdout
+
+
+def test_like_pmap():
+    """like_pmap reports NUMA-classified memory areas and per-ring
+    mapping details (reference: tools/like_pmap.py)."""
+    _run_pipeline_and_leave_proclogs()
+    res = _tool('like_pmap.py', str(os.getpid()))
+    assert res.returncode == 0, res.stderr
+    assert 'Rings:' in res.stdout
+    assert 'Anonymous Memory Areas:' in res.stdout
+    assert 'File Backed Memory Areas:' in res.stdout
+    assert 'Ring Mappings:' in res.stdout
+    assert 'Space: system' in res.stdout
+    assert 'Node:' in res.stdout or 'Area:' in res.stdout
+    assert 'Other Non-Ring Areas:' in res.stdout
 
 
 def test_proclog_roundtrip():

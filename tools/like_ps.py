@@ -1,8 +1,16 @@
 #!/usr/bin/env python3
-"""ps-style listing of bifrost_tpu pipelines and their blocks
-(reference: tools/like_ps.py)."""
+"""ps-style listing of running bifrost_tpu pipelines
+(reference: tools/like_ps.py).
 
+For every pipeline PID: command line, user, CPU%, memory%, elapsed
+time, thread count (via ``ps``), the rings it uses (name, space, size
+from the rings/<name> ProcLog geometry entries), and each block with
+its read/write ring indices, core binding, and available logs.
+"""
+
+import argparse
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
@@ -10,19 +18,147 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 from bifrost_tpu import proclog  # noqa: E402
 
 
-def main():
+def list_pipelines():
     base = proclog.proclog_dir()
     if not os.path.isdir(base):
-        print("No proclog directory at %s" % base)
-        return 1
-    print('%-8s %-10s %s' % ('PID', 'CORE', 'BLOCK'))
-    for pid_s in sorted(os.listdir(base)):
-        if not pid_s.isdigit():
+        return []
+    return sorted(int(p) for p in os.listdir(base) if p.isdigit())
+
+
+def get_process_details(pid):
+    """user/CPU%/mem%/etime/threads via ``ps``
+    (reference: like_ps.py:45-77)."""
+    data = {'user': '', 'cpu': 0.0, 'mem': 0.0, 'etime': '00:00',
+            'threads': 0}
+    try:
+        out = subprocess.check_output(
+            ['ps', 'o', 'user,pcpu,pmem,etime,nlwp', str(pid)],
+            stderr=subprocess.DEVNULL).decode()
+        fields = out.split('\n')[1].split(None, 4)
+        data.update({'user': fields[0], 'cpu': float(fields[1]),
+                     'mem': float(fields[2]),
+                     'etime': fields[3].replace('-', 'd '),
+                     'threads': int(fields[4], 10)})
+    except (subprocess.CalledProcessError, IndexError, ValueError,
+            OSError):
+        pass
+    return data
+
+
+def get_command_line(pid):
+    try:
+        with open('/proc/%d/cmdline' % pid) as fh:
+            return fh.read().replace('\0', ' ').strip()
+    except OSError:
+        return ''
+
+
+def get_best_size(value):
+    """Human-readable size (reference: like_ps.py:97-117)."""
+    for mag, unit in ((1024.0 ** 4, 'TB'), (1024.0 ** 3, 'GB'),
+                      (1024.0 ** 2, 'MB'), (1024.0, 'kB')):
+        if value >= mag:
+            return value / mag, unit
+    return float(value), 'B'
+
+
+def ring_geometry(contents):
+    """rings/<name> geometry proclogs -> {ring_name: fields}."""
+    out = {}
+    for block, logs in contents.items():
+        norm = block.replace(os.sep, '/')
+        if norm == 'rings':
+            for name, fields in logs.items():
+                out[name] = fields
+        elif norm.startswith('rings/'):
+            name = norm.split('/', 1)[1]
+            for fields in logs.values():
+                out[name] = fields
+    return out
+
+
+def block_rings(logs):
+    """([in rings], [out rings]) recorded by a block's in/out logs."""
+    rins, routs = [], []
+    for log, dest in (('in', rins), ('out', routs)):
+        d = logs.get(log, {})
+        for key in sorted(d):
+            if key.startswith('ring') and d[key] not in dest:
+                dest.append(d[key])
+    return rins, routs
+
+
+def describe_pid(pid):
+    """Text description of one pipeline
+    (reference: like_ps.py:120-196)."""
+    contents = proclog.load_by_pid(pid)
+    details = get_process_details(pid)
+    cmd = get_command_line(pid)
+    if not cmd and not details['user'] and not contents:
+        return []
+    out = ['PID: %i' % pid,
+           '  Command: %s' % cmd,
+           '  User: %s' % details['user'],
+           '  CPU Usage: %.1f%%' % details['cpu'],
+           '  Memory Usage: %.1f%%' % details['mem'],
+           '  Elapsed Time: %s' % details['etime'],
+           '  Thread Count: %i' % details['threads']]
+
+    geometry = ring_geometry(contents)
+    rings = []
+    for block, logs in sorted(contents.items()):
+        if block.replace(os.sep, '/').startswith('rings'):
             continue
-        contents = proclog.load_by_pid(int(pid_s))
-        for block, logs in sorted(contents.items()):
-            core = logs.get('bind', {}).get('core0', '-')
-            print('%-8s %-10s %s' % (pid_s, core, block))
+        for ring in sum(block_rings(logs), []):
+            if ring not in rings:
+                rings.append(ring)
+
+    out.append('  Rings:')
+    for i, ring in enumerate(rings):
+        dtl = geometry.get(str(ring))
+        if dtl and 'stride' in dtl:
+            sz, un = get_best_size(
+                float(dtl['stride']) *
+                max(int(dtl.get('nringlet', 1)), 1))
+            out.append('    %i: %s on %s of size %.1f %s'
+                       % (i, ring, dtl.get('space', '?'), sz, un))
+        else:
+            out.append('    %i: %s' % (i, ring))
+
+    out.append('  Blocks:')
+    for block, logs in sorted(contents.items()):
+        if block.replace(os.sep, '/').startswith('rings'):
+            continue
+        rins, routs = block_rings(logs)
+        core = logs.get('bind', {}).get('core0', None)
+        out.append('    %s%s' % (block, '' if core is None
+                                 else ' (core %s)' % core))
+        if rins:
+            out.append('      -> read ring(s): %s'
+                       % ' '.join('%i' % rings.index(v) for v in rins
+                                  if v in rings))
+        if routs:
+            out.append('      -> write ring(s): %s'
+                       % ' '.join('%i' % rings.index(v) for v in routs
+                                  if v in rings))
+        if logs:
+            out.append('      -> log(s): %s' % ' '.join(sorted(logs)))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('pid', nargs='*', type=int,
+                    help='pipeline PIDs (default: all found)')
+    args = ap.parse_args()
+    pids = args.pid or list_pipelines()
+    if not pids:
+        print('No running pipelines found under %s'
+              % proclog.proclog_dir())
+        return 1
+    for pid in pids:
+        for line in describe_pid(pid):
+            print(line)
     return 0
 
 

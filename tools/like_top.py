@@ -1,19 +1,154 @@
 #!/usr/bin/env python3
 """top-style monitor of running bifrost_tpu pipelines
-(reference: tools/like_top.py).
+(reference: tools/like_top.py:52-442).
 
-Renders per-block acquire/reserve/process times from the ProcLog tree.
-Use --once for a single text snapshot (no curses).
+Panes (matching the reference's information set):
+  * load average + process counts (/proc/loadavg)
+  * aggregate + per-core CPU usage deltas (/proc/stat)
+  * memory / swap usage (/proc/meminfo)
+  * optional accelerator memory line (--devices; off by default so a
+    dead accelerator tunnel cannot hang the monitor)
+  * per-block rows across ALL pipeline PIDs: PID, block, core, %CPU of
+    that core, total/acquire/process/reserve perf times, command line
+
+Interactive curses UI with the reference's sort keys (i=pid, b=name,
+c=core, t=total, a=acquire, p=process, r=reserve; pressing the active
+key again reverses; q quits).  ``--once`` prints one plain-text
+snapshot instead (usable in pipes/tests).
 """
 
 import argparse
 import os
+import socket
 import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from bifrost_tpu import proclog  # noqa: E402
+
+
+def get_load_average():
+    """1/5/10-minute load + process counts (/proc/loadavg;
+    reference: like_top.py:52-74)."""
+    data = {'1min': 0.0, '5min': 0.0, '10min': 0.0,
+            'procTotal': 0, 'procRunning': 0, 'lastPID': 0}
+    try:
+        with open('/proc/loadavg') as fh:
+            fields = fh.read().split(None, 4)
+        running, total = fields[3].split('/', 1)
+        data.update({'1min': float(fields[0]), '5min': float(fields[1]),
+                     '10min': float(fields[2]),
+                     'procRunning': int(running), 'procTotal': int(total),
+                     'lastPID': int(fields[4])})
+    except (OSError, ValueError, IndexError):
+        pass
+    return data
+
+
+_CPU_STATE = {}
+
+
+def get_processor_usage():
+    """Per-CPU usage fractions since the previous call (/proc/stat
+    deltas; reference: like_top.py:76-132).  Keys: 'avg' and one per
+    core id; values: user/nice/sys/idle/wait/irq/sirq/steal/total."""
+    zero = {'user': 0.0, 'nice': 0.0, 'sys': 0.0, 'idle': 0.0,
+            'wait': 0.0, 'irq': 0.0, 'sirq': 0.0, 'steal': 0.0,
+            'total': 0.0}
+    data = {'avg': dict(zero)}
+    try:
+        with open('/proc/stat') as fh:
+            lines = fh.read().split('\n')
+    except OSError:
+        return data
+    for line in lines:
+        if not line.startswith('cpu'):
+            break
+        fields = line.split(None, 10)
+        try:
+            cid = int(fields[0][3:], 10)
+        except ValueError:
+            cid = 'avg'
+        try:
+            us, ni, sy, idl, wa, hi, si, st = \
+                (float(v) for v in fields[1:9])
+        except (ValueError, IndexError):
+            continue
+        prev = _CPU_STATE.get(cid)
+        _CPU_STATE[cid] = {'us': us, 'ni': ni, 'sy': sy, 'id': idl,
+                           'wa': wa, 'hi': hi, 'si': si, 'st': st}
+        if prev is not None:
+            us -= prev['us']; ni -= prev['ni']; sy -= prev['sy']
+            idl -= prev['id']; wa -= prev['wa']; hi -= prev['hi']
+            si -= prev['si']; st -= prev['st']
+        t = us + ni + sy + idl + wa + hi + si + st
+        if t <= 0:
+            data[cid] = dict(zero)
+            continue
+        data[cid] = {'user': us / t, 'nice': ni / t, 'sys': sy / t,
+                     'idle': idl / t, 'wait': wa / t, 'irq': hi / t,
+                     'sirq': si / t, 'steal': st / t,
+                     'total': (us + ni + sy) / t}
+    return data
+
+
+def get_memory_swap_usage():
+    """Memory and swap from /proc/meminfo (kB;
+    reference: like_top.py:134-166)."""
+    data = {'memTotal': 0, 'memUsed': 0, 'memFree': 0, 'swapTotal': 0,
+            'swapUsed': 0, 'swapFree': 0, 'buffers': 0, 'cached': 0}
+    keymap = {'MemTotal:': 'memTotal', 'MemFree:': 'memFree',
+              'Buffers:': 'buffers', 'Cached:': 'cached',
+              'SwapTotal:': 'swapTotal', 'SwapFree:': 'swapFree'}
+    try:
+        with open('/proc/meminfo') as fh:
+            for line in fh:
+                fields = line.split(None, 2)
+                if fields and fields[0] in keymap:
+                    data[keymap[fields[0]]] = int(fields[1], 10)
+    except (OSError, ValueError):
+        pass
+    data['memUsed'] = data['memTotal'] - data['memFree']
+    data['swapUsed'] = data['swapTotal'] - data['swapFree']
+    return data
+
+
+def get_device_memory_usage(timeout=10.0):
+    """Accelerator memory via jax device memory_stats(), queried in a
+    SUBPROCESS with a timeout so a dead tunnel cannot hang the monitor
+    (the TPU analogue of the reference's nvidia-smi pane,
+    like_top.py:168-208)."""
+    import subprocess
+    data = {'devCount': 0, 'memTotal': 0, 'memUsed': 0, 'memFree': 0}
+    code = (
+        "import jax\n"
+        "tot = used = n = 0\n"
+        "for d in jax.local_devices():\n"
+        "    s = d.memory_stats() or {}\n"
+        "    tot += s.get('bytes_limit', 0)\n"
+        "    used += s.get('bytes_in_use', 0)\n"
+        "    n += 1\n"
+        "print(n, tot, used)\n")
+    try:
+        out = subprocess.run([sys.executable, '-c', code],
+                             capture_output=True, timeout=timeout)
+        n, tot, used = (int(v) for v in out.stdout.split()[-3:])
+        data.update({'devCount': n, 'memTotal': tot // 1024,
+                     'memUsed': used // 1024,
+                     'memFree': (tot - used) // 1024})
+    except Exception:
+        pass
+    return data
+
+
+def get_command_line(pid):
+    """Full command line of ``pid`` (reference: like_top.py:210-224)."""
+    try:
+        with open('/proc/%d/cmdline' % pid) as fh:
+            return fh.read().replace('\0', ' ').strip()
+    except OSError:
+        return ''
 
 
 def list_pipelines():
@@ -23,61 +158,157 @@ def list_pipelines():
     return sorted(int(p) for p in os.listdir(base) if p.isdigit())
 
 
-def snapshot(pid):
-    contents = proclog.load_by_pid(pid)
-    rows = []
-    for block, logs in sorted(contents.items()):
-        perf = logs.get('perf', {})
-        if not perf:
-            continue
-        rows.append((block,
-                     perf.get('acquire_time', -1),
-                     perf.get('reserve_time', -1),
-                     perf.get('process_time', -1)))
+def collect_blocks(pids=None):
+    """Per-block rows across pipelines: pid/name/cmd/core and the perf
+    times (reference: like_top.py:305-330)."""
+    rows = {}
+    for pid in (pids if pids is not None else list_pipelines()):
+        contents = proclog.load_by_pid(pid)
+        cmd = get_command_line(pid)
+        for block, logs in contents.items():
+            if block == 'rings':
+                continue
+            core = logs.get('bind', {}).get('core0', -1)
+            perf = logs.get('perf', {})
+            if not perf and 'bind' not in logs:
+                continue
+            ac = max(0.0, _num(perf.get('acquire_time')))
+            pr = max(0.0, _num(perf.get('process_time')))
+            re = max(0.0, _num(perf.get('reserve_time')))
+            rows['%d-%s' % (pid, block)] = {
+                'pid': pid, 'name': block, 'cmd': cmd, 'core': core,
+                'acquire': ac, 'process': pr, 'reserve': re,
+                'total': ac + pr + re}
     return rows
 
 
-def render(pid, rows):
-    out = ['pipeline pid %d   (%s)' % (pid, time.ctime()),
-           '%-44s %10s %10s %10s' % ('block', 'acquire_s', 'reserve_s',
-                                     'process_s'),
-           '-' * 78]
-    for block, acq, res, proc in rows:
-        def f(v):
-            return '%.2e' % v if isinstance(v, (int, float)) and v >= 0 \
-                else '-'
-        out.append('%-44s %10s %10s %10s' % (block[:44], f(acq), f(res),
-                                             f(proc)))
-    return '\n'.join(out)
+def _num(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def render_text(load, cpu, mem, dev, rows, sort_key='process',
+                sort_rev=True, width=110):
+    """Render the full display as text lines (shared by --once and the
+    curses loop)."""
+    host = socket.gethostname()
+    out = []
+    out.append('like_top - %s - load average: %.2f, %.2f, %.2f'
+               % (host, load['1min'], load['5min'], load['10min']))
+    out.append('Processes: %s total, %s running'
+               % (load['procTotal'], load['procRunning']))
+    c = cpu.get('avg', {})
+    out.append('CPU(s):%5.1f%%us,%5.1f%%sy,%5.1f%%ni,%5.1f%%id,'
+               '%5.1f%%wa,%5.1f%%hi,%5.1f%%si,%5.1f%%st'
+               % tuple(100.0 * c.get(k, 0.0)
+                       for k in ('user', 'sys', 'nice', 'idle', 'wait',
+                                 'irq', 'sirq', 'steal')))
+    out.append('Mem:  %9ik total, %9ik used, %9ik free, %9ik buffers'
+               % (mem['memTotal'], mem['memUsed'], mem['memFree'],
+                  mem['buffers']))
+    out.append('Swap: %9ik total, %9ik used, %9ik free, %9ik cached'
+               % (mem['swapTotal'], mem['swapUsed'], mem['swapFree'],
+                  mem['cached']))
+    if dev and dev.get('devCount'):
+        out.append('Dev(s): %9ik total, %9ik used, %9ik free, '
+                   '%i device(s)'
+                   % (dev['memTotal'], dev['memUsed'], dev['memFree'],
+                      dev['devCount']))
+    out.append('')
+    hdr = '%6s  %-24s  %4s  %5s  %8s  %8s  %8s  %8s  Cmd' \
+        % ('PID', 'Block', 'Core', '%CPU', 'Total', 'Acquire',
+           'Process', 'Reserve')
+    out.append(hdr)
+    order = sorted(rows, key=lambda k: rows[k][sort_key],
+                   reverse=sort_rev)
+    for key in order:
+        d = rows[key]
+        try:
+            pct = '%5.1f' % (100.0 * cpu[d['core']]['total'])
+        except (KeyError, TypeError):
+            pct = '%5s' % ' '
+        name = d['name'].split('/')[-1][:24]
+        out.append('%6i  %-24s  %4s  %5s  %8.3f  %8.3f  %8.3f  %8.3f  %s'
+                   % (d['pid'], name, d['core'], pct, d['total'],
+                      d['acquire'], d['process'], d['reserve'],
+                      d['cmd'][:max(width - 96, 0)]))
+    return out
+
+
+_SORT_KEYS = {'i': 'pid', 'b': 'name', 'c': 'core', 't': 'total',
+              'a': 'acquire', 'p': 'process', 'r': 'reserve'}
+
+
+def run_curses(args):
+    import curses
+
+    def loop(scr):
+        curses.use_default_colors()
+        scr.nodelay(1)
+        sort_key, sort_rev = args.sort, True
+        t_last, state = 0.0, None
+        while True:
+            ch = scr.getch()
+            curses.flushinp()
+            if ch == ord('q'):
+                break
+            if 0 <= ch < 256 and chr(ch) in _SORT_KEYS:
+                new_key = _SORT_KEYS[chr(ch)]
+                sort_rev = not sort_rev if new_key == sort_key else True
+                sort_key = new_key
+            now = time.time()
+            if now - t_last > args.interval or state is None:
+                state = (get_load_average(), get_processor_usage(),
+                         get_memory_swap_usage(),
+                         get_device_memory_usage() if args.devices
+                         else None,
+                         collect_blocks())
+                t_last = now
+            maxy, maxx = scr.getmaxyx()
+            lines = render_text(*state, sort_key=sort_key,
+                                sort_rev=sort_rev, width=maxx)
+            for y, line in enumerate(lines[:maxy - 1]):
+                attr = curses.A_REVERSE if line.startswith('   PID') \
+                    else curses.A_NORMAL
+                try:
+                    scr.addstr(y, 0, line[:maxx - 1], attr)
+                    scr.clrtoeol()
+                except curses.error:
+                    break
+            scr.clrtobot()
+            scr.refresh()
+            time.sleep(0.2)
+
+    curses.wrapper(loop)
 
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument('pid', nargs='?', type=int,
-                    help='pipeline PID (default: first found)')
     ap.add_argument('--once', action='store_true',
-                    help='print one snapshot and exit')
-    ap.add_argument('--interval', type=float, default=1.0)
+                    help='print one plain-text snapshot and exit')
+    ap.add_argument('--interval', type=float, default=1.0,
+                    help='poll interval in seconds')
+    ap.add_argument('--devices', action='store_true',
+                    help='also query accelerator memory (may be slow '
+                         'when the device tunnel is down)')
+    ap.add_argument('--sort', default='process',
+                    choices=sorted(set(_SORT_KEYS.values())))
     args = ap.parse_args()
 
-    pid = args.pid
-    if pid is None:
-        pids = list_pipelines()
-        if not pids:
-            print("No running pipelines found under %s"
-                  % proclog.proclog_dir())
-            return 1
-        pid = pids[0]
     if args.once:
-        print(render(pid, snapshot(pid)))
+        get_processor_usage()        # prime the delta state
+        time.sleep(0.05)
+        lines = render_text(
+            get_load_average(), get_processor_usage(),
+            get_memory_swap_usage(),
+            get_device_memory_usage() if args.devices else None,
+            collect_blocks(), sort_key=args.sort)
+        print('\n'.join(lines))
         return 0
-    try:
-        while True:
-            os.system('clear')
-            print(render(pid, snapshot(pid)))
-            time.sleep(args.interval)
-    except KeyboardInterrupt:
-        return 0
+    run_curses(args)
+    return 0
 
 
 if __name__ == '__main__':

@@ -1,8 +1,22 @@
 #!/usr/bin/env python3
 """Reconstruct a running pipeline's block/ring graph from its ProcLogs
-and emit graphviz DOT (reference: tools/pipeline2dot.py:97)."""
+and emit graphviz DOT (reference: tools/pipeline2dot.py).
 
+Annotations matching the reference's information set:
+  * graph label with the pipeline's command line
+  * block shapes by role (source=ellipse, sink=diamond, transform=box)
+    and CPU binding ("CPU3" / "Unbound") in each block label
+  * ring nodes annotated with space, size, and nringlet from the
+    rings/<name> geometry ProcLogs
+  * edge labels with the stream dtype where a sequence ProcLog
+    records one
+  * dotted bidirectional association edges between blocks bound to the
+    same core (reference: pipeline2dot.py:188-219)
+"""
+
+import argparse
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
@@ -10,49 +24,178 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 from bifrost_tpu import proclog  # noqa: E402
 
 
-def get_data_flows(contents):
-    """block -> ([in rings], [out rings]) from the in/out proclogs."""
-    flows = {}
+def get_best_size(value):
+    for mag, unit in ((1024.0 ** 4, 'TB'), (1024.0 ** 3, 'GB'),
+                      (1024.0 ** 2, 'MB'), (1024.0, 'kB')):
+        if value >= mag:
+            return value / mag, unit
+    return float(value), 'B'
+
+
+def get_command_line(pid):
+    try:
+        with open('/proc/%d/cmdline' % pid) as fh:
+            return fh.read().replace('\0', ' ').strip()
+    except OSError:
+        return ''
+
+
+def _is_ring_entry(block):
+    return block.replace(os.sep, '/').startswith('rings')
+
+
+def ring_geometry(contents):
+    out = {}
     for block, logs in contents.items():
-        def rings(log):
+        norm = block.replace(os.sep, '/')
+        if norm == 'rings':
+            out.update(logs)
+        elif norm.startswith('rings/'):
+            for fields in logs.values():
+                out[norm.split('/', 1)[1]] = fields
+    return out
+
+
+def get_data_flows(contents):
+    """block -> ([in rings], [out rings]); also classify sources/sinks
+    (reference: pipeline2dot.py:97-136)."""
+    flows, sources, sinks = {}, [], []
+    for block, logs in contents.items():
+        if _is_ring_entry(block):
+            continue
+        rins, routs = [], []
+        found = False
+        for log, dest in (('in', rins), ('out', routs)):
             d = logs.get(log, {})
-            return [d['ring%i' % i] for i in range(d.get('nring', 0))
-                    if 'ring%i' % i in d]
-        flows[block] = (rings('in'), rings('out'))
-    return flows
+            for key in sorted(d):
+                if key.startswith('ring'):
+                    found = True
+                    if d[key] not in dest:
+                        dest.append(d[key])
+        flows[block] = (rins, routs)
+        if found and not rins:
+            sources.append(block)
+        if found and not routs:
+            sinks.append(block)
+    return flows, sources, sinks
 
 
-def to_dot(contents):
-    flows = get_data_flows(contents)
-    lines = ['digraph pipeline {', '  rankdir=LR;']
+_DTYPE_RE = re.compile(r"'dtype':\s*'([^']+)'")
+
+
+def stream_dtype(logs):
+    """dtype recorded by a block's sequence ProcLogs, if any
+    (reference reads nbit/complex from sequence logs,
+    pipeline2dot.py:160-168)."""
+    for name, d in logs.items():
+        if not name.startswith('sequence'):
+            continue
+        if 'dtype' in d:
+            return str(d['dtype'])
+        tensor = d.get('_tensor')
+        if isinstance(tensor, str):
+            m = _DTYPE_RE.search(tensor)
+            if m:
+                return m.group(1)
+    return None
+
+
+def core_associations(contents):
+    """Pairs of blocks bound to a common core
+    (reference: pipeline2dot.py:188-219)."""
+    cores = {}
+    for block, logs in contents.items():
+        if _is_ring_entry(block):
+            continue
+        bound = []
+        i = 0
+        while 'core%i' % i in logs.get('bind', {}):
+            bound.append(logs['bind']['core%i' % i])
+            i += 1
+        if bound:
+            cores[block] = set(bound)
+    pairs = []
+    names = sorted(cores)
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            if cores[a] & cores[b] and cores[a] != {-1}:
+                pairs.append((a, b))
+    return pairs
+
+
+def to_dot(pid, contents, associations=True):
+    flows, sources, sinks = get_data_flows(contents)
+    geometry = ring_geometry(contents)
+    cmd = get_command_line(pid)
+    if cmd.startswith('python'):
+        cmd = cmd.split(None, 1)[-1]
+    cmd = os.path.basename(cmd.split(None, 1)[0]) if cmd else ''
+
+    lines = ['digraph graph%d {' % pid,
+             '  rankdir=LR;',
+             '  labelloc="t";',
+             '  label="Pipeline: %s\\n ";' % cmd]
     rings = set()
     for block, (ins, outs) in sorted(flows.items()):
-        lines.append('  "%s" [shape=box,style=filled,'
-                     'fillcolor=lightsteelblue];' % block)
+        logs = contents[block]
+        core = logs.get('bind', {}).get('core0', None)
+        cpu = 'Unbound' if core in (None, -1) else 'CPU%s' % core
+        shape = 'ellipse' if block in sources else \
+            'diamond' if block in sinks else 'box'
+        lines.append('  "%s" [label="%s\\n%s" shape="%s" style=filled '
+                     'fillcolor=lightsteelblue];'
+                     % (block, block, cpu, shape))
+        # sequence proclogs record the block's INPUT header
+        # (pipeline.py MultiTransformBlock.main), so the dtype label
+        # belongs on the input edges only
+        dtype = stream_dtype(logs)
+        label = ' [label="%s"]' % dtype if dtype else ''
         for r in ins:
             rings.add(r)
-            lines.append('  "%s" -> "%s";' % (r, block))
+            lines.append('  "ring:%s" -> "%s"%s;' % (r, block, label))
         for r in outs:
             rings.add(r)
-            lines.append('  "%s" -> "%s";' % (block, r))
+            lines.append('  "%s" -> "ring:%s";' % (block, r))
     for r in sorted(rings):
-        lines.append('  "%s" [shape=ellipse];' % r)
+        dtl = geometry.get(str(r), {})
+        if 'stride' in dtl:
+            sz, un = get_best_size(
+                float(dtl['stride']) *
+                max(int(dtl.get('nringlet', 1)), 1))
+            extra = '\\n%s  %.1f %s' % (dtl.get('space', '?'), sz, un)
+            nringlet = int(dtl.get('nringlet', 1))
+            if nringlet > 1:
+                extra += '  x%d ringlets' % nringlet
+        else:
+            extra = ''
+        lines.append('  "ring:%s" [label="%s%s" shape=ellipse];'
+                     % (r, r, extra))
+    if associations:
+        for a, b in core_associations(contents):
+            lines.append('  "%s" -> "%s" [style="dotted" dir="both"];'
+                         % (a, b))
     lines.append('}')
     return '\n'.join(lines)
 
 
 def main():
-    if len(sys.argv) > 1:
-        pid = int(sys.argv[1])
-    else:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('pid', nargs='?', type=int,
+                    help='pipeline PID (default: first found)')
+    ap.add_argument('-n', '--no-associations', action='store_true',
+                    help='exclude same-core association edges')
+    args = ap.parse_args()
+    pid = args.pid
+    if pid is None:
         base = proclog.proclog_dir()
-        pids = sorted(int(p) for p in os.listdir(base) if p.isdigit()) \
-            if os.path.isdir(base) else []
+        pids = sorted(int(p) for p in os.listdir(base)
+                      if p.isdigit()) if os.path.isdir(base) else []
         if not pids:
-            print("No running pipelines found", file=sys.stderr)
+            print('No running pipelines found', file=sys.stderr)
             return 1
         pid = pids[0]
-    print(to_dot(proclog.load_by_pid(pid)))
+    print(to_dot(pid, proclog.load_by_pid(pid),
+                 associations=not args.no_associations))
     return 0
 
 
