@@ -25,6 +25,20 @@ Usage mirrors the reference::
 
 __version__ = '0.2.0'
 
+# Honor JAX_PLATFORMS even under PJRT plugins that ignore the env var
+# (the tunneled TPU plugin in this environment does): apply it through
+# the config API before any backend initializes, so
+# `JAX_PLATFORMS=cpu python examples/...` works as documented.
+import os as _os
+
+if _os.environ.get('JAX_PLATFORMS'):
+    try:
+        import jax as _jax
+        _jax.config.update('jax_platforms', _os.environ['JAX_PLATFORMS'])
+    except Exception:
+        pass
+del _os
+
 from .dtype import DataType
 from .space import Space, SPACES
 from .ndarray import (ndarray, asarray, empty, zeros, empty_like, zeros_like,

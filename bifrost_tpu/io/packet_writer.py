@@ -133,6 +133,8 @@ class _WriterBase(object):
         return self
 
     def __exit__(self, *exc):
+        # final totals must land regardless of write throttling
+        self._log_stats(force=True)
         return False
 
 

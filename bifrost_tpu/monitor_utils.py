@@ -1,0 +1,66 @@
+"""Shared helpers for the pipeline monitor tools (tools/like_*.py,
+tools/pipeline2dot.py) — one copy of the ProcLog-tree navigation and
+formatting logic all five use."""
+
+from __future__ import annotations
+
+import os
+
+from . import proclog
+
+__all__ = ['list_pipelines', 'get_command_line', 'get_best_size',
+           'ring_geometry', 'block_rings']
+
+
+def list_pipelines():
+    """PIDs with a ProcLog tree, sorted."""
+    base = proclog.proclog_dir()
+    if not os.path.isdir(base):
+        return []
+    return sorted(int(p) for p in os.listdir(base) if p.isdigit())
+
+
+def get_command_line(pid):
+    """Full command line of ``pid`` (reference: like_top.py:210-224)."""
+    try:
+        with open('/proc/%d/cmdline' % pid) as fh:
+            return fh.read().replace('\0', ' ').strip()
+    except OSError:
+        return ''
+
+
+def get_best_size(value):
+    """Human-readable (value, unit) for a byte count
+    (reference: like_ps.py:97-117)."""
+    for mag, unit in ((1024.0 ** 4, 'TB'), (1024.0 ** 3, 'GB'),
+                      (1024.0 ** 2, 'MB'), (1024.0, 'kB')):
+        if value >= mag:
+            return value / mag, unit
+    return float(value), 'B'
+
+
+def ring_geometry(contents):
+    """rings/<name> geometry ProcLogs -> {ring_name: fields} (written
+    by Ring._write_ring_proclog)."""
+    out = {}
+    for block, logs in contents.items():
+        norm = block.replace(os.sep, '/')
+        if norm == 'rings':
+            out.update({k: dict(v) for k, v in logs.items()})
+        elif norm.startswith('rings/'):
+            name = norm.split('/', 1)[1]
+            for fields in logs.values():
+                out[name] = dict(fields)
+    return out
+
+
+def block_rings(logs):
+    """([in rings], [out rings]) recorded by a block's in/out
+    ProcLogs."""
+    rins, routs = [], []
+    for log, dest in (('in', rins), ('out', routs)):
+        d = logs.get(log, {})
+        for key in sorted(d):
+            if key.startswith('ring') and d[key] not in dest:
+                dest.append(d[key])
+    return rins, routs

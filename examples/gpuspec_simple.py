@@ -6,6 +6,8 @@ pipeline (reference: testbench/gpuspec_simple.py:44-58).
   -> write_sigproc
 
 Usage: python gpuspec_simple.py <file.raw> [outdir]
+       python gpuspec_simple.py --demo    # synthesize a small .raw
+                                          # with a tone and process it
 """
 
 import os
@@ -39,15 +41,49 @@ def build(filenames, outdir='.', gulp_nframe=1, rfactor=4):
     return bc
 
 
+def make_demo_raw(path, nchan=4, ntime=256, npol=2, nblock=4, k=19):
+    """Synthesize a GUPPI RAW file with an x-pol tone at fine bin
+    ``k`` in every coarse channel (the reference testbench ships a
+    generator too, testbench/generate_test_data.py)."""
+    import numpy as np
+    from bifrost_tpu.io import guppi as guppi_io
+    blocsize = nchan * ntime * npol * 2
+    t = np.arange(ntime)
+    tone = np.exp(2j * np.pi * k * t / ntime)
+    with open(path, 'wb') as f:
+        for b in range(nblock):
+            raw = np.zeros((nchan, ntime, npol, 2), np.int8)
+            raw[:, :, 0, 0] = np.round(60 * tone.real)
+            raw[:, :, 0, 1] = np.round(60 * tone.imag)
+            guppi_io.write_header(f, {
+                'OBSNCHAN': nchan, 'NPOL': npol, 'NBITS': 8,
+                'BLOCSIZE': blocsize, 'OBSFREQ': 1500.0, 'OBSBW': 4.0,
+                'STT_IMJD': 58000, 'STT_SMJD': 0, 'PKTIDX': b,
+                'PKTSIZE': 8192, 'TELESCOP': 'DEMO', 'BACKEND': 'GUPPI',
+                'SRC_NAME': 'TONE'})
+            f.write(raw.tobytes())
+
+
 def main(argv):
     if len(argv) < 2:
         print(__doc__)
         return 1
+    if argv[1] == '--demo':
+        import tempfile
+        outdir = argv[2] if len(argv) > 2 else tempfile.mkdtemp()
+        os.makedirs(outdir, exist_ok=True)
+        path = os.path.join(outdir, 'demo.raw')
+        make_demo_raw(path)
+        argv = [argv[0], path, outdir]
+        print("demo: synthesized %s" % path)
     outdir = argv[2] if len(argv) > 2 else '.'
     build([argv[1]], outdir)
     pipeline = bf.get_default_pipeline()
     pipeline.shutdown_on_signals()
     pipeline.run()
+    # write_sigproc names outputs <source basename>.fil
+    out = os.path.join(outdir, os.path.basename(argv[1]) + '.fil')
+    print("wrote %s" % out)
     return 0
 
 

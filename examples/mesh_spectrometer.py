@@ -12,9 +12,17 @@ Run without TPU hardware on a virtual device mesh:
     JAX_PLATFORMS=cpu python examples/mesh_spectrometer.py
 """
 
+import os
+import sys
+
 import numpy as np
 
-import bifrost_tpu as bf
+try:
+    import bifrost_tpu as bf
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bifrost_tpu as bf
 from bifrost_tpu.parallel import create_mesh
 from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
 

@@ -24,15 +24,9 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from bifrost_tpu import proclog  # noqa: E402
+from bifrost_tpu.monitor_utils import list_pipelines  # noqa: E402
 
 _HISTORY = 60
-
-
-def list_pipelines():
-    base = proclog.proclog_dir()
-    if not os.path.isdir(base):
-        return []
-    return sorted(int(p) for p in os.listdir(base) if p.isdigit())
 
 
 def get_transmit_receive():

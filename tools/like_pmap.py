@@ -19,14 +19,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from bifrost_tpu import proclog  # noqa: E402
-
-
-def get_best_size(value):
-    for mag, unit in ((1024.0 ** 4, 'TB'), (1024.0 ** 3, 'GB'),
-                      (1024.0 ** 2, 'MB'), (1024.0, 'kB')):
-        if value >= mag:
-            return value / mag, unit
-    return float(value), 'B'
+from bifrost_tpu.monitor_utils import (get_best_size,  # noqa: E402
+                                       ring_geometry)
 
 
 _NODE_RE = re.compile(r'^N(\d+)=(\d+)$')
@@ -85,11 +79,14 @@ def load_numa_maps(pid, page, huge_page):
                     swap_pages = int(tok.split('=', 1)[1], 10)
                 except ValueError:
                     pass
-        if not node_pages:
+        if not node_pages and not swap_pages:
             continue
         entry = {
-            'size': sum(node_pages.values()) * scale,
-            'node': max(node_pages, key=node_pages.get),
+            # a fully swapped-out area has no resident N<node>= counts;
+            # size it by its swapcache pages and park it on node -1
+            'size': (sum(node_pages.values()) or swap_pages) * scale,
+            'node': max(node_pages, key=node_pages.get)
+                    if node_pages else -1,
             'huge': huge,
             'heap': 'heap' in line,
             'stack': 'stack' in line,
@@ -103,17 +100,7 @@ def load_numa_maps(pid, page, huge_page):
 
 def load_rings(pid):
     """Ring geometry from the rings/<name> ProcLogs."""
-    contents = proclog.load_by_pid(pid)
-    rings = {}
-    for block, logs in contents.items():
-        norm = block.replace(os.sep, '/')
-        if norm == 'rings':
-            rings.update({k: dict(v) for k, v in logs.items()})
-        elif norm.startswith('rings/'):
-            name = norm.split('/', 1)[1]
-            for fields in logs.values():
-                rings[name] = dict(fields)
-    return rings
+    return ring_geometry(proclog.load_by_pid(pid))
 
 
 def node_totals(table):

@@ -16,13 +16,9 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from bifrost_tpu import proclog  # noqa: E402
-
-
-def list_pipelines():
-    base = proclog.proclog_dir()
-    if not os.path.isdir(base):
-        return []
-    return sorted(int(p) for p in os.listdir(base) if p.isdigit())
+from bifrost_tpu.monitor_utils import (list_pipelines,  # noqa: E402
+                                       get_command_line, get_best_size,
+                                       ring_geometry, block_rings)
 
 
 def get_process_details(pid):
@@ -45,47 +41,8 @@ def get_process_details(pid):
     return data
 
 
-def get_command_line(pid):
-    try:
-        with open('/proc/%d/cmdline' % pid) as fh:
-            return fh.read().replace('\0', ' ').strip()
-    except OSError:
-        return ''
 
 
-def get_best_size(value):
-    """Human-readable size (reference: like_ps.py:97-117)."""
-    for mag, unit in ((1024.0 ** 4, 'TB'), (1024.0 ** 3, 'GB'),
-                      (1024.0 ** 2, 'MB'), (1024.0, 'kB')):
-        if value >= mag:
-            return value / mag, unit
-    return float(value), 'B'
-
-
-def ring_geometry(contents):
-    """rings/<name> geometry proclogs -> {ring_name: fields}."""
-    out = {}
-    for block, logs in contents.items():
-        norm = block.replace(os.sep, '/')
-        if norm == 'rings':
-            for name, fields in logs.items():
-                out[name] = fields
-        elif norm.startswith('rings/'):
-            name = norm.split('/', 1)[1]
-            for fields in logs.values():
-                out[name] = fields
-    return out
-
-
-def block_rings(logs):
-    """([in rings], [out rings]) recorded by a block's in/out logs."""
-    rins, routs = [], []
-    for log, dest in (('in', rins), ('out', routs)):
-        d = logs.get(log, {})
-        for key in sorted(d):
-            if key.startswith('ring') and d[key] not in dest:
-                dest.append(d[key])
-    return rins, routs
 
 
 def describe_pid(pid):

@@ -26,6 +26,8 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from bifrost_tpu import proclog  # noqa: E402
+from bifrost_tpu.monitor_utils import (list_pipelines,  # noqa: E402
+                                       get_command_line)
 
 
 def get_load_average():
@@ -114,11 +116,21 @@ def get_memory_swap_usage():
     return data
 
 
+_DEV_CACHE = {'t': 0.0, 'data': None}
+_DEV_REFRESH_SECS = 30.0
+
+
 def get_device_memory_usage(timeout=10.0):
     """Accelerator memory via jax device memory_stats(), queried in a
     SUBPROCESS with a timeout so a dead tunnel cannot hang the monitor
     (the TPU analogue of the reference's nvidia-smi pane,
-    like_top.py:168-208)."""
+    like_top.py:168-208).  The result is cached for _DEV_REFRESH_SECS
+    seconds: the query costs a jax import per call, far too slow for
+    the curses poll loop."""
+    now = time.monotonic()
+    if _DEV_CACHE['data'] is not None and \
+            now - _DEV_CACHE['t'] < _DEV_REFRESH_SECS:
+        return _DEV_CACHE['data']
     import subprocess
     data = {'devCount': 0, 'memTotal': 0, 'memUsed': 0, 'memFree': 0}
     code = (
@@ -139,23 +151,8 @@ def get_device_memory_usage(timeout=10.0):
                      'memFree': (tot - used) // 1024})
     except Exception:
         pass
+    _DEV_CACHE.update(t=now, data=data)
     return data
-
-
-def get_command_line(pid):
-    """Full command line of ``pid`` (reference: like_top.py:210-224)."""
-    try:
-        with open('/proc/%d/cmdline' % pid) as fh:
-            return fh.read().replace('\0', ' ').strip()
-    except OSError:
-        return ''
-
-
-def list_pipelines():
-    base = proclog.proclog_dir()
-    if not os.path.isdir(base):
-        return []
-    return sorted(int(p) for p in os.listdir(base) if p.isdigit())
 
 
 def collect_blocks(pids=None):

@@ -22,38 +22,13 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from bifrost_tpu import proclog  # noqa: E402
-
-
-def get_best_size(value):
-    for mag, unit in ((1024.0 ** 4, 'TB'), (1024.0 ** 3, 'GB'),
-                      (1024.0 ** 2, 'MB'), (1024.0, 'kB')):
-        if value >= mag:
-            return value / mag, unit
-    return float(value), 'B'
-
-
-def get_command_line(pid):
-    try:
-        with open('/proc/%d/cmdline' % pid) as fh:
-            return fh.read().replace('\0', ' ').strip()
-    except OSError:
-        return ''
+from bifrost_tpu.monitor_utils import (get_best_size,  # noqa: E402
+                                       get_command_line, ring_geometry)
 
 
 def _is_ring_entry(block):
     return block.replace(os.sep, '/').startswith('rings')
 
-
-def ring_geometry(contents):
-    out = {}
-    for block, logs in contents.items():
-        norm = block.replace(os.sep, '/')
-        if norm == 'rings':
-            out.update(logs)
-        elif norm.startswith('rings/'):
-            for fields in logs.values():
-                out[norm.split('/', 1)[1]] = fields
-    return out
 
 
 def get_data_flows(contents):
