@@ -93,7 +93,7 @@ def main():
             for i in range(data.shape[0]):
                 tx.send(hi, i + 1, 1, 0, 1, data[i:i + 1])
 
-    peaks = []
+    total = np.zeros(NTIME)
 
     class PeakSink(bf.SinkBlock):
         def on_sequence(self, iseq):
@@ -103,8 +103,7 @@ def main():
 
         def on_data(self, ispan):
             spec = np.asarray(ispan.data.as_numpy())   # (t, roach, F)
-            i_spec = spec.mean(axis=(0, 1))
-            peaks.append(int(np.argmax(i_spec)))
+            total[:] += spec.sum(axis=(0, 1))
 
     with bf.Pipeline() as pipeline:
         b = bf.blocks.copy(ring, space='tpu')
@@ -122,7 +121,7 @@ def main():
         pipe_thread = threading.Thread(target=pipeline.run)
         pipe_thread.start()
         pipeline.all_blocks_finished_initializing_event.wait(30)
-        time.sleep(0.5)
+        time.sleep(1.0)
         # transmit first: UDP buffers the datagrams, and a capture
         # started with an empty socket would end on its first
         # no-data timeout if the transmitter were scheduled late
@@ -134,7 +133,7 @@ def main():
         cap_thread.join()
         pipe_thread.join()
 
-    peak = max(set(peaks), key=peaks.count) if peaks else None
+    peak = int(np.argmax(total)) if total.any() else None
     print("detected tone at fine bin %s (expected %d)"
           % (peak, TONE_BIN))
     if peak != TONE_BIN:
