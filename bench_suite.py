@@ -134,6 +134,23 @@ def bench_fdmt(ceil):
     fn = jax.jit(plan._pick_core(False))
     t = _bench_fn(fn, x, iters=10)
     nsamples = NCHAN * T
+    # Pallas-vs-XLA core comparison on the SAME shapes, so the
+    # kernel-speedup claim is a per-round measured artifact rather
+    # than CHANGELOG prose (VERDICT r2 item 7)
+    core_cmp = {}
+    try:
+        t_x = _bench_fn(jax.jit(plan._core_jax(False)), x, iters=5)
+        core_cmp['xla_gather_ms'] = round(t_x * 1e3, 2)
+        core_cmp['default_ms'] = round(t * 1e3, 2)
+        try:
+            t_p = _bench_fn(jax.jit(plan._core_pallas(False)), x,
+                            iters=5)
+            core_cmp['pallas_ms'] = round(t_p * 1e3, 2)
+            core_cmp['pallas_speedup'] = round(t_x / t_p, 2)
+        except Exception as e:
+            core_cmp['pallas'] = 'unavailable: %s' % type(e).__name__
+    except Exception as e:
+        core_cmp['error'] = '%s: %s' % (type(e).__name__, str(e)[:120])
     # bytes: each merge step reads + writes ~ (nchan_cur * nd * T) f32;
     # total over log2(nchan) steps dominated by early wide steps
     plan_steps = plan._plan['steps']
@@ -152,6 +169,7 @@ def bench_fdmt(ceil):
         'roofline': {'achieved_GBs': bw, 'hbm_GBs': ceil['hbm_gbs'],
                      'bw_frac': bw / ceil['hbm_gbs'],
                      'bound': 'bandwidth (gather/add, no matmul)'},
+        'core_compare': core_cmp,
     }
 
 
