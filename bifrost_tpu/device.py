@@ -25,6 +25,27 @@ def _devices():
     return jax.devices()
 
 
+_backend_ready = False
+
+
+def ensure_backend():
+    """Initialize the jax backend from the CALLING thread (idempotent).
+
+    The tunneled axon TPU plugin hangs indefinitely when its first
+    client initialization happens on a worker thread, so a pipeline
+    whose first device touch is inside a block thread would deadlock
+    at startup.  Pipeline.run() calls this from the launching thread
+    before spawning block threads; afterwards workers find a live
+    backend and never trigger client creation themselves.
+    """
+    global _backend_ready
+    if _backend_ready:
+        return
+    import jax
+    jax.devices()
+    _backend_ready = True
+
+
 def set_device(device):
     """Bind this thread to a device (reference: bfDeviceSet, src/cuda.cpp).
     Accepts an int index or a jax Device."""

@@ -151,6 +151,20 @@ def _dft_matrices(n1, n2, inverse, dtype_name):
     return out
 
 
+def _const_complex(m, acc):
+    """Embed a host complex matrix as a jit constant WITHOUT a
+    complex-typed host transfer: the tunneled TPU backend raises
+    UNIMPLEMENTED for complex device_put (and one failed transfer
+    poisons the whole process — see xfer.py), so ship re/im float
+    planes and recombine on device."""
+    import jax
+    import jax.numpy as jnp
+    ft = jnp.float64 if acc == jnp.complex128 else jnp.float32
+    return jax.lax.complex(
+        jnp.asarray(np.ascontiguousarray(m.real), dtype=ft),
+        jnp.asarray(np.ascontiguousarray(m.imag), dtype=ft))
+
+
 def dft_matmul_fft(x, axis=-1, inverse=False, compute_dtype=None):
     """c2c FFT along one axis as two MXU matmuls (Cooley-Tukey
     four-step: reshape N -> (N1, N2), DFT_N1, twiddle, DFT_N2).
@@ -172,7 +186,7 @@ def dft_matmul_fft(x, axis=-1, inverse=False, compute_dtype=None):
     if n1 == 1:            # prime length: plain DFT matmul
         fn = _dft_matrices(n, 1, inverse, dtn)[0]
         xm = jnp.moveaxis(x, axis, -1)
-        y = jnp.einsum('...k,kj->...j', xm, jnp.asarray(fn),
+        y = jnp.einsum('...k,kj->...j', xm, _const_complex(fn, acc),
                        preferred_element_type=acc)
         return jnp.moveaxis(y, -1, axis)
     f1, f2, tw = _dft_matrices(n1, n2, inverse, dtn)
@@ -194,9 +208,10 @@ def dft_matmul_fft(x, axis=-1, inverse=False, compute_dtype=None):
         return jnp.matmul(a, b, preferred_element_type=acc)
 
     # DFT over the n1 axis: contract with F1 on the left
-    y = mm(jnp.swapaxes(xm, -1, -2), jnp.asarray(f1.T))   # (..., n2, n1)
-    y = jnp.swapaxes(y, -1, -2) * jnp.asarray(tw)          # twiddle
-    y = mm(y, jnp.asarray(f2))                             # (..., n1, n2)
+    y = mm(jnp.swapaxes(xm, -1, -2),
+           _const_complex(f1.T, acc))                      # (..., n2, n1)
+    y = jnp.swapaxes(y, -1, -2) * _const_complex(tw, acc)  # twiddle
+    y = mm(y, _const_complex(f2, acc))                     # (..., n1, n2)
     # output index k = k1*n2 + k2? four-step ordering: k = k2*n1 + k1
     y = jnp.swapaxes(y, -1, -2).reshape(shp + (n,))
     return jnp.moveaxis(y, -1, axis)

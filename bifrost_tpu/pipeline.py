@@ -276,6 +276,15 @@ class Pipeline(BlockScope):
         self.all_blocks_finished_initializing_event.set()
 
     def run(self):
+        # device-space pipelines: create the jax backend client from
+        # THIS thread first — the tunneled TPU plugin deadlocks when a
+        # block (worker) thread triggers the first client init
+        if any(r.space != 'system'
+               for b in self.blocks
+               for r in (getattr(b, 'irings', None) or []) +
+                        (getattr(b, 'orings', None) or [])):
+            from .device import ensure_backend
+            ensure_backend()
         self.threads = [threading.Thread(target=block.run, name=block.name)
                         for block in self.blocks]
         for thread in self.threads:

@@ -1,0 +1,68 @@
+"""Fused Pallas spectrometer kernel vs the float64 numpy oracle.
+
+Runs in Pallas interpret mode on the CPU test backend; the on-hardware
+equivalence (and the MXU timing) is covered by bench.py's correctness
+gate + the spectrometer entry in the bench suite.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bifrost_tpu.ops.spectrometer import (fused_spectrometer,
+                                          spectrometer_oracle)
+
+
+def _run(T, nfft, rfactor, time_tile, seed=0):
+    rng = np.random.RandomState(seed)
+    volt = rng.randint(-64, 64, size=(T, 2, nfft, 2)).astype(np.int8)
+    got = np.asarray(fused_spectrometer(
+        jnp.asarray(volt), rfactor=rfactor, time_tile=time_tile,
+        interpret=True))
+    want = spectrometer_oracle(volt, rfactor=rfactor)
+    rel = np.max(np.abs(got - want)) / (np.max(np.abs(want)) + 1e-30)
+    return got, want, rel
+
+
+def test_matches_oracle_4096():
+    got, want, rel = _run(T=8, nfft=4096, rfactor=4, time_tile=4)
+    assert got.shape == (8, 4, 1024)
+    assert rel < 1e-5
+
+
+def test_matches_oracle_small_fft():
+    got, want, rel = _run(T=8, nfft=256, rfactor=4, time_tile=8)
+    assert got.shape == (8, 4, 64)
+    assert rel < 1e-5
+
+
+def test_rfactor_variants():
+    for rf in (1, 2, 8):
+        got, want, rel = _run(T=4, nfft=1024, rfactor=rf, time_tile=4,
+                              seed=rf)
+        assert got.shape == (4, 4, 1024 // rf)
+        assert rel < 1e-5, rf
+
+
+def test_time_tile_not_dividing_T_shrinks():
+    # T=6 with requested tile 4 -> falls back to a divisor (3)
+    got, want, rel = _run(T=6, nfft=256, rfactor=4, time_tile=4)
+    assert got.shape == (6, 4, 64)
+    assert rel < 1e-5
+
+
+def test_rejects_bad_shapes():
+    volt = np.zeros((4, 2, 300, 2), np.int8)    # not a power of two
+    with pytest.raises(ValueError):
+        fused_spectrometer(jnp.asarray(volt), interpret=True)
+    volt = np.zeros((4, 1, 256, 2), np.int8)    # single pol
+    with pytest.raises(ValueError):
+        fused_spectrometer(jnp.asarray(volt), interpret=True)
+
+
+def test_rejects_rfactor_beyond_radix():
+    # n1 for 256 is 16; rfactor 32 cannot divide the radix split
+    volt = np.zeros((4, 2, 256, 2), np.int8)
+    with pytest.raises(ValueError):
+        fused_spectrometer(jnp.asarray(volt), rfactor=32,
+                           interpret=True)
