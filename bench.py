@@ -42,12 +42,31 @@ import bifrost_tpu  # noqa: F401
 
 A100_BASELINE_MSPS = 28000.0
 
-# HBM traffic of the fused TPU chain, per input sample: ci8 read (2 B)
+# HBM traffic of the XLA fused chain, per input sample: ci8 read (2 B)
 # + unpack kernel c64 write (8) + XLA FFT custom-call read + write
 # (8 + 8) + fused detect/reduce read (8) + reduced Stokes f32 write
 # (2) = 36 B.  (The 56 B figure in the baseline model above is the
 # UNFUSED cuFFT chain on the A100 and is used only for vs_baseline.)
 CHAIN_BYTES_PER_SAMPLE = 36.0
+# ... and of the fused Pallas spectrometer kernel: ci8 read (2 B) +
+# reduced Stokes f32 write (2 B); nothing else leaves VMEM.
+CHAIN_BYTES_PER_SAMPLE_PALLAS = 4.0
+
+
+def flagship_chain_info():
+    """(bytes_per_sample, impl_label) for the flagship chain as it
+    ACTUALLY runs under the current BF_SPEC_IMPL mode — the roofline
+    must use the traffic model of the path that executed, not the XLA
+    chain's."""
+    try:
+        from bifrost_tpu.ops.spectrometer import choose_precision
+        prec = choose_precision(NFINE, RFACTOR)
+    except Exception:
+        prec = 'off'
+    if prec != 'off':
+        label = 'pallas-spectrometer[%s]' % (prec or 'default')
+        return CHAIN_BYTES_PER_SAMPLE_PALLAS, label
+    return CHAIN_BYTES_PER_SAMPLE, 'xla-fused'
 
 NTIME = 16384        # frames per gulp
 NPOL = 2
@@ -330,6 +349,56 @@ def bench_fft_impls():
     return out
 
 
+def bench_spectrometer_kernel():
+    """Measure the fused Pallas spectrometer (ops/spectrometer.py) at
+    the bench shape: accuracy vs the float64 oracle and throughput per
+    precision/tile, plus which precision the auto mode would pick.
+    The flagship number above already reflects auto mode (BF_SPEC_IMPL);
+    this entry documents the kernel's standalone envelope."""
+    import jax
+    import jax.numpy as jnp
+    from bifrost_tpu.ops.spectrometer import (fused_spectrometer,
+                                              spectrometer_accuracy,
+                                              choose_precision)
+    if jax.devices()[0].platform != 'tpu':
+        return {'skipped': 'tpu-only measurement'}
+    out = {'chosen_by_auto': str(choose_precision(NFINE, RFACTOR))}
+    rng = np.random.RandomState(5)
+    T = 4096
+    big = rng.randint(-64, 64,
+                      size=(T, NPOL, NFINE, 2)).astype(np.int8)
+    xb = jnp.asarray(big)
+    n = T * NPOL * NFINE
+    for prec, name in ((None, 'default'), ('highest', 'highest')):
+        entry = {'rel_err': spectrometer_accuracy(prec, NFINE, RFACTOR)}
+        if entry['rel_err'] >= 1e9:
+            from bifrost_tpu.ops import spectrometer as _sp
+            entry['probe_error'] = _sp._last_probe_error
+        best = None
+        for tile in (16, 32, 64):
+            try:
+                f = jax.jit(lambda v, p=prec, t=tile: fused_spectrometer(
+                    v, rfactor=RFACTOR, time_tile=t, precision=p))
+                _force(f(xb))
+                t0 = time.perf_counter()
+                iters = 8
+                for _ in range(iters):
+                    y = f(xb)
+                _force(y)
+                msps = n * iters / (time.perf_counter() - t0) / 1e6
+                if best is None or msps > best[1]:
+                    best = (tile, msps)
+            except Exception as e:
+                entry.setdefault('tile_errors', {})[tile] = \
+                    '%s: %s' % (type(e).__name__, str(e)[:120])
+        if best:
+            entry['best_tile'] = best[0]
+            entry['msps'] = round(best[1], 1)
+            entry['vs_baseline'] = round(best[1] / A100_BASELINE_MSPS, 4)
+        out[name] = entry
+    return out
+
+
 def run_suite_into(result):
     """Fold the bench_suite configs + chip ceilings + the correctness
     gate + the FFT-impl comparison into ``result`` (VERDICT r2 item 1:
@@ -369,10 +438,11 @@ def run_suite_into(result):
     # config 2 is the flagship measurement already in `result`.
     # the fraction of the MEASURED HBM ceiling the fused chain
     # sustains is the roofline verdict on the chain (VERDICT r2 item 2)
-    chain_bytes_per_sample = CHAIN_BYTES_PER_SAMPLE
+    chain_bytes_per_sample, impl = flagship_chain_info()
     c2 = {'config': 'Guppi spectroscopy (flagship, above)',
           'value': result['value'],
           'unit': result['unit'],
+          'impl': impl,
           'vs_baseline': result['vs_baseline']}
     if isinstance(ceil.get('hbm_gbs'), float):
         achieved = result['value'] * 1e6 * chain_bytes_per_sample / 1e9
@@ -381,8 +451,10 @@ def run_suite_into(result):
             'achieved_GBs': round(achieved, 1),
             'hbm_GBs': round(ceil['hbm_gbs'], 1),
             'hbm_frac': round(achieved / ceil['hbm_gbs'], 3),
-            'bound': 'HBM bandwidth (FFT custom call caps fusion; '
-                     'see pallas fused-spectrometer path)'}
+            'bound': ('HBM in/out (whole chain resident in VMEM)'
+                      if impl.startswith('pallas') else
+                      'HBM bandwidth (FFT custom call caps fusion; '
+                      'see pallas fused-spectrometer path)')}
     configs['2'] = c2
     for cid in (1, 3, 4, 5, 6):
         fn = bench_suite.ALL[cid]
@@ -408,6 +480,10 @@ def run_suite_into(result):
     fft_cmp = attempt(bench_fft_impls)
     result['fft_impl'] = fft_cmp
     detail['fft_impl'] = fft_cmp
+
+    spec = attempt(bench_spectrometer_kernel)
+    result['spectrometer'] = spec
+    detail['spectrometer'] = spec
 
     name = 'BENCH_SUITE_r03.json' if platform == 'tpu' \
         else 'BENCH_SUITE_%s_validation.json' % platform

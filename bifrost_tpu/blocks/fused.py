@@ -58,6 +58,14 @@ class FusedBlock(TransformBlock):
             cur = jax.eval_shape(fn, cur)
         composed = lambda x: _reduce(lambda v, f: f(v), fns, x)
         mesh = self.mesh
+        if mesh is None:
+            # whole-chain kernel substitution (e.g. the fused Pallas
+            # spectrometer) when the stage pattern + accuracy gate admit
+            from ..stages import match_spectrometer
+            spec_fn = match_spectrometer(self.stages, self._headers,
+                                         shape, dtype)
+            if spec_fn is not None:
+                composed = spec_fn
         if mesh is not None:
             # Scale the whole fused chain over the scope's mesh: shard the
             # gulp's frame axis, let GSPMD partition every stage and insert

@@ -478,3 +478,57 @@ class MapStage(Stage):
             ev.run(body)
             return ev.out['b']
         return fn
+
+
+def match_spectrometer(stages, headers, shape, dtype):
+    """Recognize the Guppi spectrometer pattern — FftStage(c2c forward,
+    no shift, last axis) -> DetectStage('stokes', pol) ->
+    ReduceStage('freq', r, 'sum') on ci8 dual-pol input — and return
+    the fused Pallas kernel (ops/spectrometer.py) when the active
+    BF_SPEC_IMPL mode admits it, else None.
+
+    This is the TPU equivalent of the reference wiring cuFFT load/store
+    callbacks into the transform (reference: src/fft_kernels.cu
+    CallbackData): the whole chain becomes one kernel with no HBM
+    round-trips between steps.
+    """
+    import os
+    if len(stages) != 3:
+        return None
+    f, d, r = stages
+    if not (isinstance(f, FftStage) and isinstance(d, DetectStage)
+            and isinstance(r, ReduceStage)):
+        return None
+    if headers[0]['_tensor']['dtype'] != 'ci8':
+        return None
+    if str(dtype) != 'int8' or len(shape) != 4:
+        return None
+    ntime, npol, nfft, two = shape
+    if npol != 2 or two != 2 or nfft < 4 or (nfft & (nfft - 1)):
+        return None
+    if f.mode != 'c2c' or f.inverse or f.apply_fftshift \
+            or f.axes != [2]:
+        return None
+    if d.mode != 'stokes' or d.axis_index != 1 or d.npol != 2:
+        return None
+    if r.op != 'sum' or r.axis != 2 or not r.factor:
+        return None
+    from .ops import spectrometer as spec
+    n1, _ = spec._factor_pow2(nfft)
+    if n1 % r.factor:
+        return None
+    prec = spec.choose_precision(nfft, r.factor)
+    if prec == 'off':
+        return None
+    try:
+        tile = int(os.environ.get('BF_SPEC_TILE', '32'))
+    except ValueError:
+        tile = 32
+    if tile < 1:
+        tile = 32
+    factor = r.factor
+
+    def fn(x):
+        return spec.fused_spectrometer(x, rfactor=factor,
+                                       time_tile=tile, precision=prec)
+    return fn

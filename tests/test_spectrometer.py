@@ -66,3 +66,97 @@ def test_rejects_rfactor_beyond_radix():
     with pytest.raises(ValueError):
         fused_spectrometer(jnp.asarray(volt), rfactor=32,
                            interpret=True)
+
+
+def test_fused_block_substitutes_kernel(monkeypatch):
+    """The FusedBlock spectrometer pattern-match swaps in the Pallas
+    kernel (interpret mode here) and the pipeline output still matches
+    the oracle."""
+    import bifrost_tpu as bf
+    from bifrost_tpu.ops import spectrometer as spec
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    calls = []
+    real = spec.fused_spectrometer
+
+    def fake(v, **kw):
+        calls.append(kw)
+        kw.pop('interpret', None)
+        return real(v, interpret=True, **kw)
+
+    monkeypatch.setattr(spec, 'choose_precision', lambda *a, **k: None)
+    monkeypatch.setattr(spec, 'fused_spectrometer', fake)
+
+    T, NF, RF = 8, 256, 4
+    rng = np.random.RandomState(3)
+    raw = np.zeros((T, 2, NF), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-32, 32, size=(T, 2, NF))
+    raw['im'] = rng.randint(-32, 32, size=(T, 2, NF))
+    with bf.Pipeline() as p:
+        hdr = simple_header([-1, 2, NF], 'ci8',
+                            labels=['time', 'pol', 'fine_time'])
+        src = NumpySourceBlock([raw], hdr, gulp_nframe=T)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fused(b, [
+            FftStage('fine_time', axis_labels='freq'),
+            DetectStage('stokes', axis='pol'),
+            ReduceStage('freq', RF),
+        ])
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    assert calls, "pattern matcher did not substitute the kernel"
+    out = sink.result()
+    volt = np.stack([raw['re'], raw['im']], axis=-1).astype(np.int8)
+    want = spectrometer_oracle(volt, rfactor=RF)
+    rel = np.max(np.abs(out - want)) / np.max(np.abs(want))
+    assert out.shape == (T, 4, NF // RF)
+    assert rel < 1e-5
+
+
+def test_matcher_rejects_non_matching_chains(monkeypatch):
+    """Chains that differ from the spectrometer pattern keep the XLA
+    path (matcher returns None)."""
+    from bifrost_tpu.ops import spectrometer as spec
+    from bifrost_tpu.stages import (FftStage, DetectStage, ReduceStage,
+                                    match_spectrometer)
+    monkeypatch.setattr(spec, 'choose_precision', lambda *a, **k: None)
+    hdr = {'_tensor': {'shape': [-1, 2, 256], 'dtype': 'ci8',
+                       'labels': ['time', 'pol', 'fine_time'],
+                       'scales': [[0, 1]] * 3, 'units': [None] * 3}}
+
+    def build(stages):
+        h = dict(hdr)
+        headers = [h]
+        for s in stages:
+            h = s.transform_header(h)
+            headers.append(h)
+        return headers
+
+    # matching chain sanity
+    st = [FftStage('fine_time', axis_labels='freq'),
+          DetectStage('stokes', axis='pol'), ReduceStage('freq', 4)]
+    hs = build(st)
+    assert match_spectrometer(st, hs, (8, 2, 256, 2), 'int8') is not None
+    # wrong detect mode
+    st = [FftStage('fine_time', axis_labels='freq'),
+          DetectStage('coherence', axis='pol'), ReduceStage('freq', 4)]
+    hs = build(st)
+    assert match_spectrometer(st, hs, (8, 2, 256, 2), 'int8') is None
+    # fftshift enabled
+    st = [FftStage('fine_time', axis_labels='freq', apply_fftshift=True),
+          DetectStage('stokes', axis='pol'), ReduceStage('freq', 4)]
+    hs = build(st)
+    assert match_spectrometer(st, hs, (8, 2, 256, 2), 'int8') is None
+    # mean reduce
+    st = [FftStage('fine_time', axis_labels='freq'),
+          DetectStage('stokes', axis='pol'),
+          ReduceStage('freq', 4, op='mean')]
+    hs = build(st)
+    assert match_spectrometer(st, hs, (8, 2, 256, 2), 'int8') is None
+    # non-power-of-two nfft never reaches the kernel
+    assert match_spectrometer(st, hs, (8, 2, 192, 2), 'int8') is None
