@@ -168,6 +168,88 @@ class Fdmt(object):
             return state[0, :max_delay, :]
         return core
 
+    def _core_jax_rolls(self, negative_delays):
+        """Merge steps as row-takes + STATIC lane rolls.
+
+        The generic XLA core expresses each step as a 3-D gather with
+        per-(row, delay) time shifts, which lowers poorly on TPU.
+        Here the output slots of every step are sorted by time-shift on
+        the host, the sort permutation is composed into the NEXT step's
+        index tables (so it never materializes at runtime), and each
+        distinct shift becomes ONE static jnp.roll over a contiguous
+        row segment — the runtime program is only axis-0 takes, lane
+        rotates, masked multiplies, and adds.  Select with
+        BF_FDMT_IMPL=rolls.  (Reference kernel this replaces:
+        src/fdmt.cu:53-96.)"""
+        import jax.numpy as jnp
+        plan = self._plan
+        nd_init = plan['nd_init']
+        steps = plan['steps']
+        max_delay = plan['max_delay']
+        sgn = -1 if negative_delays else +1
+
+        # host-side schedule: per step, physical row selections sorted
+        # by shift, contiguous equal-shift segments, passthrough mask
+        sched = []
+        nd_in = nd_init
+        in_pos = None               # logical flat idx -> physical row
+        for step in steps:
+            nout, nd_out = step.d1.shape
+            la = (step.rows_lo[:, None] * nd_in + step.d1).ravel()
+            lb = (step.rows_hi[:, None] * nd_in + step.d2).ravel()
+            shift = step.d1.ravel().astype(np.int64)
+            pt = np.repeat(step.passthrough, nd_out)
+            if in_pos is not None:
+                la = in_pos[la]
+                lb = in_pos[lb]
+            order = np.argsort(shift, kind='stable')
+            sel_a = la[order].astype(np.int32)
+            sel_b = lb[order].astype(np.int32)
+            s_sorted = shift[order]
+            segs = []
+            i, n = 0, len(s_sorted)
+            while i < n:
+                j = i
+                while j < n and s_sorted[j] == s_sorted[i]:
+                    j += 1
+                segs.append((i, j, int(s_sorted[i])))
+                i = j
+            out_pos = np.empty(n, np.int64)
+            out_pos[order] = np.arange(n)
+            sched.append((sel_a, sel_b, segs, pt[order].copy()))
+            in_pos = out_pos
+            nd_in = nd_out
+        fin = (in_pos[np.arange(max_delay)] if in_pos is not None
+               else np.arange(max_delay)).astype(np.int32)
+
+        def core(x):
+            nchan, T = x.shape
+            t = jnp.arange(T)
+            d = jnp.arange(nd_init)[:, None]
+            ti = t[None, :] + sgn * d
+            ok = (ti >= 0) & (ti <= T - 1)
+            state = jnp.cumsum(x[:, jnp.clip(ti, 0, T - 1)] * ok[None],
+                               axis=1)
+            state = state.reshape(-1, T)
+            for sel_a, sel_b, segs, pt in sched:
+                a = jnp.take(state, jnp.asarray(sel_a), axis=0)
+                b0 = jnp.take(state, jnp.asarray(sel_b), axis=0)
+                parts = []
+                for (i, j, s) in segs:
+                    seg = b0[i:j]
+                    if s == 0:
+                        parts.append(seg)
+                        continue
+                    r = jnp.roll(seg, -sgn * s, axis=1)
+                    mask = (t <= T - 1 - s) if sgn > 0 else (t >= s)
+                    parts.append(r * mask[None, :])
+                b = jnp.concatenate(parts, axis=0) if len(parts) > 1 \
+                    else parts[0]
+                b = jnp.where(jnp.asarray(pt)[:, None], 0.0, b)
+                state = a + b
+            return jnp.take(state, jnp.asarray(fin), axis=0)
+        return core
+
     def _core_pallas(self, negative_delays, interpret=False):
         """Pallas step pipeline: delay tables in SMEM, subband rows kept
         in VMEM across their delay programs, per-row time shift as a
@@ -221,6 +303,8 @@ class Fdmt(object):
         impl = os.environ.get('BF_FDMT_IMPL', '').strip().lower()
         if impl == 'xla':
             return self._core_jax(negative_delays)
+        if impl == 'rolls':
+            return self._core_jax_rolls(negative_delays)
         if impl == 'pallas':
             return self._core_pallas(negative_delays)
         try:
@@ -230,7 +314,9 @@ class Fdmt(object):
             on_tpu = False
         if on_tpu and _pk.available():
             return self._core_pallas(negative_delays)
-        return self._core_jax(negative_delays)
+        # static-roll core: measured ~20x over the gather core on the
+        # CPU backend (bench config 3 core_compare)
+        return self._core_jax_rolls(negative_delays)
 
     def _core_numpy(self, x, negative_delays=False):
         """Pure-numpy reference core (the test oracle)."""

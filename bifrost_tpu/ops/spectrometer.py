@@ -44,12 +44,22 @@ __all__ = ['fused_spectrometer', 'spectrometer_oracle',
 
 
 def _factor_pow2(n):
-    """n = n1 * n2 with n1, n2 the most square power-of-two split."""
+    """n = n1 * n2 with n1, n2 the most square power-of-two split.
+    BF_SPEC_SPLIT=<n1> overrides for on-chip tuning (the two matmuls
+    contract n1 and n2 respectively; MXU efficiency depends on how
+    the split maps onto the 128-wide systolic array)."""
     import math
+    import os
     if n & (n - 1):
         raise ValueError("fused spectrometer requires power-of-two nfft")
     h = int(math.log2(n))
     n1 = 1 << (h // 2)
+    try:
+        o = int(os.environ.get('BF_SPEC_SPLIT', '0'))
+        if o >= 1 and n % o == 0 and (o & (o - 1)) == 0:
+            n1 = o
+    except ValueError:
+        pass
     return n1, n // n1
 
 
@@ -228,7 +238,10 @@ def spectrometer_accuracy(precision, nfft=4096, rfactor=4):
     for the process lifetime) and return a large finite sentinel so
     artifacts stay strict-JSON."""
     global _last_probe_error
-    key = (precision, nfft, rfactor)
+    # the effective radix split is part of the key: BF_SPEC_SPLIT
+    # changes the contraction/accumulation lengths (and so rounding),
+    # and the gate must probe the shape actually substituted
+    key = (precision, nfft, rfactor) + _factor_pow2(nfft)
     if key in _acc_cache:
         return _acc_cache[key]
     try:

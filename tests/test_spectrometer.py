@@ -160,3 +160,13 @@ def test_matcher_rejects_non_matching_chains(monkeypatch):
     assert match_spectrometer(st, hs, (8, 2, 256, 2), 'int8') is None
     # non-power-of-two nfft never reaches the kernel
     assert match_spectrometer(st, hs, (8, 2, 192, 2), 'int8') is None
+
+
+def test_split_override(monkeypatch):
+    monkeypatch.setenv('BF_SPEC_SPLIT', '128')
+    got, want, rel = _run(T=4, nfft=4096, rfactor=4, time_tile=4)
+    assert rel < 1e-5
+    # invalid overrides fall back to the square split
+    monkeypatch.setenv('BF_SPEC_SPLIT', 'nope')
+    got, want, rel = _run(T=4, nfft=4096, rfactor=4, time_tile=4)
+    assert rel < 1e-5
