@@ -161,27 +161,20 @@ def bench_fdmt(ceil):
     # loop a real dependency chain) — same amortization rationale as
     # measure_ceilings
     K = 8 if jax.default_backend() == 'tpu' else 2
-
-    def body(i, carry):
-        xi = x + (1e-30 * i) + 1e-30 * carry[0, 0]
-        return core(xi)
-
-    fn = jax.jit(lambda c0: lax.fori_loop(0, K, body, c0))
     c0 = core(x)
-    t = _bench_fn(fn, c0, iters=3) / K
+
+    def timed_core(c, iters=2):
+        def body(i, carry):
+            return c(x + (1e-30 * i) + 1e-30 * carry[0, 0])
+        f = jax.jit(lambda s0: lax.fori_loop(0, K, body, s0))
+        return _bench_fn(f, c0, iters=iters) / K
+
+    t = timed_core(core, iters=3)
     nsamples = NCHAN * T
     # Pallas-vs-XLA core comparison on the SAME shapes, so the
     # kernel-speedup claim is a per-round measured artifact rather
     # than CHANGELOG prose (VERDICT r2 item 7)
     core_cmp = {}
-
-    def timed_core(c):
-        # same chained-loop amortization as the headline number, so
-        # the three cores are compared on equal (dispatch-free) terms
-        def b(i, carry):
-            return c(x + (1e-30 * i) + 1e-30 * carry[0, 0])
-        f = jax.jit(lambda s0: lax.fori_loop(0, K, b, s0))
-        return _bench_fn(f, c0, iters=2) / K
 
     try:
         t_x = timed_core(plan._core_jax(False))

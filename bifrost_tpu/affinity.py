@@ -15,7 +15,21 @@ _MBIND_SYSCALL = {'x86_64': 237, 'aarch64': 235}
 _MPOL_BIND = 2
 
 
+def _native_lib():
+    try:
+        from . import native
+        return native.load()
+    except Exception:  # pragma: no cover
+        return None
+
+
 def get_core():
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+        out = ctypes.c_int(-1)
+        if lib.bft_affinity_get_core(ctypes.byref(out)) == 0:
+            return out.value
     try:
         cores = os.sched_getaffinity(0)
         return min(cores) if len(cores) < os.cpu_count() else -1
@@ -24,8 +38,16 @@ def get_core():
 
 
 def set_core(core):
+    """Bind the CALLING THREAD to ``core`` (reference:
+    src/affinity.cpp bfAffinitySetCore is thread-scoped; block threads
+    each pin themselves).  Falls back to process-wide
+    sched_setaffinity where the native library is unavailable."""
     if core is None or core < 0:
         return
+    lib = _native_lib()
+    if lib is not None:
+        if lib.bft_affinity_set_core(int(core)) == 0:
+            return
     try:
         os.sched_setaffinity(0, {core})
     except (AttributeError, OSError):  # pragma: no cover

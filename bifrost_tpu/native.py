@@ -104,6 +104,19 @@ def _declare(lib):
         'bft_ring_overwritten_in': ([c.c_void_p, ll, ll, P(ll)], c.c_int),
         'bft_ring_tail_head': ([c.c_void_p, P(ll), P(ll)], c.c_int),
         'bft_version': ([], c.c_int),
+        # util.cpp: affinity / aligned host memory / ProcLog writer
+        'bft_affinity_set_core': ([c.c_int], c.c_int),
+        'bft_affinity_get_core': ([P(c.c_int)], c.c_int),
+        'bft_malloc': ([P(c.c_void_p), ll], c.c_int),
+        'bft_free': ([c.c_void_p], c.c_int),
+        'bft_memcpy': ([c.c_void_p, c.c_void_p, ll], c.c_int),
+        'bft_memcpy2d': ([c.c_void_p, ll, c.c_void_p, ll, ll, ll],
+                         c.c_int),
+        'bft_memset': ([c.c_void_p, c.c_int, ll], c.c_int),
+        'bft_memset2d': ([c.c_void_p, ll, c.c_int, ll, ll], c.c_int),
+        'bft_proclog_set_base': ([c.c_char_p], c.c_int),
+        'bft_proclog_update': ([c.c_char_p, c.c_char_p, c.c_char_p],
+                               c.c_int),
     }
     for fname, (argtypes, restype) in sigs.items():
         fn = getattr(lib, fname)

@@ -315,8 +315,17 @@ class Fdmt(object):
         if on_tpu and _pk.available():
             return self._core_pallas(negative_delays)
         # static-roll core: measured ~20x over the gather core on the
-        # CPU backend (bench config 3 core_compare)
-        return self._core_jax_rolls(negative_delays)
+        # CPU backend (bench config 3 core_compare).  Its program size
+        # scales with the number of distinct shifts, so huge-max_delay
+        # plans keep the compact gather core to bound compile time.
+        if self._rolls_segments() <= 2048:
+            return self._core_jax_rolls(negative_delays)
+        return self._core_jax(negative_delays)
+
+    def _rolls_segments(self):
+        """Total distinct-shift segments the rolls core would emit."""
+        return sum(len(np.unique(step.d1))
+                   for step in self._plan['steps'])
 
     def _core_numpy(self, x, negative_delays=False):
         """Pure-numpy reference core (the test oracle)."""

@@ -238,10 +238,14 @@ def spectrometer_accuracy(precision, nfft=4096, rfactor=4):
     for the process lifetime) and return a large finite sentinel so
     artifacts stay strict-JSON."""
     global _last_probe_error
-    # the effective radix split is part of the key: BF_SPEC_SPLIT
-    # changes the contraction/accumulation lengths (and so rounding),
-    # and the gate must probe the shape actually substituted
-    key = (precision, nfft, rfactor) + _factor_pow2(nfft)
+    try:
+        # the effective radix split is part of the key: BF_SPEC_SPLIT
+        # changes the contraction/accumulation lengths (and so
+        # rounding) and the gate must probe the shape substituted
+        key = (precision, nfft, rfactor) + _factor_pow2(nfft)
+    except ValueError as e:
+        _last_probe_error = 'ValueError: %s' % e
+        return 1e9
     if key in _acc_cache:
         return _acc_cache[key]
     try:

@@ -1,0 +1,110 @@
+"""Native utility C ABI: affinity, aligned memory, ProcLog writer
+(native/util.cpp; reference surfaces: src/bifrost/affinity.h,
+memory.h, proclog.h)."""
+import ctypes
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from bifrost_tpu import native
+
+
+lib = native.load()
+pytestmark = pytest.mark.skipif(lib is None,
+                                reason='native library unavailable')
+
+
+def test_affinity_thread_scoped():
+    got = {}
+
+    def worker():
+        assert lib.bft_affinity_set_core(0) == 0
+        out = ctypes.c_int(-2)
+        assert lib.bft_affinity_get_core(ctypes.byref(out)) == 0
+        got['worker'] = out.value
+
+    before = os.sched_getaffinity(0)
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert got['worker'] == 0
+    # binding happened on the worker THREAD; the process mask that
+    # other threads inherit is untouched
+    assert os.sched_getaffinity(0) == before
+    assert lib.bft_affinity_set_core(ctypes.c_int(-1)) == 0
+
+
+def test_affinity_python_wrapper_uses_native():
+    from bifrost_tpu import affinity
+    got = {}
+
+    def worker():
+        affinity.set_core(0)
+        got['core'] = affinity.get_core()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert got['core'] == 0
+
+
+def test_malloc_alignment_and_free():
+    p = ctypes.c_void_p()
+    assert lib.bft_malloc(ctypes.byref(p), 4096) == 0
+    assert p.value is not None and p.value % 512 == 0
+    assert lib.bft_memset(p, 0xAB, 4096) == 0
+    buf = (ctypes.c_ubyte * 4096).from_address(p.value)
+    assert bytes(buf[:8]) == b'\xab' * 8
+    assert lib.bft_free(p) == 0
+    # zero-size allocation is OK and returns NULL
+    q = ctypes.c_void_p(1)
+    assert lib.bft_malloc(ctypes.byref(q), 0) == 0
+    assert q.value is None
+    assert lib.bft_malloc(ctypes.byref(q), -1) != 0
+
+
+def test_memcpy_and_2d():
+    src = np.arange(64, dtype=np.uint8)
+    dst = np.zeros(64, dtype=np.uint8)
+    assert lib.bft_memcpy(dst.ctypes.data, src.ctypes.data, 64) == 0
+    np.testing.assert_array_equal(dst, src)
+
+    # strided 2-D copy: 3 rows of 4 bytes out of 8-byte-stride rows
+    s2 = np.arange(24, dtype=np.uint8).reshape(3, 8)
+    d2 = np.zeros((3, 16), dtype=np.uint8)
+    assert lib.bft_memcpy2d(d2.ctypes.data, 16,
+                            s2.ctypes.data, 8, 4, 3) == 0
+    np.testing.assert_array_equal(d2[:, :4], s2[:, :4])
+    assert not d2[:, 4:].any()
+    # width > stride is invalid
+    assert lib.bft_memcpy2d(d2.ctypes.data, 2,
+                            s2.ctypes.data, 8, 4, 3) != 0
+
+    d3 = np.zeros((2, 8), dtype=np.uint8)
+    assert lib.bft_memset2d(d3.ctypes.data, 8, 0x5A, 3, 2) == 0
+    assert (d3[:, :3] == 0x5A).all() and not d3[:, 3:].any()
+
+
+def test_proclog_requires_base():
+    """Runs before any set_base in this process: updating without a
+    base is a BFT_ERR_STATE (-2), not a silent success."""
+    assert lib.bft_proclog_update(b'blk', b'log', b'x : 1\n') == -2
+
+
+def test_proclog_native_writer(tmp_path):
+    assert lib.bft_proclog_set_base(str(tmp_path).encode()) == 0
+    assert lib.bft_proclog_update(b'capture_0', b'stats',
+                                  b'ngood : 42\nnmissing : 1\n') == 0
+    path = os.path.join(str(tmp_path), str(os.getpid()),
+                        'capture_0', 'stats')
+    with open(path) as f:
+        body = f.read()
+    assert 'ngood : 42' in body and 'nmissing : 1' in body
+    # atomic replace: a second update fully replaces the contents
+    assert lib.bft_proclog_update(b'capture_0', b'stats',
+                                  b'ngood : 43\n') == 0
+    with open(path) as f:
+        assert f.read() == 'ngood : 43\n'
+    assert lib.bft_proclog_set_base(b'') != 0
