@@ -456,13 +456,15 @@ def run_suite_into(result):
                       'HBM bandwidth (FFT custom call caps fusion; '
                       'see pallas fused-spectrometer path)')}
     configs['2'] = c2
-    for cid in (1, 3, 4, 5, 6):
+    for cid in (1, 3, 4, 5, 6, 7):
         fn = bench_suite.ALL[cid]
         res = attempt(lambda f=fn, c=cid:
-                      f(ceil) if c in (3, 4, 5) else f())
+                      f(ceil) if c in (3, 4, 5) else
+                      (f(msps_pipe=result['value']) if c == 7 else f()))
         detail['config_%d' % cid] = res
         compact = {}
-        for k in ('config', 'value', 'unit', 'vs_baseline', 'error'):
+        for k in ('config', 'value', 'unit', 'vs_baseline', 'error',
+                  'serial_s', 'pipeline_s', 'reference_bar'):
             if k in res:
                 compact[k] = (round(res[k], 2)
                               if isinstance(res[k], float) else res[k])
