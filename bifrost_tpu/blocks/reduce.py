@@ -50,13 +50,15 @@ def _host_reduce(xr, rax, f, op):
     vector does the same contraction at memory speed (~16x measured),
     so float sum/mean go through matmul and min/max through strided
     accumulation."""
-    if op in ('sum', 'mean') and xr.dtype.kind in 'fc':
+    if op in ('sum', 'mean') and xr.dtype.kind in 'fc' and f <= 512:
+        # gemv accumulates quasi-naively; at huge factors pairwise
+        # np.sum is more accurate, so the fast path is gated on f
         m = np.moveaxis(xr, rax, -1)
         res = m @ np.ones(f, dtype=xr.dtype)
         if op == 'mean':
             res = res / f
         return res
-    if op in ('min', 'max'):
+    if op in ('min', 'max') and f <= 64:
         sl = [slice(None)] * xr.ndim
         sl[rax] = 0
         acc = np.array(xr[tuple(sl)])
@@ -65,7 +67,7 @@ def _host_reduce(xr, rax, f, op):
             sl[rax] = j
             best(acc, xr[tuple(sl)], out=acc)
         return acc
-    fn = {'sum': np.sum, 'mean': np.mean,
+    fn = {'sum': np.sum, 'mean': np.mean, 'min': np.min, 'max': np.max,
           'stderr': lambda a, axis: np.std(a, axis=axis) / np.sqrt(f)
           }[op]
     return fn(xr, axis=rax)

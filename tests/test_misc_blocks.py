@@ -174,3 +174,41 @@ def test_audio_block_with_fake_portaudio(monkeypatch):
     finally:
         pa_mod.set_library(None)
         importlib.reload(audio_blocks)
+
+
+def test_host_transpose_tiled_matches_numpy():
+    from bifrost_tpu.blocks.transpose import _host_transpose
+    rng = np.random.RandomState(9)
+    cases = [
+        ((300, 1, 200), (2, 1, 0)),       # tiled path, odd sizes
+        ((128, 70), (1, 0)),              # tiled, non-divisible tile
+        ((8, 6, 4), (2, 0, 1)),           # 3-D fallback
+        ((5, 7), (1, 0)),                 # small fallback
+        ((64, 1, 64, 1), (2, 1, 0, 3)),   # size-1 axes interleaved
+    ]
+    for shape, axes in cases:
+        src = rng.randn(*shape).astype(np.float32)
+        want = np.transpose(src, axes)
+        out = np.empty_like(want)
+        _host_transpose(out, src, axes)
+        np.testing.assert_array_equal(out, want,
+                                      err_msg=str((shape, axes)))
+
+
+def test_host_reduce_matches_numpy():
+    from bifrost_tpu.blocks.reduce import _host_reduce
+    rng = np.random.RandomState(4)
+    for dtype in (np.float32, np.complex64, np.int32):
+        for shape, rax, f in [((6, 8, 4), 2, 4), ((3, 4, 5), 1, 4),
+                              ((2, 700), 1, 700), ((2, 130, 5), 1, 130)]:
+            x = (rng.randn(*shape) * 100).astype(dtype)
+            for op in ('sum', 'mean', 'min', 'max'):
+                if op in ('min', 'max') and dtype == np.complex64:
+                    continue
+                want = {'sum': np.sum, 'mean': np.mean,
+                        'min': np.min, 'max': np.max}[op](x, axis=rax)
+                got = _host_reduce(x, rax, f if shape[rax] == f
+                                   else shape[rax], op)
+                np.testing.assert_allclose(
+                    got, want, rtol=1e-5, atol=1e-3,
+                    err_msg=str((dtype, shape, rax, op)))
