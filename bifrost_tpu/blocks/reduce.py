@@ -47,9 +47,11 @@ def _host_reduce(xr, rax, f, op):
 
     np.sum over a tiny trailing axis runs at ~150 MB/s (pairwise
     reduction, no SIMD across the stride); a BLAS gemv with a ones
-    vector does the same contraction at memory speed (~16x measured),
-    so float sum/mean go through matmul and min/max through strided
-    accumulation."""
+    vector does the same contraction at memory speed (~16x measured).
+    Float sum/mean go through matmul below the f<=512 accuracy
+    crossover and min/max through strided accumulation below the
+    f<=64 speed crossover; larger factors, stderr, and integer dtypes
+    keep the numpy reductions."""
     if op in ('sum', 'mean') and xr.dtype.kind in 'fc' and f <= 512:
         # gemv accumulates quasi-naively; at huge factors pairwise
         # np.sum is more accurate, so the fast path is gated on f

@@ -8,7 +8,6 @@ from copy import deepcopy
 import numpy as np
 
 from ..pipeline import TransformBlock
-from .. import ops
 
 __all__ = ['TransposeBlock', 'transpose']
 
@@ -57,10 +56,13 @@ def _host_transpose(out, src, axes, tile=64):
     (~4x measured at (8192, 1024) f32).  Non-2D-like permutations fall
     back to the plain copy."""
     view = src.transpose(axes)
-    # locate the 2-D-like case: exactly two non-size-1 axes, swapped
+    # tiles overwrite regions they later read when out aliases src, so
+    # aliased calls take numpy's overlap-buffered assignment instead;
+    # likewise the non-2-D-like and small cases
     big = [i for i, n in enumerate(view.shape) if n > 1]
     if len(big) != 2 or view.shape[big[0]] < tile \
-            or view.shape[big[1]] < tile:
+            or view.shape[big[1]] < tile \
+            or np.shares_memory(out, src):
         out[...] = view
         return
     vt = np.squeeze(view)

@@ -199,16 +199,18 @@ def test_host_reduce_matches_numpy():
     from bifrost_tpu.blocks.reduce import _host_reduce
     rng = np.random.RandomState(4)
     for dtype in (np.float32, np.complex64, np.int32):
-        for shape, rax, f in [((6, 8, 4), 2, 4), ((3, 4, 5), 1, 4),
-                              ((2, 700), 1, 700), ((2, 130, 5), 1, 130)]:
+        # shapes follow ReduceBlock's call convention: rax is an
+        # inserted axis whose FULL length is the factor
+        for shape, rax in [((6, 8, 4), 2), ((3, 4, 5), 1),
+                           ((2, 700), 1), ((2, 130, 5), 1)]:
             x = (rng.randn(*shape) * 100).astype(dtype)
+            f = shape[rax]
             for op in ('sum', 'mean', 'min', 'max'):
                 if op in ('min', 'max') and dtype == np.complex64:
                     continue
                 want = {'sum': np.sum, 'mean': np.mean,
                         'min': np.min, 'max': np.max}[op](x, axis=rax)
-                got = _host_reduce(x, rax, f if shape[rax] == f
-                                   else shape[rax], op)
+                got = _host_reduce(x, rax, f, op)
                 np.testing.assert_allclose(
                     got, want, rtol=1e-5, atol=1e-3,
                     err_msg=str((dtype, shape, rax, op)))

@@ -68,17 +68,41 @@ def test_rejects_rfactor_beyond_radix():
                            interpret=True)
 
 
+def _run_fused_ci8_chain(raw, rfactor=4, mesh=None):
+    """Build the ci8 fused FFT->stokes->reduce pipeline the two
+    substitution tests share and return the gathered output."""
+    import bifrost_tpu as bf
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from util import NumpySourceBlock, GatherSink, simple_header
+    import contextlib
+    T, _, NF = raw.shape
+    hdr = simple_header([-1, 2, NF], 'ci8',
+                        labels=['time', 'pol', 'fine_time'])
+    scope = bf.block_scope(mesh=mesh) if mesh is not None \
+        else contextlib.nullcontext()
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock([raw], hdr, gulp_nframe=T)
+        with scope:
+            b = bf.blocks.copy(src, space='tpu')
+            b = bf.blocks.fused(b, [
+                FftStage('fine_time', axis_labels='freq'),
+                DetectStage('stokes', axis='pol'),
+                ReduceStage('freq', rfactor),
+            ])
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    return sink.result()
+
+
 def test_fused_block_substitutes_kernel(monkeypatch):
     """The FusedBlock spectrometer pattern-match swaps in the Pallas
     kernel (interpret mode here) and the pipeline output still matches
     the oracle."""
-    import bifrost_tpu as bf
     from bifrost_tpu.ops import spectrometer as spec
-    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
     from bifrost_tpu.dtype import ci8 as ci8_dtype
-    import sys, os
-    sys.path.insert(0, os.path.dirname(__file__))
-    from util import NumpySourceBlock, GatherSink, simple_header
 
     calls = []
     real = spec.fused_spectrometer
@@ -96,21 +120,8 @@ def test_fused_block_substitutes_kernel(monkeypatch):
     raw = np.zeros((T, 2, NF), dtype=ci8_dtype)
     raw['re'] = rng.randint(-32, 32, size=(T, 2, NF))
     raw['im'] = rng.randint(-32, 32, size=(T, 2, NF))
-    with bf.Pipeline() as p:
-        hdr = simple_header([-1, 2, NF], 'ci8',
-                            labels=['time', 'pol', 'fine_time'])
-        src = NumpySourceBlock([raw], hdr, gulp_nframe=T)
-        b = bf.blocks.copy(src, space='tpu')
-        b = bf.blocks.fused(b, [
-            FftStage('fine_time', axis_labels='freq'),
-            DetectStage('stokes', axis='pol'),
-            ReduceStage('freq', RF),
-        ])
-        b = bf.blocks.copy(b, space='system')
-        sink = GatherSink(b)
-        p.run()
+    out = _run_fused_ci8_chain(raw, rfactor=RF)
     assert calls, "pattern matcher did not substitute the kernel"
-    out = sink.result()
     volt = np.stack([raw['re'], raw['im']], axis=-1).astype(np.int8)
     want = spectrometer_oracle(volt, rfactor=RF)
     rel = np.max(np.abs(out - want)) / np.max(np.abs(want))
@@ -170,3 +181,30 @@ def test_split_override(monkeypatch):
     monkeypatch.setenv('BF_SPEC_SPLIT', 'nope')
     got, want, rel = _run(T=4, nfft=4096, rfactor=4, time_tile=4)
     assert rel < 1e-5
+
+
+def test_mesh_scope_keeps_xla_path(monkeypatch):
+    """Under BlockScope(mesh=...) the FusedBlock does NOT substitute
+    the Pallas kernel (GSPMD shards the XLA chain instead)."""
+    from bifrost_tpu.ops import spectrometer as spec
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    from bifrost_tpu.parallel.mesh import create_mesh
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip('needs the 8-device virtual mesh')
+
+    called = []
+    monkeypatch.setattr(spec, 'choose_precision',
+                        lambda *a, **k: called.append(1) or None)
+    T, NF = 8, 256
+    rng = np.random.RandomState(6)
+    raw = np.zeros((T, 2, NF), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-8, 8, size=(T, 2, NF))
+    raw['im'] = rng.randint(-8, 8, size=(T, 2, NF))
+    out = _run_fused_ci8_chain(raw, rfactor=4,
+                               mesh=create_mesh({'sp': 8}))
+    assert not called, "matcher must not be consulted under a mesh"
+    volt = np.stack([raw['re'], raw['im']], axis=-1).astype(np.int8)
+    want = spectrometer_oracle(volt, rfactor=4)
+    assert np.max(np.abs(out - want)) / np.max(np.abs(want)) < 1e-4
