@@ -49,8 +49,31 @@ A100_BASELINE_MSPS = 28000.0
 # UNFUSED cuFFT chain on the A100 and is used only for vs_baseline.)
 CHAIN_BYTES_PER_SAMPLE = 36.0
 # ... and of the fused Pallas spectrometer kernel: ci8 read (2 B) +
-# reduced Stokes f32 write (2 B); nothing else leaves VMEM.
+# reduced Stokes f32 write (2 B); nothing else leaves VMEM.  The
+# BF_SPEC_TRANSPOSE=epilogue variant adds an XLA reorder of the
+# reduced output (+4 B).
 CHAIN_BYTES_PER_SAMPLE_PALLAS = 4.0
+CHAIN_BYTES_PER_SAMPLE_PALLAS_EPI = 8.0
+
+
+def flagship_header():
+    """The flagship gulp's ring header (shared by the bench pipeline
+    and the roofline probe so the two can never drift apart)."""
+    return {'name': 'bench', 'time_tag': 0,
+            '_tensor': {'shape': [-1, NPOL, NFINE],
+                        'dtype': 'ci8',
+                        'labels': ['time', 'pol', 'fine_time'],
+                        'scales': [[0, 1]] * 3,
+                        'units': [None] * 3}}
+
+
+def flagship_stages():
+    """The flagship FFT->detect->reduce stage chain (single source of
+    truth for build_and_run and flagship_chain_info)."""
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    return [FftStage('fine_time', axis_labels='freq'),
+            DetectStage('stokes', axis='pol'),
+            ReduceStage('freq', RFACTOR)]
 
 
 def flagship_chain_info():
@@ -59,12 +82,27 @@ def flagship_chain_info():
     must use the traffic model of the path that executed, not the XLA
     chain's."""
     try:
+        from bifrost_tpu.stages import match_spectrometer
+        stages = flagship_stages()
+        hdr = flagship_header()
+        headers = [hdr]
+        h = hdr
+        for s in stages:
+            h = s.transform_header(h)
+            headers.append(h)
+        fn = match_spectrometer(stages, headers,
+                                (NTIME, NPOL, NFINE, 2), 'int8')
+    except Exception:
+        fn = None
+    if fn is not None:
         from bifrost_tpu.ops.spectrometer import choose_precision
         prec = choose_precision(NFINE, RFACTOR)
-    except Exception:
-        prec = 'off'
-    if prec != 'off':
-        label = 'pallas-spectrometer[%s]' % (prec or 'default')
+        trans = os.environ.get('BF_SPEC_TRANSPOSE',
+                               'kernel').strip().lower()
+        label = 'pallas-spectrometer[%s,%s]' % (prec or 'default',
+                                                trans)
+        if trans == 'epilogue':
+            return CHAIN_BYTES_PER_SAMPLE_PALLAS_EPI, label
         return CHAIN_BYTES_PER_SAMPLE_PALLAS, label
     return CHAIN_BYTES_PER_SAMPLE, 'xla-fused'
 
@@ -90,7 +128,6 @@ def build_and_run():
     import bifrost_tpu as bf
     bf.enable_compilation_cache()    # reuse XLA programs across runs
     from bifrost_tpu.pipeline import SourceBlock, SinkBlock
-    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
 
     class VoltageSource(SourceBlock):
         """Emits device-resident ci8 voltage gulps (device rep: int8
@@ -118,12 +155,7 @@ def build_and_run():
 
         def on_sequence(self, reader, name):
             self.count = 0
-            return [{'name': 'bench', 'time_tag': 0,
-                     '_tensor': {'shape': [-1, NPOL, NFINE],
-                                 'dtype': 'ci8',
-                                 'labels': ['time', 'pol', 'fine_time'],
-                                 'scales': [[0, 1]] * 3,
-                                 'units': [None] * 3}}]
+            return [flagship_header()]
 
         def on_data(self, reader, ospans):
             if self.count >= self.ngulp:
@@ -160,11 +192,7 @@ def build_and_run():
         src = VoltageSource(NGULP_WARM + NGULP_BENCH)
         # the whole FFT->detect->reduce chain fuses into ONE XLA
         # computation per gulp (blocks/fused.py)
-        b = bf.blocks.fused(src, [
-            FftStage('fine_time', axis_labels='freq'),
-            DetectStage('stokes', axis='pol'),
-            ReduceStage('freq', RFACTOR),
-        ])
+        b = bf.blocks.fused(src, flagship_stages())
         sink = SpectraSink(b)
         p.run()
     if sink.elapsed is None:
@@ -369,34 +397,80 @@ def bench_spectrometer_kernel():
                       size=(T, NPOL, NFINE, 2)).astype(np.int8)
     xb = jnp.asarray(big)
     n = T * NPOL * NFINE
-    for prec, name in ((None, 'default'), ('highest', 'highest')):
+    for prec, name in ((None, 'default'), ('high', 'high'),
+                       ('highest', 'highest')):
         entry = {'rel_err': spectrometer_accuracy(prec, NFINE, RFACTOR)}
         if entry['rel_err'] >= 1e9:
             from bifrost_tpu.ops import spectrometer as _sp
             entry['probe_error'] = _sp._last_probe_error
         best = None
-        for tile in (16, 32, 64):
-            try:
-                f = jax.jit(lambda v, p=prec, t=tile: fused_spectrometer(
-                    v, rfactor=RFACTOR, time_tile=t, precision=p))
-                _force(f(xb))
-                t0 = time.perf_counter()
-                iters = 8
-                for _ in range(iters):
-                    y = f(xb)
-                _force(y)
-                msps = n * iters / (time.perf_counter() - t0) / 1e6
-                if best is None or msps > best[1]:
-                    best = (tile, msps)
-            except Exception as e:
-                entry.setdefault('tile_errors', {})[tile] = \
-                    '%s: %s' % (type(e).__name__, str(e)[:120])
+        for tile in (8, 16):
+            for trans in ('kernel', 'epilogue'):
+                try:
+                    f = jax.jit(
+                        lambda v, p=prec, t=tile, m=trans:
+                        fused_spectrometer(v, rfactor=RFACTOR,
+                                           time_tile=t, precision=p,
+                                           transpose=m))
+                    _force(f(xb))
+                    t0 = time.perf_counter()
+                    iters = 8
+                    for _ in range(iters):
+                        y = f(xb)
+                    _force(y)
+                    msps = n * iters / (time.perf_counter() - t0) / 1e6
+                    if best is None or msps > best[2]:
+                        best = (tile, trans, msps)
+                except Exception as e:
+                    entry.setdefault('tile_errors', {})[
+                        '%d/%s' % (tile, trans)] = \
+                        '%s: %s' % (type(e).__name__, str(e)[:120])
         if best:
             entry['best_tile'] = best[0]
-            entry['msps'] = round(best[1], 1)
-            entry['vs_baseline'] = round(best[1] / A100_BASELINE_MSPS, 4)
+            entry['best_transpose'] = best[1]
+            entry['msps'] = round(best[2], 1)
+            entry['vs_baseline'] = round(best[2] / A100_BASELINE_MSPS, 4)
         out[name] = entry
     return out
+
+
+def _run_isolated(argv, timeout=900):
+    """Run a bench entrypoint in a FRESH subprocess and parse the last
+    JSON line of its stdout.  Isolation matters on the tunneled
+    backend: one op hitting UNIMPLEMENTED poisons every subsequent op
+    in the process (this is what zeroed configs 4/5/7 + fft_impl in an
+    earlier r3 run), so each config gets its own backend."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        p = subprocess.run([sys.executable] + argv, cwd=here,
+                           capture_output=True, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {'error': 'subprocess timeout after %ds' % timeout}
+    line = None
+    for ln in (p.stdout or '').splitlines():
+        ln = ln.strip()
+        # skip preamble lines (e.g. bench_suite's chip_ceilings echo):
+        # a crash between the preamble and the result must not record
+        # the preamble as the config's result
+        if ln.startswith('{') and '"chip_ceilings"' not in ln:
+            line = ln
+    if line is None or p.returncode != 0:
+        err = 'rc=%d, stderr: %s' % (
+            p.returncode, (p.stderr or '')[-200:].replace('\n', ' '))
+        if line is None:
+            return {'error': 'no JSON output (%s)' % err}
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            return {'error': 'unparseable output: %s' % line[:200]}
+        parsed.setdefault('error', 'subprocess failed (%s)' % err)
+        return parsed
+    try:
+        return json.loads(line)
+    except ValueError:
+        return {'error': 'unparseable output: %s' % line[:200]}
 
 
 def run_suite_into(result):
@@ -456,11 +530,18 @@ def run_suite_into(result):
                       'HBM bandwidth (FFT custom call caps fusion; '
                       'see pallas fused-spectrometer path)')}
     configs['2'] = c2
+    ceil_f = {k: v for k, v in ceil.items() if isinstance(v, float)}
     for cid in (1, 3, 4, 5, 6, 7):
-        fn = bench_suite.ALL[cid]
-        res = attempt(lambda f=fn, c=cid:
-                      f(ceil) if c in (3, 4, 5) else
-                      (f(msps_pipe=result['value']) if c == 7 else f()))
+        argv = ['bench_suite.py', '--config', str(cid)]
+        if cid in (3, 4, 5) and ceil_f:
+            # pass ceilings only when actually measured — an empty
+            # dict would stop the fresh subprocess from measuring its
+            # own after a parent-process backend failure
+            argv += ['--ceil-json', json.dumps(ceil_f)]
+        if cid == 7:
+            argv += ['--msps-pipe', str(result['value'])]
+        res = _run_isolated(argv)
+        res.pop('config_id', None)
         detail['config_%d' % cid] = res
         compact = {}
         for k in ('config', 'value', 'unit', 'vs_baseline', 'error',
@@ -479,11 +560,11 @@ def run_suite_into(result):
         configs[str(cid)] = compact
     result['configs'] = configs
 
-    fft_cmp = attempt(bench_fft_impls)
+    fft_cmp = _run_isolated(['bench.py', '--fft-impl'])
     result['fft_impl'] = fft_cmp
     detail['fft_impl'] = fft_cmp
 
-    spec = attempt(bench_spectrometer_kernel)
+    spec = _run_isolated(['bench.py', '--spectrometer'])
     result['spectrometer'] = spec
     detail['spectrometer'] = spec
 
@@ -509,6 +590,12 @@ def main():
         res = run_correctness_gate()
         print(json.dumps(res))
         return 0 if res['ok'] else 1
+    if '--fft-impl' in sys.argv:
+        print(json.dumps(bench_fft_impls()))
+        return 0
+    if '--spectrometer' in sys.argv:
+        print(json.dumps(bench_spectrometer_kernel()))
+        return 0
     msps = build_and_run()
     import jax
     result = {

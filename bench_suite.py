@@ -548,6 +548,13 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
                     help='config number 1-6; 0 = all')
+    ap.add_argument('--ceil-json', default=None,
+                    help='pre-measured chip ceilings as a JSON object '
+                         '(skips the in-process ceiling probes; used '
+                         'by bench.py to run each config in an '
+                         'isolated subprocess)')
+    ap.add_argument('--msps-pipe', type=float, default=None,
+                    help='flagship pipeline Msamples/s for config 7')
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
     need_dev = any(c in (2, 3, 4, 5) for c in todo)
@@ -564,14 +571,22 @@ def main(argv=None):
     if need_dev:
         import bifrost_tpu as _bf
         _bf.enable_compilation_cache()
-    ceil = measure_ceilings() if need_dev else {}
+    if args.ceil_json:
+        ceil = json.loads(args.ceil_json)
+    else:
+        ceil = measure_ceilings() if need_dev else {}
     if ceil:
         print(json.dumps({'chip_ceilings': {
             k: round(v, 2) for k, v in ceil.items()}}))
     for c in todo:
         fn = ALL[c]
         try:
-            res = fn(ceil) if c in (2, 3, 4, 5) else fn()
+            if c in (2, 3, 4, 5):
+                res = fn(ceil)
+            elif c == 7 and args.msps_pipe:
+                res = fn(msps_pipe=args.msps_pipe)
+            else:
+                res = fn()
         except Exception as e:
             res = {'config': 'config %d' % c, 'error':
                    '%s: %s' % (type(e).__name__, e)}

@@ -6,8 +6,8 @@ This is the TPU answer to the reference's flagship GPU pipeline
 blocks/detect.py + src/reduce.cu as three separate kernels with HBM
 round-trips between them, mitigated there by cuFFT load callbacks,
 src/fft_kernels.cu CallbackData).  On TPU the XLA FFT is an opaque
-custom call, so the fused chain still moves ~36 B/sample through HBM
-(ci8 read + c64 unpack write + FFT read/write + detect read + f32
+custom call, so the fused XLA chain still moves ~36 B/sample through
+HBM (ci8 read + c64 unpack write + FFT read/write + detect read + f32
 write).  This kernel keeps the whole chain in VMEM and touches HBM for
 exactly the ci8 input (2 B/sample) and the reduced Stokes output
 (~2 B/sample).
@@ -18,12 +18,30 @@ ops/fft.py:dft_matmul_fft), with the DFT factor matrices resident in
 VMEM:
 
     x[p, q]   (p slow, q fast; n = N2*p + q)
-    y[r, q]   = sum_p x[p, q] * exp(-2pi i p r / N1)     (matmul 1)
-    y[r, q]  *= exp(-2pi i q r / N)                      (twiddle)
-    X[N1*s+r] = sum_q y[r, q] * exp(-2pi i q s / N2)     (matmul 2)
+    y[q, r]   = sum_p x[p, q] * exp(-2pi i p r / N1)     (matmul 1)
+    y[q, r]  *= exp(-2pi i q r / N)                      (twiddle)
+    X[N1*s+r] = sum_q y[q, r] * exp(-2pi i q s / N2)     (matmul 2)
 
-Stokes (blocks/detect.py math) and the frequency reduction then happen
-on the VPU while the data is still in VMEM.
+MOSAIC SHAPE DISCIPLINE (measured on the target backend, not guessed):
+the TPU vector layout rejects reshapes that split the minor (lane)
+dimension into small factors and rejects 3-D ``swapaxes``, but supports
+(a) reshapes whose new minor dimension is lane-native (a multiple of
+128), (b) ``dot_general`` contracting the MIDDLE dimension of a 3-D
+operand (which is how both FFT steps avoid materializing a transpose),
+(c) 2-D transposes, and (d) int16 loads with shift arithmetic.  The
+kernel is built strictly from that set:
+
+- ci8 re/im pairs enter as one int16 per complex sample (an XLA
+  bitcast, free) and are split with sign-extending shifts in-kernel;
+- both FFT matmuls are ``dot_general`` with contracting dim 1, so the
+  data never transposes between steps;
+- the frequency reduce groups the fast output index r (a SUBLANE
+  reshape + sum, exact f32 on the VPU);
+- the one unavoidable Bailey-transpose (the 4-step FFT's output index
+  order k = N1*s + r vs the natural s-major flattening) is either a
+  loop of supported 2-D transposes in-kernel (default: output HBM
+  traffic stays ~2 B/sample) or a cheap XLA epilogue transpose of the
+  REDUCED output (BF_SPEC_TRANSPOSE=epilogue; adds ~4 B/sample).
 
 Complex matmuls use the 3-real-matmul (Karatsuba) decomposition:
     RE = Ar Br - Ai Bi
@@ -43,38 +61,57 @@ __all__ = ['fused_spectrometer', 'spectrometer_oracle',
            'spectrometer_mode']
 
 
-def _factor_pow2(n):
-    """n = n1 * n2 with n1, n2 the most square power-of-two split.
-    BF_SPEC_SPLIT=<n1> overrides for on-chip tuning (the two matmuls
-    contract n1 and n2 respectively; MXU efficiency depends on how
-    the split maps onto the 128-wide systolic array)."""
+def _choose_split(n, rfactor):
+    """n = n1 * n2 for the 4-step factorization.
+
+    Preferred: lane-native n2 (a multiple of 128, the TPU vector lane
+    count) so the in-kernel reshape (rows, n) -> (rows, n1, n2) keeps
+    the minor dimension register-shaped — the only split Mosaic
+    compiles.  Fallback (interpret mode / CPU tests): the most-square
+    power-of-two split.  BF_SPEC_SPLIT=<n1> overrides when valid.
+
+    Raises ValueError when no split supports ``rfactor`` (the caller
+    surfaces this; the XLA chain handles such shapes instead).
+    """
     import math
     import os
-    if n & (n - 1):
+    if n & (n - 1) or n < 4:
         raise ValueError("fused spectrometer requires power-of-two nfft")
-    h = int(math.log2(n))
-    n1 = 1 << (h // 2)
     try:
         o = int(os.environ.get('BF_SPEC_SPLIT', '0'))
-        if o >= 1 and n % o == 0 and (o & (o - 1)) == 0:
-            n1 = o
     except ValueError:
-        pass
+        o = 0
+    if (o >= 1 and n % o == 0 and (o & (o - 1)) == 0
+            and o % rfactor == 0):
+        return o, n // o
+    # lane-native: largest n1 <= 128 with n2 % 128 == 0
+    n1 = n // 128
+    while n1 > 128:
+        n1 //= 2
+    if n1 >= 1 and n1 % rfactor == 0:
+        return n1, n // n1
+    # square fallback (compiles under interpret; the on-chip accuracy
+    # gate rejects it for real Mosaic lowering)
+    h = int(math.log2(n))
+    n1 = 1 << (h // 2)
+    if n1 % rfactor:
+        raise ValueError(
+            "rfactor must divide the radix split n1=%d" % n1)
     return n1, n // n1
 
 
 @functools.lru_cache(maxsize=8)
 def _dft_consts(n1, n2):
-    """(f1, twT, f2) factor matrices as (re, im) float32 pairs.
+    """(f1, tw, f2) factor matrices as (re, im) float32 pairs.
 
     f1[p, r] = exp(-2pi i p r / n1)        contraction over p (step 1)
-    tw[r, q] = exp(-2pi i q r / (n1 n2))   twiddle
+    tw[q, r] = exp(-2pi i q r / (n1 n2))   twiddle
     f2[q, s] = exp(-2pi i q s / n2)        contraction over q (step 2)
     """
     w1 = np.exp(-2j * np.pi *
                 np.outer(np.arange(n1), np.arange(n1)) / n1)
     tw = np.exp(-2j * np.pi *
-                np.outer(np.arange(n1), np.arange(n2)) / (n1 * n2))
+                np.outer(np.arange(n2), np.arange(n1)) / (n1 * n2))
     w2 = np.exp(-2j * np.pi *
                 np.outer(np.arange(n2), np.arange(n2)) / n2)
     pack = lambda m: (np.ascontiguousarray(m.real, np.float32),
@@ -82,39 +119,122 @@ def _dft_consts(n1, n2):
     return pack(w1), pack(tw), pack(w2)
 
 
-def _cmatmul3(ar, ai, br, bi, dot):
-    """Karatsuba complex matmul on real planes: 3 MXU passes."""
-    rr = dot(ar, br)
-    ii = dot(ai, bi)
-    ss = dot(ar + ai, br + bi)
-    return rr - ii, ss - rr - ii
+def _split_bf16(m):
+    """m (f32) as a (hi, lo) bf16 pair with hi + lo ~ m to ~2^-18."""
+    import ml_dtypes
+    hi = m.astype(ml_dtypes.bfloat16)
+    lo = (m - hi.astype(np.float32)).astype(ml_dtypes.bfloat16)
+    return hi, lo
 
 
-def _kernel(n1, n2, rfactor, dot, v_ref, f1r_ref, f1i_ref, twr_ref,
-            twi_ref, f2r_ref, f2i_ref, o_ref):
+@functools.lru_cache(maxsize=8)
+def _kernel_consts(n1, n2, mode):
+    """Host-built factor matrices for the kernel, keyed by precision
+    mode.  When 3*n1 <= 128 the three step-1 Karatsuba products ride
+    ONE padded MXU pass via a block-diagonal factor
+    blockdiag(F1r, F1i, F1r+F1i); 'high' mode carries every factor as
+    a bf16 (hi, lo) pair for the manual 3-pass split."""
+    (f1r, f1i), (twr, twi), (f2r, f2i) = _dft_consts(n1, n2)
+    c = {'twr': twr, 'twi': twi}
+    f1s = f1r + f1i
+    f2s = f2r + f2i
+    use_bd = 3 * n1 <= 128
+    if use_bd:
+        z = np.zeros((n1, n1), np.float32)
+        bd1 = np.block([[f1r, z, z], [z, f1i, z], [z, z, f1s]])
+        step1 = {'bd1': bd1}
+    else:
+        step1 = {'f1r': f1r, 'f1i': f1i, 'f1s': f1s}
+    step2 = {'f2r': f2r, 'f2i': f2i, 'f2s': f2s}
+    if mode == 'high':
+        for d in (step1, step2):
+            for k in list(d):
+                d[k + 'h'], d[k + 'l'] = _split_bf16(d.pop(k))
+    c.update(step1)
+    c.update(step2)
+    return c, use_bd
+
+
+def _kernel(n1, n2, rfactor, mode, kernel_transpose, names, use_bd,
+            v_ref, *refs):
+    import jax
     import jax.numpy as jnp
-    n = n1 * n2
+    o_ref = refs[-1]
     rows = v_ref.shape[0]           # 2 * time_tile (x,y pol interleaved)
     tt = rows // 2
-    v = v_ref[...].astype(jnp.float32)          # (rows, 2n) re/im pairs
-    v = v.reshape(rows, n, 2)
-    re = v[:, :, 0].reshape(rows, n1, n2)       # p slow, q fast
-    im = v[:, :, 1].reshape(rows, n1, n2)
-    # ---- step 1: contract p.  q-major view: (rows*n2, n1) @ (n1, n1)
-    reT = jnp.swapaxes(re, 1, 2).reshape(rows * n2, n1)
-    imT = jnp.swapaxes(im, 1, 2).reshape(rows * n2, n1)
-    yr, yi = _cmatmul3(reT, imT, f1r_ref[...], f1i_ref[...], dot)
-    # ---- twiddle: y[q, r] *= twT[q, r]
-    twr = jnp.swapaxes(twr_ref[...], 0, 1).reshape(1, n2, n1)
-    twi = jnp.swapaxes(twi_ref[...], 0, 1).reshape(1, n2, n1)
-    yr = yr.reshape(rows, n2, n1)
-    yi = yi.reshape(rows, n2, n1)
-    yr, yi = yr * twr - yi * twi, yr * twi + yi * twr
-    # ---- step 2: contract q.  r-major view: (rows*n1, n2) @ (n2, n2)
-    yr = jnp.swapaxes(yr, 1, 2).reshape(rows * n1, n2)
-    yi = jnp.swapaxes(yi, 1, 2).reshape(rows * n1, n2)
-    zr, zi = _cmatmul3(yr, yi, f2r_ref[...], f2i_ref[...], dot)
-    # z[r, s]: freq k = n1*s + r
+    j = n1 // rfactor
+    C = {k: r[...] for k, r in zip(names, refs[:-1])}
+
+    # middle-dim contraction: (rows, K, M) x (K, N) -> (rows, M, N)
+    dn = (((1,), (0,)), ((), ()))
+    hp = jax.lax.Precision.HIGHEST if mode == 'highest' else None
+
+    def dot(a, b):
+        return jax.lax.dot_general(a, b, dn, precision=hp,
+                                   preferred_element_type=jnp.float32)
+
+    def split(x):
+        """f32 -> (hi, lo) bf16 planes for the manual 3-pass split."""
+        h = x.astype(jnp.bfloat16)
+        l = (x - h.astype(jnp.float32)).astype(jnp.bfloat16)
+        return h, l
+
+    def cmm(ar, ai, nm):
+        """Karatsuba complex matmul against factor ``nm``: three real
+        products rr = ar@Br, ii = ai@Bi, ss = (ar+ai)@(Br+Bi).
+        'high' runs each as hi/lo bf16 passes (dropping the lo*lo
+        term, ~2^-18 relative).
+        """
+        a_s = ar + ai
+        if mode != 'high':
+            rr = dot(ar, C[nm + 'r'])
+            ii = dot(ai, C[nm + 'i'])
+            ss = dot(a_s, C[nm + 's'])
+        else:
+            out = []
+            for a, suf in ((ar, 'r'), (ai, 'i'), (a_s, 's')):
+                bh, bl = C[nm + suf + 'h'], C[nm + suf + 'l']
+                ah, al = split(a)
+                out.append(dot(ah, bh) + dot(ah, bl) + dot(al, bh))
+            rr, ii, ss = out
+        return rr - ii, ss - rr - ii
+
+    # ---- unpack: one int16 per complex sample; low byte = re,
+    # high byte = im (little-endian bitcast, verified on-device)
+    v32 = v_ref[...].astype(jnp.int32)
+    re = ((v32 << 24) >> 24).astype(jnp.float32).reshape(rows, n1, n2)
+    im = (v32 >> 8).astype(jnp.float32).reshape(rows, n1, n2)
+    # ---- step 1: contract p (dim 1) -> y[row, q, r].  int8 voltages
+    # (and their pairwise sums) are EXACT in bf16, so 'high' needs only
+    # the factor-side split (2 passes)
+    if use_bd:
+        acat = jnp.concatenate([re, im, re + im], axis=1)
+        if mode == 'high':
+            ab = acat.astype(jnp.bfloat16)
+            y = dot(ab, C['bd1h']) + dot(ab, C['bd1l'])
+        else:
+            y = dot(acat, C['bd1'])
+        rr = y[..., :n1]
+        ii = y[..., n1:2 * n1]
+        ss = y[..., 2 * n1:]
+        yr, yi = rr - ii, ss - rr - ii
+    elif mode == 'high':
+        out = []
+        for a, suf in ((re, 'r'), (im, 'i'), (re + im, 's')):
+            ab = a.astype(jnp.bfloat16)     # exact: int8-valued
+            out.append(dot(ab, C['f1' + suf + 'h']) +
+                       dot(ab, C['f1' + suf + 'l']))
+        rr, ii, ss = out
+        yr, yi = rr - ii, ss - rr - ii
+    else:
+        yr, yi = cmm(re, im, 'f1')
+    # ---- twiddle: y[row, q, r] *= tw[q, r]
+    twr = C['twr'][None]
+    twi = C['twi'][None]
+    tr = yr * twr - yi * twi
+    ti = yr * twi + yi * twr
+    # ---- step 2: contract q (dim 1) -> z[row, r, s]; freq k = n1*s + r
+    zr, zi = cmm(tr, ti, 'f2')
     zr = zr.reshape(tt, 2, n1, n2)
     zi = zi.reshape(tt, 2, n1, n2)
     xr_, yr_ = zr[:, 0], zr[:, 1]
@@ -122,23 +242,26 @@ def _kernel(n1, n2, rfactor, dot, v_ref, f1r_ref, f1i_ref, twr_ref,
     # ---- Stokes (blocks/detect.py): I, Q, U, V
     xx = xr_ * xr_ + xi_ * xi_
     yy = yr_ * yr_ + yi_ * yi_
-    # x * conj(y)
-    xyr = xr_ * yr_ + xi_ * yi_
+    xyr = xr_ * yr_ + xi_ * yi_       # x * conj(y)
     xyi = xi_ * yr_ - xr_ * yi_
-    stokes = (xx + yy, xx - yy, 2.0 * xyr, -2.0 * xyi)
-    # ---- reduce freq by rfactor: k = n1*s + r -> groups share s, with
-    # r in [f*rfactor, ...); output bin f' = (n1//rfactor)*s + j
-    j = n1 // rfactor
-    outs = []
-    for plane in stokes:
-        red = plane.reshape(tt, j, rfactor, n2).sum(axis=2)  # (tt, j, s)
-        red = jnp.swapaxes(red, 1, 2)                        # (tt, s, j)
-        outs.append(red.reshape(tt, j * n2))
-    o_ref[...] = jnp.concatenate(outs, axis=-1)   # (tt, 4 * n // rf)
+    planes = (xx + yy, xx - yy, 2.0 * xyr, -2.0 * xyi)
+    # ---- reduce freq by rfactor.  k = n1*s + r and rfactor | n1, so
+    # groups are r-subgroups at fixed s: a SUBLANE reshape + exact f32
+    # VPU sum.  Natural output bin g = (n1//rfactor)*s + j needs
+    # (tt, j, s) -> (tt, s, j): statically-unrolled 2-D transposes
+    # (Mosaic supports 2-D transpose but not 3-D swapaxes).
+    for k, plane in enumerate(planes):
+        red = plane.reshape(tt, j, rfactor, n2).sum(axis=2)  # (tt,j,s)
+        if kernel_transpose:
+            for t in range(tt):
+                o_ref[t, k] = red[t].T
+        else:
+            o_ref[:, k] = red                   # j-major; XLA reorders
 
 
 def fused_spectrometer(volt, nfft=None, rfactor=4, time_tile=32,
-                       precision=None, interpret=False):
+                       precision=None, interpret=False,
+                       transpose='auto'):
     """ci8 dual-pol voltages -> reduced Stokes spectra, one kernel.
 
     volt: (T, 2, nfft, 2) int8 — (time, pol, fine_time, re/im), the
@@ -147,11 +270,17 @@ def fused_spectrometer(volt, nfft=None, rfactor=4, time_tile=32,
     identical semantics to the fused stage chain
     FftStage -> DetectStage('stokes') -> ReduceStage('freq', rfactor).
 
-    precision: None (backend default: one bf16 MXU pass per matmul —
-    int8 inputs fit bf16's 8-bit mantissa exactly, so the dominant
-    error is accumulation rounding) or 'highest' (multi-pass f32-
-    equivalent MXU arithmetic, ~3x the MXU cycles).
+    precision: None (backend default: one bf16 MXU pass per matmul),
+    'high' (3-pass bf16, ~f32 accuracy), or 'highest' (6-pass, full
+    f32).  The auto mode (choose_precision) picks the cheapest one
+    that passes the f32 accuracy gate on the actual backend.
+
+    transpose: 'kernel' (Bailey reorder as in-kernel 2-D transposes;
+    output HBM traffic stays ~2 B/sample), 'epilogue' (XLA transpose
+    of the reduced output; ~4 B/sample extra HBM but no in-kernel
+    loop), or 'auto' (BF_SPEC_TRANSPOSE env, default 'kernel').
     """
+    import os
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -165,42 +294,54 @@ def fused_spectrometer(volt, nfft=None, rfactor=4, time_tile=32,
         raise ValueError("nfft mismatch")
     if nfft % rfactor:
         raise ValueError("rfactor must divide nfft")
-    n1, n2 = _factor_pow2(nfft)
-    if n1 % rfactor:
-        raise ValueError(
-            "rfactor must divide the radix split n1=%d" % n1)
+    n1, n2 = _choose_split(nfft, rfactor)
+    if transpose not in ('kernel', 'epilogue'):
+        transpose = os.environ.get('BF_SPEC_TRANSPOSE',
+                                   'kernel').strip().lower()
+        if transpose not in ('kernel', 'epilogue'):
+            transpose = 'kernel'
     tt = min(time_tile, T)
     while T % tt:
         tt -= 1
-    (f1r, f1i), (twr, twi), (f2r, f2i) = _dft_consts(n1, n2)
+    mode = precision if precision in ('high', 'highest') else 'default'
+    consts, use_bd = _kernel_consts(n1, n2, mode)
     nout = nfft // rfactor
-    prec = (jax.lax.Precision.HIGHEST if precision == 'highest'
-            else None)
+    j = n1 // rfactor
 
-    def dot(a, b):
-        return jax.lax.dot(a, b, precision=prec,
-                           preferred_element_type=jnp.float32)
-
-    kern = functools.partial(_kernel, n1, n2, rfactor, dot)
+    names = sorted(consts)
+    cvals = [jnp.asarray(consts[k]) for k in names]
+    cspecs = [pl.BlockSpec(v.shape,
+                           (lambda nd: lambda i: (0,) * nd)(v.ndim))
+              for v in cvals]
+    kern = functools.partial(_kernel, n1, n2, rfactor, mode,
+                             transpose == 'kernel', tuple(names),
+                             use_bd)
     rows_tile = 2 * tt
-    flat = volt.reshape(T * 2, 2 * nfft)     # (spectra, re/im pairs)
+    # one int16 per complex sample (free XLA bitcast of the (re, im)
+    # int8 pair; little-endian: low byte = re)
+    v16 = jax.lax.bitcast_convert_type(volt, jnp.int16)   # (T, 2, n)
+    flat = v16.reshape(T * 2, n)
     grid = (T // tt,)
-    const = pl.BlockSpec((n1, n1), lambda i: (0, 0))
-    const2 = pl.BlockSpec((n2, n2), lambda i: (0, 0))
-    consttw = pl.BlockSpec((n1, n2), lambda i: (0, 0))
+    if transpose == 'kernel':
+        out_spec = pl.BlockSpec((tt, 4, n2, j), lambda i: (i, 0, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((T, 4, n2, j), jnp.float32)
+    else:
+        out_spec = pl.BlockSpec((tt, 4, j, n2), lambda i: (i, 0, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((T, 4, j, n2), jnp.float32)
     out = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((rows_tile, 2 * nfft), lambda i: (i, 0)),
-            const, const, consttw, consttw, const2, const2,
-        ],
-        out_specs=pl.BlockSpec((tt, 4 * nout), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((T, 4 * nout), jnp.float32),
+        in_specs=[pl.BlockSpec((rows_tile, nfft), lambda i: (i, 0))]
+                 + cspecs,
+        out_specs=out_spec,
+        out_shape=out_shape,
         interpret=interpret,
-    )(flat, jnp.asarray(f1r), jnp.asarray(f1i), jnp.asarray(twr),
-      jnp.asarray(twi), jnp.asarray(f2r), jnp.asarray(f2i))
-    return out.reshape(T, 4, nout)
+    )(flat, *cvals)
+    if transpose == 'kernel':
+        # (T, 4, s, j): flattening (s, j) IS natural frequency order
+        return out.reshape(T, 4, nout)
+    # epilogue: (T, 4, j, s) -> (T, 4, s, j) -> natural order
+    return jnp.swapaxes(out, 2, 3).reshape(T, 4, nout)
 
 
 def spectrometer_oracle(volt, rfactor=4):
@@ -242,7 +383,7 @@ def spectrometer_accuracy(precision, nfft=4096, rfactor=4):
         # the effective radix split is part of the key: BF_SPEC_SPLIT
         # changes the contraction/accumulation lengths (and so
         # rounding) and the gate must probe the shape substituted
-        key = (precision, nfft, rfactor) + _factor_pow2(nfft)
+        key = (precision, nfft, rfactor) + _choose_split(nfft, rfactor)
     except ValueError as e:
         _last_probe_error = 'ValueError: %s' % e
         return 1e9
@@ -263,6 +404,41 @@ def spectrometer_accuracy(precision, nfft=4096, rfactor=4):
         return 1e9
     _acc_cache[key] = rel
     return rel
+
+
+_usable_cache = {}
+
+
+def kernel_usable(nfft, rfactor, tile, precision, transpose):
+    """True when the kernel COMPILES AND RUNS on the current backend at
+    the exact (tile, precision, transpose) that would be substituted.
+    The accuracy gate probes a small tile; VMEM exhaustion only shows
+    up at the substitution tile (scoped-vmem limit ~16 MB), so the
+    matcher must probe the real configuration before committing — a
+    mid-pipeline compile failure would otherwise kill the block thread.
+    Successes are cached; failures are not (transient backend errors
+    must not disable the kernel for the process lifetime)."""
+    global _last_probe_error
+    try:
+        key = ((nfft, rfactor, tile, precision, transpose)
+               + _choose_split(nfft, rfactor))
+    except ValueError as e:
+        _last_probe_error = 'ValueError: %s' % e
+        return False
+    if key in _usable_cache:
+        return True
+    try:
+        import jax.numpy as jnp
+        volt = np.zeros((tile, 2, nfft, 2), np.int8)
+        out = fused_spectrometer(jnp.asarray(volt), rfactor=rfactor,
+                                 time_tile=tile, precision=precision,
+                                 transpose=transpose)
+        np.asarray(out)
+    except Exception as e:
+        _last_probe_error = '%s: %s' % (type(e).__name__, str(e)[:200])
+        return False
+    _usable_cache[key] = True
+    return True
 
 
 def choose_precision(nfft=4096, rfactor=4):
@@ -286,9 +462,9 @@ def choose_precision(nfft=4096, rfactor=4):
         return 'off'
     if mode == 'pallas':
         prec = os.environ.get('BF_SPEC_PREC', '').strip().lower()
-        return 'highest' if prec == 'highest' else None
-    # auto: correctness-gated substitution
-    for prec in (None, 'highest'):
+        return prec if prec in ('high', 'highest') else None
+    # auto: correctness-gated substitution, cheapest passing precision
+    for prec in (None, 'high', 'highest'):
         if spectrometer_accuracy(prec, nfft, rfactor) < 1e-5:
             return prec
     return 'off'

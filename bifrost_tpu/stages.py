@@ -514,21 +514,32 @@ def match_spectrometer(stages, headers, shape, dtype):
     if r.op != 'sum' or r.axis != 2 or not r.factor:
         return None
     from .ops import spectrometer as spec
-    n1, _ = spec._factor_pow2(nfft)
-    if n1 % r.factor:
+    try:
+        n1, _ = spec._choose_split(nfft, r.factor)
+    except ValueError:
         return None
     prec = spec.choose_precision(nfft, r.factor)
     if prec == 'off':
         return None
+    # default tile 16: the 4096-pt kernel fits the ~16 MB scoped-VMEM
+    # limit at 16 but not 32 (measured on chip)
     try:
-        tile = int(os.environ.get('BF_SPEC_TILE', '32'))
+        tile = int(os.environ.get('BF_SPEC_TILE', '16'))
     except ValueError:
-        tile = 32
+        tile = 16
     if tile < 1:
-        tile = 32
+        tile = 16
+    trans = os.environ.get('BF_SPEC_TRANSPOSE', 'kernel').strip().lower()
+    if trans not in ('kernel', 'epilogue'):
+        trans = 'kernel'
+    # compile-probe the EXACT substitution configuration (VMEM limits
+    # bind at the real tile, not the accuracy gate's small one)
+    if not spec.kernel_usable(nfft, r.factor, tile, prec, trans):
+        return None
     factor = r.factor
 
     def fn(x):
         return spec.fused_spectrometer(x, rfactor=factor,
-                                       time_tile=tile, precision=prec)
+                                       time_tile=tile, precision=prec,
+                                       transpose=trans)
     return fn
