@@ -173,6 +173,82 @@ def test_matcher_rejects_non_matching_chains(monkeypatch):
     assert match_spectrometer(st, hs, (8, 2, 192, 2), 'int8') is None
 
 
+def test_choose_split_prefers_lane_native():
+    from bifrost_tpu.ops.spectrometer import _choose_split
+    # minor dim a multiple of 128 (the only split Mosaic compiles)
+    assert _choose_split(4096, 4) == (32, 128)
+    assert _choose_split(1024, 8) == (8, 128)
+    # square fallback when the lane-native n1 can't host rfactor
+    assert _choose_split(256, 4) == (16, 16)
+    # no valid split at all -> ValueError
+    with pytest.raises(ValueError):
+        _choose_split(256, 32)
+    with pytest.raises(ValueError):
+        _choose_split(192, 4)       # not a power of two
+
+
+def test_precision_modes_match_oracle():
+    rng = np.random.RandomState(2)
+    volt = rng.randint(-64, 64, size=(4, 2, 1024, 2)).astype(np.int8)
+    want = spectrometer_oracle(volt, rfactor=4)
+    for prec in (None, 'high', 'highest'):
+        got = np.asarray(fused_spectrometer(
+            jnp.asarray(volt), rfactor=4, time_tile=4, precision=prec,
+            interpret=True))
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        # interpret mode runs f32 throughout; all modes must agree
+        assert rel < 1e-5, (prec, rel)
+
+
+def test_epilogue_transpose_matches_kernel_transpose():
+    rng = np.random.RandomState(9)
+    volt = rng.randint(-64, 64, size=(4, 2, 1024, 2)).astype(np.int8)
+    a = np.asarray(fused_spectrometer(jnp.asarray(volt), rfactor=4,
+                                      time_tile=4, interpret=True,
+                                      transpose='kernel'))
+    b = np.asarray(fused_spectrometer(jnp.asarray(volt), rfactor=4,
+                                      time_tile=4, interpret=True,
+                                      transpose='epilogue'))
+    assert np.array_equal(a, b)
+
+
+def test_kernel_usable_rejects_invalid_config():
+    from bifrost_tpu.ops import spectrometer as spec
+    # no split supports rfactor 32 at nfft 256 -> unusable, no compile
+    assert not spec.kernel_usable(256, 32, 16, None, 'kernel')
+
+
+def test_matcher_probes_usability(monkeypatch):
+    """match_spectrometer consults kernel_usable with the exact
+    substitution config and returns None when it fails."""
+    from bifrost_tpu.ops import spectrometer as spec
+    from bifrost_tpu.stages import (FftStage, DetectStage, ReduceStage,
+                                    match_spectrometer)
+    monkeypatch.setattr(spec, 'choose_precision', lambda *a, **k: None)
+    seen = {}
+
+    def fake_usable(nfft, rfactor, tile, prec, trans):
+        seen.update(nfft=nfft, rfactor=rfactor, tile=tile,
+                    prec=prec, trans=trans)
+        return False
+
+    monkeypatch.setattr(spec, 'kernel_usable', fake_usable)
+    hdr = {'_tensor': {'shape': [-1, 2, 256], 'dtype': 'ci8',
+                       'labels': ['time', 'pol', 'fine_time'],
+                       'scales': [[0, 1]] * 3, 'units': [None] * 3}}
+    st = [FftStage('fine_time', axis_labels='freq'),
+          DetectStage('stokes', axis='pol'), ReduceStage('freq', 4)]
+    headers = [hdr]
+    h = hdr
+    for s in st:
+        h = s.transform_header(h)
+        headers.append(h)
+    assert match_spectrometer(st, headers, (8, 2, 256, 2),
+                              'int8') is None
+    assert seen == {'nfft': 256, 'rfactor': 4, 'tile': 16,
+                    'prec': None, 'trans': 'kernel'}
+
+
 def test_split_override(monkeypatch):
     monkeypatch.setenv('BF_SPEC_SPLIT', '128')
     got, want, rel = _run(T=4, nfft=4096, rfactor=4, time_tile=4)
