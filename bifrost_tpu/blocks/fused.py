@@ -58,10 +58,10 @@ class FusedBlock(TransformBlock):
             cur = jax.eval_shape(fn, cur)
         composed = lambda x: _reduce(lambda v, f: f(v), fns, x)
         mesh = self.mesh
+        from ..stages import match_spectrometer
         if mesh is None:
             # whole-chain kernel substitution (e.g. the fused Pallas
             # spectrometer) when the stage pattern + accuracy gate admit
-            from ..stages import match_spectrometer
             spec_fn = match_spectrometer(self.stages, self._headers,
                                          shape, dtype)
             if spec_fn is not None:
@@ -71,9 +71,40 @@ class FusedBlock(TransformBlock):
             # gulp's frame axis, let GSPMD partition every stage and insert
             # any collectives (the TPU generalization of the reference's
             # per-block gpu=N placement, reference: pipeline.py:365-366).
-            from ..parallel.scope import shardable_nframe, time_sharding
+            from ..parallel.scope import (shardable_nframe,
+                                          time_sharding,
+                                          time_axis_name,
+                                          time_axis_size)
             taxis = self._headers[0]['_tensor']['shape'].index(-1)
             if shardable_nframe(mesh, shape[taxis]):
+                if taxis == 0:
+                    # the spectrometer kernel is independent per time
+                    # step, so under a mesh it runs per-shard inside
+                    # shard_map on the frame axis; match at the
+                    # PER-SHARD shape (that is what each device
+                    # compiles and what kernel_usable must probe)
+                    nsh = time_axis_size(mesh)
+                    local = (shape[0] // nsh,) + tuple(shape[1:])
+                    spec_fn = match_spectrometer(
+                        self.stages, self._headers, local, dtype)
+                    if spec_fn is not None:
+                        import inspect
+                        from ..parallel.ops import _shard_map
+                        from jax.sharding import PartitionSpec
+                        sm = _shard_map()
+                        # the pallas body carries no varying-mesh-axis
+                        # metadata; disable the check under either API
+                        # generation (check_vma >= 0.8, check_rep before)
+                        params = inspect.signature(sm).parameters
+                        kw = {}
+                        if 'check_vma' in params:
+                            kw['check_vma'] = False
+                        elif 'check_rep' in params:
+                            kw['check_rep'] = False
+                        p = PartitionSpec(time_axis_name(mesh))
+                        sharded = sm(spec_fn, mesh=mesh, in_specs=p,
+                                     out_specs=p, **kw)
+                        return jax.jit(sharded), taxis
                 sharding = time_sharding(mesh, len(shape), taxis)
                 return (jax.jit(composed, in_shardings=sharding),
                         taxis)

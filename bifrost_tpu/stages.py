@@ -532,6 +532,12 @@ def match_spectrometer(stages, headers, shape, dtype):
     trans = os.environ.get('BF_SPEC_TRANSPOSE', 'kernel').strip().lower()
     if trans not in ('kernel', 'epilogue'):
         trans = 'kernel'
+    # the EFFECTIVE tile after fused_spectrometer's shrink-to-divisor
+    # (shape[0] is the frame count the kernel will actually see — the
+    # per-shard count under a mesh)
+    tile = min(tile, shape[0])
+    while shape[0] % tile:
+        tile -= 1
     # compile-probe the EXACT substitution configuration (VMEM limits
     # bind at the real tile, not the accuracy gate's small one)
     if not spec.kernel_usable(nfft, r.factor, tile, prec, trans):

@@ -245,7 +245,9 @@ def test_matcher_probes_usability(monkeypatch):
         headers.append(h)
     assert match_spectrometer(st, headers, (8, 2, 256, 2),
                               'int8') is None
-    assert seen == {'nfft': 256, 'rfactor': 4, 'tile': 16,
+    # tile is the EFFECTIVE one after shrink-to-divisor vs the real
+    # frame count (8 here), not the raw BF_SPEC_TILE default
+    assert seen == {'nfft': 256, 'rfactor': 4, 'tile': 8,
                     'prec': None, 'trans': 'kernel'}
 
 
@@ -259,9 +261,10 @@ def test_split_override(monkeypatch):
     assert rel < 1e-5
 
 
-def test_mesh_scope_keeps_xla_path(monkeypatch):
-    """Under BlockScope(mesh=...) the FusedBlock does NOT substitute
-    the Pallas kernel (GSPMD shards the XLA chain instead)."""
+def test_mesh_scope_substitutes_kernel_per_shard(monkeypatch):
+    """Under BlockScope(mesh=...) the FusedBlock substitutes the
+    Pallas kernel PER SHARD via shard_map on the frame axis, and the
+    pipeline output still matches the oracle."""
     from bifrost_tpu.ops import spectrometer as spec
     from bifrost_tpu.dtype import ci8 as ci8_dtype
     from bifrost_tpu.parallel.mesh import create_mesh
@@ -270,17 +273,52 @@ def test_mesh_scope_keeps_xla_path(monkeypatch):
     if len(jax.devices()) < 8:
         pytest.skip('needs the 8-device virtual mesh')
 
-    called = []
-    monkeypatch.setattr(spec, 'choose_precision',
-                        lambda *a, **k: called.append(1) or None)
-    T, NF = 8, 256
+    calls = []
+    real = spec.fused_spectrometer
+
+    def fake(v, **kw):
+        calls.append(tuple(v.shape))
+        kw.pop('interpret', None)
+        return real(v, interpret=True, **kw)
+
+    monkeypatch.setattr(spec, 'choose_precision', lambda *a, **k: None)
+    monkeypatch.setattr(spec, 'fused_spectrometer', fake)
+
+    T, NF = 16, 256
     rng = np.random.RandomState(6)
     raw = np.zeros((T, 2, NF), dtype=ci8_dtype)
     raw['re'] = rng.randint(-8, 8, size=(T, 2, NF))
     raw['im'] = rng.randint(-8, 8, size=(T, 2, NF))
     out = _run_fused_ci8_chain(raw, rfactor=4,
                                mesh=create_mesh({'sp': 8}))
-    assert not called, "matcher must not be consulted under a mesh"
+    # matched at the per-shard shape: T/8 frames per device
+    assert (T // 8, 2, NF, 2) in calls, calls
+    volt = np.stack([raw['re'], raw['im']], axis=-1).astype(np.int8)
+    want = spectrometer_oracle(volt, rfactor=4)
+    assert out.shape == (T, 4, NF // 4)
+    assert np.max(np.abs(out - want)) / np.max(np.abs(want)) < 1e-4
+
+
+def test_mesh_scope_falls_back_to_gspmd_chain(monkeypatch):
+    """When the kernel is not admitted (choose_precision 'off'), the
+    mesh path still runs the GSPMD-sharded XLA chain."""
+    from bifrost_tpu.ops import spectrometer as spec
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    from bifrost_tpu.parallel.mesh import create_mesh
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip('needs the 8-device virtual mesh')
+
+    monkeypatch.setattr(spec, 'choose_precision',
+                        lambda *a, **k: 'off')
+    T, NF = 8, 256
+    rng = np.random.RandomState(7)
+    raw = np.zeros((T, 2, NF), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-8, 8, size=(T, 2, NF))
+    raw['im'] = rng.randint(-8, 8, size=(T, 2, NF))
+    out = _run_fused_ci8_chain(raw, rfactor=4,
+                               mesh=create_mesh({'sp': 8}))
     volt = np.stack([raw['re'], raw['im']], axis=-1).astype(np.int8)
     want = spectrometer_oracle(volt, rfactor=4)
     assert np.max(np.abs(out - want)) / np.max(np.abs(want)) < 1e-4
