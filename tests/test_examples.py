@@ -48,3 +48,15 @@ def test_mesh_spectrometer():
 def test_fdmt_search():
     res = _run('fdmt_search.py')
     assert res.returncode == 0, res.stderr[-2000:]
+
+
+def test_file_roundtrip(tmp_path):
+    res = _run('file_roundtrip.py', str(tmp_path))
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert 'file_roundtrip OK' in res.stdout
+
+
+def test_serialize_replay(tmp_path):
+    res = _run('serialize_replay.py', str(tmp_path))
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert 'replay bit-identical to live run' in res.stdout
