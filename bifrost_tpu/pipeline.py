@@ -21,6 +21,7 @@ TPU-first differences:
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 import threading
@@ -245,11 +246,15 @@ class Pipeline(BlockScope):
 
     instance_count = 0
 
-    def __init__(self, name=None, **kwargs):
+    def __init__(self, name=None, auto_fuse=None, **kwargs):
         if name is None:
             name = 'Pipeline_%i' % Pipeline.instance_count
             Pipeline.instance_count += 1
         super(Pipeline, self).__init__(name=name, **kwargs)
+        if auto_fuse is None:
+            auto_fuse = os.environ.get('BF_AUTO_FUSE',
+                                       '0').strip() == '1'
+        self.auto_fuse = auto_fuse
         self.blocks = []
         self.threads = []
         self.shutdown_timeout = 5.
@@ -275,7 +280,120 @@ class Pipeline(BlockScope):
                     % block.name)
         self.all_blocks_finished_initializing_event.set()
 
+    def _auto_fuse(self):
+        """Collapse chains of adjacent single-Stage transform blocks
+        into ONE FusedBlock each (one jitted computation per gulp, no
+        intermediate ring traffic) — the pipeline-level analogue of
+        XLA's op fusion.  A reference-style pipeline written as
+        separate fft/detect/reduce blocks gets the fused chain's
+        performance (and the Pallas spectrometer substitution, when
+        the pattern matches) without rewriting to ``blocks.fused``.
+
+        Opt-in: ``Pipeline(auto_fuse=True)`` or ``BF_AUTO_FUSE=1``.
+        Chains only merge when the interior ring has exactly one
+        consumer, no ``block_view`` tap, and every block resolves the
+        same scope tunables (core/device/mesh/gulp...).  The replaced
+        blocks never start threads; the FusedBlock writes into the
+        chain tail's existing output ring so downstream blocks keep
+        their references.  (The tail blocks' pre-created rings and
+        ProcLog directories remain as inert artifacts of
+        construction.)
+        """
+        from .blocks.fft import _StageBlock
+        from .blocks.fused import FusedBlock
+
+        def fusable(b):
+            # device rings only: some stage blocks (reduce) also run a
+            # host numpy path on 'system' rings, which cannot fuse
+            return (isinstance(b, _StageBlock)
+                    and len(b.irings) == 1 and len(b.orings) == 1
+                    and b.irings[0].space == 'tpu'
+                    and getattr(b, 'guarantee', True))
+
+        tunables = ('core', 'device', 'mesh', 'gulp_nframe',
+                    'buffer_factor', 'buffer_nframe', 'sync_depth',
+                    'sync_strict')
+
+        def compatible(a, b):
+            for t in tunables:
+                va, vb = getattr(a, t), getattr(b, t)
+                if va is not vb and va != vb:
+                    return False
+            return True
+
+        # key by the UNDERLYING ring: a block_view consumer reads
+        # through a RingView whose identity differs from the producer's
+        # oring, and a viewed interior ring must block fusion
+        def base_ring(r):
+            return getattr(r, '_base_ring', r)
+
+        consumers = {}
+        for b in self.blocks:
+            for r in getattr(b, 'irings', ()):
+                consumers.setdefault(id(base_ring(r)), []).append(b)
+
+        def sole_consumer(prod):
+            lst = consumers.get(id(base_ring(prod.orings[0])), [])
+            if len(lst) != 1:
+                return None
+            # the sole consumer must read the ring DIRECTLY — a view
+            # implies a header transform fusion would discard
+            nxt = lst[0]
+            direct = any(r is prod.orings[0] for r in nxt.irings)
+            return nxt if direct else None
+
+        chains = []
+        in_chain = set()
+        for b in self.blocks:
+            if not fusable(b) or id(b) in in_chain:
+                continue
+            prod = getattr(b.irings[0], 'owner', None)
+            if (prod is not None and fusable(prod)
+                    and sole_consumer(prod) is b
+                    and compatible(prod, b)):
+                continue                  # interior of another chain
+            chain = [b]
+            while True:
+                nxt = sole_consumer(chain[-1])
+                if (nxt is not None and fusable(nxt)
+                        and id(nxt) not in in_chain
+                        and compatible(chain[-1], nxt)):
+                    chain.append(nxt)
+                else:
+                    break
+            if len(chain) >= 2:
+                chains.append(chain)
+                in_chain.update(id(x) for x in chain)
+
+        for chain in chains:
+            head, tail = chain[0], chain[-1]
+            # construct under the head's scope so the FusedBlock
+            # inherits the same tunables, registering with THIS
+            # pipeline regardless of the ambient default
+            _stacks.pipelines.append(self)
+            _stacks.scopes.append(head._parent_scope or self)
+            try:
+                # carry the chain's RESOLVED tunables explicitly:
+                # per-block settings (device=1 on the blocks
+                # themselves) are not visible through the parent scope
+                fb = FusedBlock(
+                    head.irings[0], [blk._stage for blk in chain],
+                    name='AutoFused_x%d_%s'
+                         % (len(chain), head.name.split('/')[-1]),
+                    **{t: getattr(head, t) for t in tunables})
+            finally:
+                _stacks.scopes.pop()
+                _stacks.pipelines.pop()
+            fb.orings = [tail.orings[0]]
+            for blk in chain:
+                self.blocks.remove(blk)
+                parent = blk._parent_scope
+                if parent is not None and blk in parent._children:
+                    parent._children.remove(blk)
+
     def run(self):
+        if self.auto_fuse:
+            self._auto_fuse()
         # device-space pipelines: create the jax backend client from
         # THIS thread first — the tunneled TPU plugin deadlocks when a
         # block (worker) thread triggers the first client init

@@ -227,3 +227,107 @@ def test_block_scope_device_placement():
         Probe(b)
         p.run()
     assert devices_seen == [3], devices_seen
+
+
+def _run_stage_chain(auto_fuse, raw, hdr):
+    """Reference-style separate fft/detect/reduce blocks; auto_fuse
+    collapses them into one FusedBlock (pipeline-level op fusion)."""
+    with bf.Pipeline(auto_fuse=auto_fuse) as p:
+        src = NumpySourceBlock([raw], hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fft(b, axes='fine_time', axis_labels='freq')
+        b = bf.blocks.detect(b, mode='stokes')
+        b = bf.blocks.reduce(b, 'freq', 4)
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+        nblocks = len(p.blocks)
+    return sink.result(), nblocks
+
+
+def test_auto_fuse_output_identical_and_blocks_collapse():
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    rng = np.random.RandomState(3)
+    raw = np.zeros((8, 2, 64), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-32, 32, size=(8, 2, 64))
+    raw['im'] = rng.randint(-32, 32, size=(8, 2, 64))
+    hdr = simple_header([-1, 2, 64], 'ci8',
+                        labels=['time', 'pol', 'fine_time'])
+    base, nb_base = _run_stage_chain(False, raw, hdr)
+    fused, nb_fused = _run_stage_chain(True, raw, hdr)
+    np.testing.assert_allclose(fused, base, rtol=1e-5)
+    # src + copy + fft + detect + reduce + copy + sink = 7 blocks;
+    # fused: src + copy + AutoFused + copy + sink = 5
+    assert nb_base == 7
+    assert nb_fused == 5
+
+
+def test_auto_fuse_skips_tapped_ring():
+    """A ring with two consumers must not be swallowed by fusion."""
+    rng = np.random.RandomState(4)
+    data = (rng.randn(8, 16) +
+            1j * rng.randn(8, 16)).astype(np.complex64)
+    hdr = simple_header([-1, 16], 'cf32', labels=['time', 'freq'])
+    with bf.Pipeline(auto_fuse=True) as p:
+        src = NumpySourceBlock([data], hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        d = bf.blocks.detect(b, mode='scalar')
+        r = bf.blocks.reduce(d, 'freq', 4)
+        g1 = GatherSink(bf.blocks.copy(d, space='system'))
+        g2 = GatherSink(bf.blocks.copy(r, space='system'))
+        p.run()
+    want_d = np.abs(data) ** 2
+    np.testing.assert_allclose(g1.result(), want_d, rtol=1e-5)
+    np.testing.assert_allclose(g2.result(),
+                               want_d.reshape(8, 4, 4).sum(-1),
+                               rtol=1e-5)
+
+
+def test_auto_fuse_skips_view_tapped_ring():
+    """A block_view tap reads through a RingView whose identity differs
+    from the producer's oring; fusion must still see it as a second
+    consumer (a swallowed tap would deadlock its sink)."""
+    rng = np.random.RandomState(5)
+    data = (rng.randn(8, 16) +
+            1j * rng.randn(8, 16)).astype(np.complex64)
+    hdr = simple_header([-1, 16], 'cf32', labels=['time', 'freq'])
+    with bf.Pipeline(auto_fuse=True) as p:
+        src = NumpySourceBlock([data], hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        d = bf.blocks.detect(b, mode='scalar')
+        r = bf.blocks.reduce(d, 'freq', 4)
+        tap = bf.views.rename_axis(d, 'freq', 'chan')
+        g1 = GatherSink(bf.blocks.copy(tap, space='system'))
+        g2 = GatherSink(bf.blocks.copy(r, space='system'))
+        p.run()
+    want_d = np.abs(data) ** 2
+    np.testing.assert_allclose(g1.result(), want_d, rtol=1e-5)
+    assert g1.headers[0]['_tensor']['labels'] == ['time', 'chan']
+    np.testing.assert_allclose(g2.result(),
+                               want_d.reshape(8, 4, 4).sum(-1),
+                               rtol=1e-5)
+
+
+def test_auto_fuse_carries_per_block_tunables():
+    """Per-block settings (core= on the blocks themselves) survive
+    fusion: the replacement FusedBlock resolves the same values."""
+    rng = np.random.RandomState(6)
+    data = (rng.randn(8, 16) +
+            1j * rng.randn(8, 16)).astype(np.complex64)
+    hdr = simple_header([-1, 16], 'cf32', labels=['time', 'freq'])
+    with bf.Pipeline(auto_fuse=True) as p:
+        src = NumpySourceBlock([data], hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        d = bf.blocks.detect(b, mode='scalar', core=0)
+        r = bf.blocks.reduce(d, 'freq', 4, core=0)
+        g = GatherSink(bf.blocks.copy(r, space='system'))
+        p._auto_fuse()
+        fused = [blk for blk in p.blocks
+                 if blk.name.split('/')[-1].startswith('AutoFused')]
+        assert len(fused) == 1, [blk.name for blk in p.blocks]
+        assert fused[0].core == 0
+        p.auto_fuse = False           # already fused by hand above
+        p.run()
+    want = np.abs(data) ** 2
+    np.testing.assert_allclose(g.result(),
+                               want.reshape(8, 4, 4).sum(-1), rtol=1e-5)
