@@ -1,54 +1,34 @@
-"""FFT-shift block (reference: python/bifrost/blocks/fftshift.py:37-81)."""
+"""FFT-shift block (reference: python/bifrost/blocks/fftshift.py:37-81).
+
+Math/metadata live in stages.FftShiftStage so the block is auto-fusable
+(Pipeline(auto_fuse=True)) and identical standalone or fused; 'system'
+rings take a numpy path.
+"""
 
 from __future__ import annotations
 
-from copy import deepcopy
-
-from ..pipeline import TransformBlock
+from ..stages import FftShiftStage
+from .fft import _StageBlock
 
 __all__ = ['FftShiftBlock', 'fftshift']
 
 
-class FftShiftBlock(TransformBlock):
+class FftShiftBlock(_StageBlock):
     def __init__(self, iring, axes, inverse=False, *args, **kwargs):
-        super(FftShiftBlock, self).__init__(iring, *args, **kwargs)
-        if not isinstance(axes, (list, tuple)):
-            axes = [axes]
-        self.specified_axes = axes
-        self.inverse = inverse
+        super(FftShiftBlock, self).__init__(
+            iring, FftShiftStage(axes, inverse), *args, **kwargs)
 
     def define_valid_input_spaces(self):
         return ('tpu', 'system')
 
-    def on_sequence(self, iseq):
-        ihdr = iseq.header
-        itensor = ihdr['_tensor']
-        self.axes = [itensor['labels'].index(ax) if isinstance(ax, str)
-                     else ax for ax in self.specified_axes]
-        frame_axis = itensor['shape'].index(-1)
-        if frame_axis in self.axes:
-            raise KeyError("Cannot fftshift the frame axis")
-        ohdr = deepcopy(ihdr)
-        otensor = ohdr['_tensor']
-        if 'scales' in itensor:
-            for ax in self.axes:
-                sgn = +1 if self.inverse else -1
-                step = otensor['scales'][ax][1]
-                otensor['scales'][ax][0] += \
-                    sgn * (otensor['shape'][ax] // 2) * step
-        return ohdr
-
     def on_data(self, ispan, ospan):
-        axes = self.axes
         if ispan.ring.space == 'tpu':
-            import jax.numpy as jnp
-            fn = jnp.fft.ifftshift if self.inverse else jnp.fft.fftshift
-            ospan.set(fn(ispan.data, axes=axes))
-        else:
-            import numpy as np
-            fn = np.fft.ifftshift if self.inverse else np.fft.fftshift
-            ospan.data.as_numpy()[...] = fn(ispan.data.as_numpy(),
-                                            axes=axes)
+            return super(FftShiftBlock, self).on_data(ispan, ospan)
+        import numpy as np
+        st = self._stage
+        fn = np.fft.ifftshift if st.inverse else np.fft.fftshift
+        ospan.data.as_numpy()[...] = fn(ispan.data.as_numpy(),
+                                        axes=st.axes)
 
 
 def fftshift(iring, axes, inverse=False, *args, **kwargs):

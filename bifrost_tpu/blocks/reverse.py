@@ -1,59 +1,36 @@
 """Axis-reversal block (reference: python/bifrost/blocks/reverse.py:36-75).
-The reference runs a bf.map gather; here it's jnp.flip under jit."""
+The reference runs a bf.map gather; here the math/metadata live in
+stages.ReverseStage (jnp cyclic flip under jit, auto-fusable); 'system'
+rings take a numpy path.
+"""
 
 from __future__ import annotations
 
-from copy import deepcopy
-
-from ..pipeline import TransformBlock
+from ..stages import ReverseStage
+from .fft import _StageBlock
 
 __all__ = ['ReverseBlock', 'reverse']
 
 
-class ReverseBlock(TransformBlock):
+class ReverseBlock(_StageBlock):
     def __init__(self, iring, axes, *args, **kwargs):
-        super(ReverseBlock, self).__init__(iring, *args, **kwargs)
-        if not isinstance(axes, (list, tuple)):
-            axes = [axes]
-        self.specified_axes = axes
+        super(ReverseBlock, self).__init__(iring, ReverseStage(axes),
+                                           *args, **kwargs)
 
     def define_valid_input_spaces(self):
         return ('tpu', 'system')
 
-    def on_sequence(self, iseq):
-        ihdr = iseq.header
-        itensor = ihdr['_tensor']
-        self.axes = [itensor['labels'].index(ax) if isinstance(ax, str)
-                     else ax for ax in self.specified_axes]
-        frame_axis = itensor['shape'].index(-1)
-        if frame_axis in self.axes:
-            raise KeyError("Cannot reverse the frame axis")
-        ohdr = deepcopy(ihdr)
-        otensor = ohdr['_tensor']
-        if 'scales' in itensor:
-            for ax in self.axes:
-                step = otensor['scales'][ax][1]
-                otensor['scales'][ax][0] += otensor['shape'][ax] * step
-                otensor['scales'][ax][1] = -step
-        return ohdr
-
     def on_data(self, ispan, ospan):
-        # reference semantics: b(i) = a(-i), i.e. element 0 stays put and
-        # the rest reverse (a cyclic reversal), matching the map gather.
+        # reference semantics: b(i) = a(-i), i.e. element 0 stays put
+        # and the rest reverse (a cyclic reversal), matching the map
+        # gather.
         if ispan.ring.space == 'tpu':
-            import jax.numpy as jnp
-            x = ispan.data
-            y = x
-            for ax in self.axes:
-                y = jnp.roll(jnp.flip(y, axis=ax), 1, axis=ax)
-            ospan.set(y)
-        else:
-            import numpy as np
-            x = ispan.data.as_numpy()
-            y = x
-            for ax in self.axes:
-                y = np.roll(np.flip(y, axis=ax), 1, axis=ax)
-            ospan.data.as_numpy()[...] = y
+            return super(ReverseBlock, self).on_data(ispan, ospan)
+        import numpy as np
+        y = ispan.data.as_numpy()
+        for ax in self._stage.axes:
+            y = np.roll(np.flip(y, axis=ax), 1, axis=ax)
+        ospan.data.as_numpy()[...] = y
 
 
 def reverse(iring, axes, *args, **kwargs):

@@ -1,56 +1,42 @@
 """Time-scrunch block: average ``factor`` frames into one
 (reference: python/bifrost/blocks/scrunch.py:38-66).  Works in any space
-(the reference is system-only; the TPU path is a jitted mean)."""
+(the reference is system-only); math/metadata live in
+stages.ScrunchStage (auto-fusable jitted mean); 'system' rings take a
+numpy path.
+"""
 
 from __future__ import annotations
 
-from copy import deepcopy
-
-from ..pipeline import TransformBlock
+from ..stages import ScrunchStage
+from .fft import _StageBlock
 
 __all__ = ['ScrunchBlock', 'scrunch']
 
 
-class ScrunchBlock(TransformBlock):
+class ScrunchBlock(_StageBlock):
     def __init__(self, iring, factor, *args, **kwargs):
-        super(ScrunchBlock, self).__init__(iring, *args, **kwargs)
         assert isinstance(factor, int)
-        self.factor = factor
+        super(ScrunchBlock, self).__init__(iring, ScrunchStage(factor),
+                                           *args, **kwargs)
 
-    def define_output_nframes(self, input_nframe):
-        if input_nframe % self.factor != 0:
-            raise ValueError("Scrunch factor does not divide gulp size")
-        return input_nframe // self.factor
-
-    def on_sequence(self, iseq):
-        ohdr = deepcopy(iseq.header)
-        frame_axis = ohdr['_tensor']['shape'].index(-1)
-        ohdr['_tensor']['scales'][frame_axis][1] *= self.factor
-        return ohdr
+    def define_valid_input_spaces(self):
+        return ('tpu', 'system')
 
     def on_data(self, ispan, ospan):
-        f = self.factor
         if ispan.ring.space == 'tpu':
-            import jax.numpy as jnp
-            x = ispan.data
-            t = ispan.tensor
-            taxis = len(t['ringlet_shape'])
-            nf = x.shape[taxis] // f
-            shp = x.shape[:taxis] + (nf, f) + x.shape[taxis + 1:]
-            ospan.set(jnp.mean(x.reshape(shp), axis=taxis + 1,
-                               dtype=x.dtype if jnp.issubdtype(
-                                   x.dtype, jnp.inexact) else jnp.float32
-                               ).astype(x.dtype))
-        else:
-            x = ispan.data.as_numpy()
-            out = ospan.data.as_numpy()
-            taxis = len(ispan.tensor['ringlet_shape'])
-            nf = x.shape[taxis] // f
-            shp = x.shape[:taxis] + (nf, f) + x.shape[taxis + 1:]
-            out[...] = x.reshape(shp).mean(axis=taxis + 1).astype(out.dtype)
-        return ispan.nframe // f
+            return super(ScrunchBlock, self).on_data(ispan, ospan)
+        import numpy as np
+        f = self._stage.factor
+        taxis = self._stage.taxis
+        x = ispan.data.as_numpy()
+        nf = x.shape[taxis] // f
+        shp = x.shape[:taxis] + (nf, f) + x.shape[taxis + 1:]
+        acc = x.dtype if np.issubdtype(x.dtype, np.inexact) \
+            else np.float32
+        ospan.data.as_numpy()[...] = x.reshape(shp).mean(
+            axis=taxis + 1, dtype=acc).astype(x.dtype)
 
 
 def scrunch(iring, factor, *args, **kwargs):
-    """Block: average ``factor`` incoming frames into one output frame."""
+    """Block: average every ``factor`` frames into one."""
     return ScrunchBlock(iring, factor, *args, **kwargs)

@@ -1,50 +1,34 @@
 """Axis permutation block (reference:
-python/bifrost/blocks/transpose.py:41-83)."""
+python/bifrost/blocks/transpose.py:41-83).
+
+Math/metadata live in stages.TransposeStage (auto-fusable — on TPU the
+XLA layout engine handles the permutation); 'system' rings take the
+cache-blocked numpy path below.
+"""
 
 from __future__ import annotations
 
-from copy import deepcopy
-
 import numpy as np
 
-from ..pipeline import TransformBlock
+from ..stages import TransposeStage
+from .fft import _StageBlock
 
 __all__ = ['TransposeBlock', 'transpose']
 
 
-class TransposeBlock(TransformBlock):
+class TransposeBlock(_StageBlock):
     def __init__(self, iring, axes, *args, **kwargs):
-        super(TransposeBlock, self).__init__(iring, *args, **kwargs)
-        self.specified_axes = axes
-        self.space = self.orings[0].space
+        super(TransposeBlock, self).__init__(iring, TransposeStage(axes),
+                                             *args, **kwargs)
 
-    def on_sequence(self, iseq):
-        ihdr = iseq.header
-        itensor = ihdr['_tensor']
-        if 'labels' in itensor:
-            labels = itensor['labels']
-            self.axes = [labels.index(ax) if isinstance(ax, str) else ax
-                         for ax in self.specified_axes]
-        else:
-            self.axes = list(self.specified_axes)
-        ohdr = deepcopy(ihdr)
-        otensor = ohdr['_tensor']
-        for item in ('shape', 'labels', 'scales', 'units'):
-            if item in itensor:
-                otensor[item] = [itensor[item][ax] for ax in self.axes]
-        return ohdr
+    def define_valid_input_spaces(self):
+        return ('tpu', 'system')
 
     def on_data(self, ispan, ospan):
-        if self.space == 'tpu':
-            import jax.numpy as jnp
-            arr = ispan.data
-            axes = list(self.axes)
-            if arr.ndim == len(axes) + 1:   # trailing re/im pair axis
-                axes = axes + [len(axes)]
-            ospan.set(jnp.transpose(arr, axes))
-        else:
-            _host_transpose(ospan.data.as_numpy(),
-                            ispan.data.as_numpy(), self.axes)
+        if ispan.ring.space == 'tpu':
+            return super(TransposeBlock, self).on_data(ispan, ospan)
+        _host_transpose(ospan.data.as_numpy(),
+                        ispan.data.as_numpy(), self._stage.axes)
 
 
 def _host_transpose(out, src, axes, tile=64):
