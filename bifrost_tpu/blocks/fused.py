@@ -11,10 +11,7 @@ FFT→detect→reduce fused).
 
 from __future__ import annotations
 
-from functools import reduce as _reduce
-
 from ..pipeline import TransformBlock
-from ..dtype import DataType
 
 __all__ = ['FusedBlock', 'fused']
 
@@ -25,6 +22,12 @@ class FusedBlock(TransformBlock):
         self.stages = list(stages)
         self._plan = None
         self._plan_key = None
+        #: configuration of the path the LAST built plan executes
+        #: (published to ProcLog ``<name>/impl`` so benchmarks and
+        #: monitors read what ran instead of re-deriving it)
+        self.impl_info = None
+        from ..proclog import ProcLog
+        self._impl_proclog = ProcLog(self.name + '/impl')
 
     def define_valid_input_spaces(self):
         return ('tpu',)
@@ -47,25 +50,18 @@ class FusedBlock(TransformBlock):
 
     def _build_plan(self, shape, dtype):
         import jax
-        fns = []
-        cur = jax.ShapeDtypeStruct(tuple(shape), dtype)
-        for stage, ihdr in zip(self.stages, self._headers[:-1]):
-            idt = DataType(ihdr['_tensor']['dtype'])
-            meta = {'shape': list(cur.shape), 'dtype': idt,
-                    'reim': idt.kind == 'ci'}
-            fn = stage.build(meta)
-            fns.append(fn)
-            cur = jax.eval_shape(fn, cur)
-        composed = lambda x: _reduce(lambda v, f: f(v), fns, x)
+        from ..stages import compose_stages, match_spectrometer
         mesh = self.mesh
-        from ..stages import match_spectrometer
         if mesh is None:
-            # whole-chain kernel substitution (e.g. the fused Pallas
-            # spectrometer) when the stage pattern + accuracy gate admit
-            spec_fn = match_spectrometer(self.stages, self._headers,
-                                         shape, dtype)
-            if spec_fn is not None:
-                composed = spec_fn
+            # compose_stages applies the whole-chain kernel
+            # substitution (e.g. the fused Pallas spectrometer) when
+            # the stage pattern + accuracy gate admit
+            composed, info = compose_stages(
+                self.stages, self._headers, shape, dtype)
+            self._set_impl(info)
+            return jax.jit(composed), None
+        composed, _ = compose_stages(self.stages, self._headers,
+                                     shape, dtype, substitute=False)
         if mesh is not None:
             # Scale the whole fused chain over the scope's mesh: shard the
             # gulp's frame axis, let GSPMD partition every stage and insert
@@ -88,6 +84,9 @@ class FusedBlock(TransformBlock):
                     spec_fn = match_spectrometer(
                         self.stages, self._headers, local, dtype)
                     if spec_fn is not None:
+                        self._set_impl(dict(
+                            spec_fn.info,
+                            mesh='shard_map[%d]' % nsh))
                         import inspect
                         from ..parallel.ops import _shard_map
                         from jax.sharding import PartitionSpec
@@ -106,9 +105,20 @@ class FusedBlock(TransformBlock):
                                      out_specs=p, **kw)
                         return jax.jit(sharded), taxis
                 sharding = time_sharding(mesh, len(shape), taxis)
+                self._set_impl({'impl': 'xla-fused',
+                                'mesh': 'gspmd'})
                 return (jax.jit(composed, in_shardings=sharding),
                         taxis)
+            self._set_impl({'impl': 'xla-fused'})
         return jax.jit(composed), None
+
+    def _set_impl(self, info):
+        """Record + publish the configuration the built plan executes."""
+        self.impl_info = dict(info)
+        try:
+            self._impl_proclog.update(self.impl_info)
+        except OSError:
+            pass
 
     def on_data(self, ispan, ospan):
         x = ispan.data

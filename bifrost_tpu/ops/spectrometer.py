@@ -75,15 +75,25 @@ def _choose_split(n, rfactor):
     """
     import math
     import os
+    import warnings
     if n & (n - 1) or n < 4:
         raise ValueError("fused spectrometer requires power-of-two nfft")
+    raw = os.environ.get('BF_SPEC_SPLIT', '0')
     try:
-        o = int(os.environ.get('BF_SPEC_SPLIT', '0'))
+        o = int(raw)
     except ValueError:
         o = 0
     if (o >= 1 and n % o == 0 and (o & (o - 1)) == 0
             and o % rfactor == 0):
         return o, n // o
+    if raw.strip() not in ('', '0'):
+        # the tuning knob must never silently do nothing: an override
+        # incompatible with (n, rfactor) falls through to the default
+        # split, loudly
+        warnings.warn(
+            "BF_SPEC_SPLIT=%r ignored: need a power-of-two divisor of "
+            "nfft=%d that rfactor=%d divides; using the default split"
+            % (raw, n, rfactor), RuntimeWarning)
     # lane-native: largest n1 <= 128 with n2 % 128 == 0
     n1 = n // 128
     while n1 > 128:
@@ -368,6 +378,42 @@ def spectrometer_mode():
 _acc_cache = {}
 _last_probe_error = None
 
+# Failure memoization for the compile/accuracy probes.  Failures are
+# cached with a timestamp + attempt count: a transient backend error
+# must not disable the kernel for the process lifetime, but a backend
+# that PERSISTENTLY rejects the config must not re-pay a full compile
+# attempt (seconds on the tunneled backend) on every plan rebuild
+# (ADVICE r3).  After _PROBE_MAX_TRIES consecutive failures the config
+# is only re-probed once per BF_SPEC_PROBE_TTL seconds.
+_fail_cache = {}
+_PROBE_MAX_TRIES = 2
+
+
+def _probe_ttl():
+    import os
+    try:
+        return float(os.environ.get('BF_SPEC_PROBE_TTL', '300'))
+    except ValueError:
+        return 300.0
+
+
+def _fail_cached(key):
+    """True when ``key`` has failed enough times recently that the
+    probe should be skipped."""
+    import time
+    entry = _fail_cache.get(key)
+    if entry is None:
+        return False
+    count, last = entry
+    return count >= _PROBE_MAX_TRIES and \
+        (time.time() - last) < _probe_ttl()
+
+
+def _record_failure(key):
+    import time
+    count, _ = _fail_cache.get(key, (0, 0.0))
+    _fail_cache[key] = (count + 1, time.time())
+
 
 def spectrometer_accuracy(precision, nfft=4096, rfactor=4):
     """Measured on-device relative error of the kernel vs the float64
@@ -375,8 +421,8 @@ def spectrometer_accuracy(precision, nfft=4096, rfactor=4):
     length — and so the rounding behavior — scales with the radix
     split, so the gate must probe the shape actually substituted).
     Successes are cached per (precision, nfft, rfactor); failures are
-    NOT cached (a transient backend error must not disable the kernel
-    for the process lifetime) and return a large finite sentinel so
+    retried up to _PROBE_MAX_TRIES times, then at most once per
+    BF_SPEC_PROBE_TTL seconds, and return a large finite sentinel so
     artifacts stay strict-JSON."""
     global _last_probe_error
     try:
@@ -389,6 +435,9 @@ def spectrometer_accuracy(precision, nfft=4096, rfactor=4):
         return 1e9
     if key in _acc_cache:
         return _acc_cache[key]
+    if _fail_cached(key):
+        _last_probe_error = 'cached failure (retry after TTL)'
+        return 1e9
     try:
         import jax.numpy as jnp
         rng = np.random.RandomState(11)
@@ -401,7 +450,9 @@ def spectrometer_accuracy(precision, nfft=4096, rfactor=4):
                     (np.max(np.abs(want)) + 1e-30))
     except Exception as e:
         _last_probe_error = '%s: %s' % (type(e).__name__, str(e)[:200])
+        _record_failure(key)
         return 1e9
+    _fail_cache.pop(key, None)
     _acc_cache[key] = rel
     return rel
 
@@ -416,8 +467,11 @@ def kernel_usable(nfft, rfactor, tile, precision, transpose):
     up at the substitution tile (scoped-vmem limit ~16 MB), so the
     matcher must probe the real configuration before committing — a
     mid-pipeline compile failure would otherwise kill the block thread.
-    Successes are cached; failures are not (transient backend errors
-    must not disable the kernel for the process lifetime)."""
+    Successes are cached; failures are retried a bounded number of
+    times, then once per BF_SPEC_PROBE_TTL seconds (ADVICE r3: an
+    unconditional retry re-pays a full compile attempt on every
+    gulp-shape plan rebuild when the backend persistently rejects the
+    config)."""
     global _last_probe_error
     try:
         key = ((nfft, rfactor, tile, precision, transpose)
@@ -427,6 +481,9 @@ def kernel_usable(nfft, rfactor, tile, precision, transpose):
         return False
     if key in _usable_cache:
         return True
+    if _fail_cached(key):
+        _last_probe_error = 'cached failure (retry after TTL)'
+        return False
     try:
         import jax.numpy as jnp
         volt = np.zeros((tile, 2, nfft, 2), np.int8)
@@ -436,7 +493,9 @@ def kernel_usable(nfft, rfactor, tile, precision, transpose):
         np.asarray(out)
     except Exception as e:
         _last_probe_error = '%s: %s' % (type(e).__name__, str(e)[:200])
+        _record_failure(key)
         return False
+    _fail_cache.pop(key, None)
     _usable_cache[key] = True
     return True
 

@@ -384,7 +384,12 @@ class Pipeline(BlockScope):
             finally:
                 _stacks.scopes.pop()
                 _stacks.pipelines.pop()
+            # rewire: the chain tail's output ring becomes fb's, and
+            # its owner must follow (downstream fused-scope
+            # buffer-sharing reads iseq.ring.owner); fb's self-created
+            # ring is abandoned before anyone writes to it
             fb.orings = [tail.orings[0]]
+            tail.orings[0].owner = fb
             for blk in chain:
                 self.blocks.remove(blk)
                 parent = blk._parent_scope

@@ -322,3 +322,81 @@ def test_mesh_scope_falls_back_to_gspmd_chain(monkeypatch):
     volt = np.stack([raw['re'], raw['im']], axis=-1).astype(np.int8)
     want = spectrometer_oracle(volt, rfactor=4)
     assert np.max(np.abs(out - want)) / np.max(np.abs(want)) < 1e-4
+
+
+def test_fused_block_publishes_impl_record(monkeypatch, tmp_path):
+    """The FusedBlock records the path its plan executes (impl_info)
+    and publishes it to ProcLog <block>/impl, so benchmarks read what
+    ran instead of re-deriving the substitution decision (VERDICT r3
+    item 4)."""
+    import bifrost_tpu as bf
+    from bifrost_tpu import proclog as proclog_mod
+    from bifrost_tpu.ops import spectrometer as spec
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    monkeypatch.setenv('BF_PROCLOG_DIR', str(tmp_path))
+    real = spec.fused_spectrometer
+    monkeypatch.setattr(spec, 'choose_precision', lambda *a, **k: None)
+    monkeypatch.setattr(
+        spec, 'fused_spectrometer',
+        lambda v, **kw: real(v, **dict(kw, interpret=True)))
+
+    T, NF, RF = 8, 256, 4
+    rng = np.random.RandomState(3)
+    raw = np.zeros((T, 2, NF), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-32, 32, size=(T, 2, NF))
+    raw['im'] = rng.randint(-32, 32, size=(T, 2, NF))
+    hdr = simple_header([-1, 2, NF], 'ci8',
+                        labels=['time', 'pol', 'fine_time'])
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock([raw], hdr, gulp_nframe=T)
+        b = bf.blocks.copy(src, space='tpu')
+        fb = bf.blocks.fused(b, [
+            FftStage('fine_time', axis_labels='freq'),
+            DetectStage('stokes', axis='pol'),
+            ReduceStage('freq', RF),
+        ])
+        b = bf.blocks.copy(fb, space='system')
+        sink = GatherSink(b)
+        p.run()
+    assert sink.result().shape == (T, 4, NF // RF)
+    assert fb.impl_info['impl'] == 'pallas-spectrometer'
+    assert fb.impl_info['rfactor'] == RF
+    assert fb.impl_info['nfft'] == NF
+    # published to the proclog tree
+    logs = proclog_mod.load_by_pid(os.getpid())
+    impl_logs = [blk['impl'] for blk in logs.values() if 'impl' in blk]
+    assert any(v.get('impl') == 'pallas-spectrometer'
+               for v in impl_logs), logs
+
+
+def test_compose_stages_is_the_shared_chain_constructor():
+    """compose_stages builds the same function a FusedBlock compiles;
+    the driver entry (__graft_entry__) goes through it (VERDICT r3
+    item 6)."""
+    from bifrost_tpu.stages import (FftStage, DetectStage, ReduceStage,
+                                    compose_stages, walk_headers)
+    T, NF, RF = 8, 64, 4
+    hdr = {'name': 's', 'time_tag': 0,
+           '_tensor': {'shape': [-1, 2, NF], 'dtype': 'ci8',
+                       'labels': ['time', 'pol', 'fine_time'],
+                       'scales': [[0, 1]] * 3, 'units': [None] * 3}}
+    st = [FftStage('fine_time', axis_labels='freq'),
+          DetectStage('stokes', axis='pol'),
+          ReduceStage('freq', RF)]
+    headers = walk_headers(st, hdr)
+    fn, info = compose_stages(st, headers, (T, 2, NF, 2), 'int8')
+    assert info['impl'] in ('xla-fused', 'pallas-spectrometer')
+    import jax.numpy as jnp
+    rng = np.random.RandomState(5)
+    volt = rng.randint(-32, 32, size=(T, 2, NF, 2)).astype(np.int8)
+    got = np.asarray(fn(jnp.asarray(volt)))
+    want = spectrometer_oracle(volt, rfactor=RF)
+    rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+    assert got.shape == (T, 4, NF // RF)
+    assert rel < 1e-5

@@ -1,0 +1,42 @@
+"""Probe the accelerator backend with a hard deadline.
+
+Prints one JSON line {"alive": bool, "init_s": float, "platform": str}
+and exits 0 when the backend initializes within the deadline, 3
+otherwise.  Used by bench.py's retry loop and by round automation to
+decide when the tunneled chip is healthy enough for a capture session.
+"""
+import json
+import os
+import sys
+import time
+
+
+def main():
+    deadline = float(os.environ.get('BF_PROBE_DEADLINE', '120'))
+    t0 = time.time()
+    result = {}
+
+    def probe():
+        import jax
+        devs = jax.devices()
+        import jax.numpy as jnp
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        y = float(jnp.sum(x @ x))
+        result['platform'] = devs[0].platform
+        result['n_devices'] = len(devs)
+        result['matmul_ok'] = (y == 256.0 * 256 * 256)
+
+    import threading
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(deadline)
+    init_s = round(time.time() - t0, 1)
+    if result.get('platform'):
+        print(json.dumps(dict(result, alive=True, init_s=init_s)))
+        return 0
+    print(json.dumps({'alive': False, 'init_s': init_s}))
+    return 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())
