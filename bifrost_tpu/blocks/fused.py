@@ -62,61 +62,64 @@ class FusedBlock(TransformBlock):
             return jax.jit(composed), None
         composed, _ = compose_stages(self.stages, self._headers,
                                      shape, dtype, substitute=False)
-        if mesh is not None:
-            # Scale the whole fused chain over the scope's mesh: shard the
-            # gulp's frame axis, let GSPMD partition every stage and insert
-            # any collectives (the TPU generalization of the reference's
-            # per-block gpu=N placement, reference: pipeline.py:365-366).
-            from ..parallel.scope import (shardable_nframe,
-                                          time_sharding,
-                                          time_axis_name,
-                                          time_axis_size)
-            taxis = self._headers[0]['_tensor']['shape'].index(-1)
-            if shardable_nframe(mesh, shape[taxis]):
-                if taxis == 0:
-                    # the spectrometer kernel is independent per time
-                    # step, so under a mesh it runs per-shard inside
-                    # shard_map on the frame axis; match at the
-                    # PER-SHARD shape (that is what each device
-                    # compiles and what kernel_usable must probe)
-                    nsh = time_axis_size(mesh)
-                    local = (shape[0] // nsh,) + tuple(shape[1:])
-                    spec_fn = match_spectrometer(
-                        self.stages, self._headers, local, dtype)
-                    if spec_fn is not None:
-                        self._set_impl(dict(
-                            spec_fn.info,
-                            mesh='shard_map[%d]' % nsh))
-                        import inspect
-                        from ..parallel.ops import _shard_map
-                        from jax.sharding import PartitionSpec
-                        sm = _shard_map()
-                        # the pallas body carries no varying-mesh-axis
-                        # metadata; disable the check under either API
-                        # generation (check_vma >= 0.8, check_rep before)
-                        params = inspect.signature(sm).parameters
-                        kw = {}
-                        if 'check_vma' in params:
-                            kw['check_vma'] = False
-                        elif 'check_rep' in params:
-                            kw['check_rep'] = False
-                        p = PartitionSpec(time_axis_name(mesh))
-                        sharded = sm(spec_fn, mesh=mesh, in_specs=p,
-                                     out_specs=p, **kw)
-                        return jax.jit(sharded), taxis
-                sharding = time_sharding(mesh, len(shape), taxis)
-                self._set_impl({'impl': 'xla-fused',
-                                'mesh': 'gspmd'})
-                return (jax.jit(composed, in_shardings=sharding),
-                        taxis)
-            self._set_impl({'impl': 'xla-fused'})
+        # Scale the whole fused chain over the scope's mesh: shard the
+        # gulp's frame axis, let GSPMD partition every stage and insert
+        # any collectives (the TPU generalization of the reference's
+        # per-block gpu=N placement, reference: pipeline.py:365-366).
+        from ..parallel.scope import (shardable_nframe,
+                                      time_sharding,
+                                      time_axis_name,
+                                      time_axis_size)
+        taxis = self._headers[0]['_tensor']['shape'].index(-1)
+        if shardable_nframe(mesh, shape[taxis]):
+            if taxis == 0:
+                # the spectrometer kernel is independent per time
+                # step, so under a mesh it runs per-shard inside
+                # shard_map on the frame axis; match at the
+                # PER-SHARD shape (that is what each device
+                # compiles and what kernel_usable must probe)
+                nsh = time_axis_size(mesh)
+                local = (shape[0] // nsh,) + tuple(shape[1:])
+                spec_fn = match_spectrometer(
+                    self.stages, self._headers, local, dtype)
+                if spec_fn is not None:
+                    self._set_impl(dict(
+                        spec_fn.info,
+                        mesh='shard_map[%d]' % nsh))
+                    import inspect
+                    from ..parallel.ops import _shard_map
+                    from jax.sharding import PartitionSpec
+                    sm = _shard_map()
+                    # the pallas body carries no varying-mesh-axis
+                    # metadata; disable the check under either API
+                    # generation (check_vma >= 0.8, check_rep before)
+                    params = inspect.signature(sm).parameters
+                    kw = {}
+                    if 'check_vma' in params:
+                        kw['check_vma'] = False
+                    elif 'check_rep' in params:
+                        kw['check_rep'] = False
+                    p = PartitionSpec(time_axis_name(mesh))
+                    sharded = sm(spec_fn, mesh=mesh, in_specs=p,
+                                 out_specs=p, **kw)
+                    return jax.jit(sharded), taxis
+            sharding = time_sharding(mesh, len(shape), taxis)
+            self._set_impl({'impl': 'xla-fused', 'mesh': 'gspmd'})
+            return (jax.jit(composed, in_shardings=sharding),
+                    taxis)
+        # mesh present but the gulp's frame count is not shardable:
+        # run unsharded
+        self._set_impl({'impl': 'xla-fused'})
         return jax.jit(composed), None
 
     def _set_impl(self, info):
         """Record + publish the configuration the built plan executes."""
         self.impl_info = dict(info)
         try:
-            self._impl_proclog.update(self.impl_info)
+            # force: plan rebuilds are rare, event-driven records — the
+            # per-gulp rate limit must not drop one (the published
+            # record would then describe a superseded plan)
+            self._impl_proclog.update(self.impl_info, force=True)
         except OSError:
             pass
 

@@ -368,14 +368,24 @@ class Fdmt(object):
 
     def _probe_key(self, shape, negative_delays):
         import jax
+        import zlib
         plan = self._plan
         try:
             backend = jax.default_backend()
         except Exception:
             backend = 'unknown'
-        return '%s|nchan=%d|md=%d|ndi=%d|T=%d|sgn=%d' % (
+        # hash the actual delay tables: plans with the same (nchan,
+        # max_delay) but different f0/df/exponent have different shift
+        # distributions (different rolls program size / gather
+        # locality) and must not share a measured winner
+        h = 0
+        for step in plan['steps']:
+            for arr in (step.d1, step.d2,
+                        step.passthrough.astype(np.int32)):
+                h = zlib.crc32(np.ascontiguousarray(arr).tobytes(), h)
+        return '%s|nchan=%d|md=%d|ndi=%d|T=%d|sgn=%d|tab=%08x' % (
             backend, plan['nchan'], plan['max_delay'], plan['nd_init'],
-            shape[-1], -1 if negative_delays else 1)
+            shape[-1], -1 if negative_delays else 1, h & 0xffffffff)
 
     def _probe_cores(self, cands, shape, negative_delays):
         """Measure every candidate core at ``shape`` (amortized: K

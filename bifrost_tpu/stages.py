@@ -505,6 +505,13 @@ def compose_stages(stages, headers, shape, dtype, substitute=True):
     """
     import jax
     from functools import reduce as _reduce
+    if substitute:
+        # check the whole-chain substitution first: when it matches,
+        # the per-stage functions below would be built only to be
+        # discarded
+        plan = match_spectrometer(stages, headers, shape, dtype)
+        if plan is not None:
+            return plan, plan.info
     fns = []
     cur = jax.ShapeDtypeStruct(tuple(shape), dtype)
     for stage, ihdr in zip(stages, headers[:-1]):
@@ -514,10 +521,6 @@ def compose_stages(stages, headers, shape, dtype, substitute=True):
         fn = stage.build(meta)
         fns.append(fn)
         cur = jax.eval_shape(fn, cur)
-    if substitute:
-        plan = match_spectrometer(stages, headers, shape, dtype)
-        if plan is not None:
-            return plan, plan.info
     composed = lambda x: _reduce(lambda v, f: f(v), fns, x)
     return composed, {'impl': 'xla-fused'}
 

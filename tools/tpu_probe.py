@@ -17,6 +17,17 @@ def main():
     result = {}
 
     def probe():
+        # import bifrost_tpu first: its __init__ honors JAX_PLATFORMS
+        # under PJRT plugins that ignore the env var (same reason
+        # bench.py imports it before jax) — the probe must gate on the
+        # SAME backend the bench will use
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        try:
+            import bifrost_tpu  # noqa: F401
+        except ImportError:
+            pass
         import jax
         devs = jax.devices()
         import jax.numpy as jnp
