@@ -699,7 +699,7 @@ def run_suite_into(result):
                               if k in smoke}
     detail['pallas_smoke'] = smoke
 
-    name = 'BENCH_SUITE_r04.json' if platform == 'tpu' \
+    name = 'BENCH_SUITE_r05.json' if platform == 'tpu' \
         else 'BENCH_SUITE_%s_validation.json' % platform
     try:
         with open(os.path.join(here, name), 'w') as f:

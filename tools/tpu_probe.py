@@ -1,9 +1,11 @@
 """Probe the accelerator backend with a hard deadline.
 
 Prints one JSON line {"alive": bool, "init_s": float, "platform": str}
-and exits 0 when the backend initializes within the deadline, 3
-otherwise.  Used by bench.py's retry loop and by round automation to
-decide when the tunneled chip is healthy enough for a capture session.
+and exits 0 only when the backend BOTH initializes within the deadline
+AND passes a bf16 matmul correctness gate (a chip that initializes but
+miscomputes must not trigger a bench capture); exits 3 otherwise.
+Used by bench.py's retry loop and by round automation to decide when
+the tunneled chip is healthy enough for a capture session.
 """
 import json
 import os
@@ -32,20 +34,27 @@ def main():
         devs = jax.devices()
         import jax.numpy as jnp
         x = jnp.ones((256, 256), jnp.bfloat16)
-        y = float(jnp.sum(x @ x))
+        # accumulate the check sum in f32: a backend that reduces in
+        # bf16 would round 2^24 + 256 terms and fail an exact compare
+        # while being perfectly healthy
+        y = float(jnp.sum(x @ x, dtype=jnp.float32))
+        expected = 256.0 * 256 * 256
         result['platform'] = devs[0].platform
         result['n_devices'] = len(devs)
-        result['matmul_ok'] = (y == 256.0 * 256 * 256)
+        result['matmul_ok'] = abs(y - expected) <= 1e-3 * expected
 
     import threading
     t = threading.Thread(target=probe, daemon=True)
     t.start()
     t.join(deadline)
     init_s = round(time.time() - t0, 1)
-    if result.get('platform'):
+    if result.get('platform') and result.get('matmul_ok'):
         print(json.dumps(dict(result, alive=True, init_s=init_s)))
         return 0
-    print(json.dumps({'alive': False, 'init_s': init_s}))
+    # preserve whatever the probe did collect: a live-but-miscomputing
+    # chip (platform set, matmul_ok false) must be distinguishable in
+    # watch logs from a 120 s init hang (nothing set)
+    print(json.dumps(dict(result, alive=False, init_s=init_s)))
     return 3
 
 
