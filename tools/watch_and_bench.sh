@@ -28,6 +28,9 @@ for i in $(seq 1 400); do
       echo "$(date -u +%FT%TZ) capture OK" >> "$LOG"
       exit 0
     fi
+    # never leave a truncated artifact where round automation could
+    # commit it as if it were real
+    rm -f BENCH_r05.json.tmp
     echo "$(date -u +%FT%TZ) bench attempt failed; continuing watch" >> "$LOG"
   fi
   sleep 240
