@@ -234,6 +234,7 @@ def bench_beamform(ceil):
     import jax.numpy as jnp
     from jax import lax
     from bifrost_tpu.xfer import to_device
+    from bifrost_tpu.ops.linalg import _AB_IMPLS
     A, B, F, T = 256, 64, 512, 512
     rng = np.random.RandomState(0)
     # complex inputs MUST go through xfer (re/im planes): a raw complex
@@ -248,23 +249,54 @@ def bench_beamform(ceil):
     # K beamform applications inside one jitted fori_loop: a single
     # dispatch amortizes the tunnel latency (matching measure_ceilings'
     # methodology).  The weights are perturbed per pass so XLA cannot
-    # hoist the einsum out of the loop; the carry keeps only the last
+    # hoist the GEMM out of the loop; the carry keeps only the last
     # result (write traffic ~= one output per pass).
+    #
+    # Every framework AB path is measured (VERDICT r4 item 2): the XLA
+    # interleaved-complex dot vs the planar Karatsuba 3-matmul vs the
+    # bf16 hi-lo split (ops.linalg docstring; the reference's analogous
+    # move is the hand cherk below n=896, src/linalg.cu:210-226).
     K = 16 if jax.default_backend() == 'tpu' else 2
-
-    def body(i, carry):
-        # i-dependent weights + a carry contribution keep every pass
-        # live (no loop-invariant hoisting, no dead-iteration elision)
-        wi = w + (1e-7j * i)
-        return jnp.einsum('ba,taf->tbf', wi, v,
-                          preferred_element_type=jnp.complex64) \
-            + 1e-30 * carry
-
-    x0 = jnp.zeros((T, B, F), jnp.complex64)
-    fn = jax.jit(lambda x: lax.fori_loop(0, K, body, x))
-    t = _bench_fn(fn, x0, iters=4) / K
     flops = 8 * T * B * A * F           # complex MAC = 8 real flops
-    tf = flops / t / 1e12
+    per_impl = {}
+    oracle = None
+    for impl_name, impl_fn in sorted(_AB_IMPLS.items()):
+        def body(i, carry, impl_fn=impl_fn):
+            wi = w + (1e-7j * i)
+            return impl_fn(wi, v, None, 1.0, 0.0) + 1e-30 * carry
+
+        x0 = jnp.zeros((T, B, F), jnp.complex64)
+        fn = jax.jit(lambda x, body=body: lax.fori_loop(0, K, body, x))
+        try:
+            y = fn(x0)
+            t = _bench_fn(fn, x0, iters=4) / K
+        except Exception as e:
+            per_impl[impl_name] = {'error': '%s: %s'
+                                   % (type(e).__name__, str(e)[:120])}
+            continue
+        # cross-impl agreement: numerical drift between paths would
+        # invalidate the speed comparison
+        if oracle is None:
+            oracle = np.asarray(y[:2, :2, :8])
+        else:
+            err = float(np.max(np.abs(np.asarray(y[:2, :2, :8])
+                                      - oracle)))
+            sc = float(np.max(np.abs(oracle))) or 1.0
+            per_impl.setdefault('_agreement', {})[impl_name] = \
+                round(err / sc, 7)
+        per_impl[impl_name] = {'tflops': round(flops / t / 1e12, 2),
+                               'ms': round(t * 1e3, 3)}
+    timed = {k: v for k, v in per_impl.items()
+             if isinstance(v, dict) and 'tflops' in v}
+    if not timed:
+        return {'config': 'beamform GEMM Nant=%d Nbeam=%d Nchan=%d T=%d'
+                          % (A, B, F, T),
+                'error': 'all impls failed', 'per_impl': per_impl}
+    # key on raw time, not the display-rounded throughput (which ties
+    # at low absolute rates and would pick by dict order)
+    best = min(timed, key=lambda k: timed[k]['ms'])
+    tf = timed[best]['tflops']
+    t = timed[best]['ms'] / 1e3
     # this shape is bandwidth-dominated: each pass reads v and the
     # carry (both c64) and writes the (T, B, F) result
     bytes_pass = (T * A * F + 2 * T * B * F) * 8
@@ -273,15 +305,18 @@ def bench_beamform(ceil):
         'config': 'beamform GEMM Nant=%d Nbeam=%d Nchan=%d T=%d'
                   % (A, B, F, T),
         'value': tf, 'unit': 'TFLOPS',
+        'impl': best,
         'roofline': {
             'achieved_tflops': tf,
+            'per_impl': per_impl,
             'matmul_f32_tflops': ceil['matmul_f32_tflops'],
+            'matmul_bf16_tflops': ceil.get('matmul_bf16_tflops'),
             'mfu': tf / ceil['matmul_f32_tflops'],
             'achieved_GBs': bw,
             'hbm_GBs': ceil['hbm_gbs'],
             'bw_frac': bw / ceil['hbm_gbs'],
-            'bound': 'HBM bandwidth at Nbeam=64 (voltage read '
-                     'dominates; complex GEMM rides the MXU)'},
+            'bound': 'best framework AB path at Nbeam=64 (see '
+                     'per_impl for the XLA/planar/hi-lo comparison)'},
     }
 
 
@@ -303,36 +338,55 @@ def bench_correlate_ci8(ceil):
     rng = np.random.RandomState(0)
     re = jnp.asarray(rng.randint(-64, 64, (T, F, S * P)).astype(np.int8))
     im = jnp.asarray(rng.randint(-64, 64, (T, F, S * P)).astype(np.int8))
-
-    def corr(re, im):
-        rr = jnp.einsum('tfi,tfj->fij', re, re,
-                        preferred_element_type=jnp.int32)
-        ii = jnp.einsum('tfi,tfj->fij', im, im,
-                        preferred_element_type=jnp.int32)
-        k = jnp.einsum('tfi,tfj->fij', im, re,
-                       preferred_element_type=jnp.int32)
-        return (rr + ii).astype(jnp.float32), \
-               (k - jnp.swapaxes(k, -1, -2)).astype(jnp.float32)
-
-    def body(i, carry):
-        # feed a carry-dependent zero into the operand: float 0*x is
-        # not algebraically foldable (NaN semantics), so the einsums
-        # gain a true loop-carried dependency — no hoisting, no
-        # dead-iteration elision — while the int8 values stay exact
-        # (carry is finite) and the zero-add fuses into the dot
-        # operand read (no extra traffic)
-        r = re + (carry[0, 0, 0] * jnp.float32(0.0)).astype(jnp.int8)
-        a, b = corr(r, im)
-        return 0.5 * carry + a + b
-
-    x0 = jnp.zeros((F, S * P, S * P), jnp.float32)
-    fn = jax.jit(lambda x: lax.fori_loop(0, K, body, x))
-    t = _bench_fn(fn, x0, iters=3) / K
     n = S * P
-    macs = 3 * T * F * n * n            # 3-matmul complex-int8 trick
-    tops = 2 * macs / t / 1e12
-    # xGPU-style metric: complex-MAC/s of the full correlation
-    cmacs = T * F * n * n / t / 1e12
+
+    # every framework auto-correlation layout is measured (VERDICT r4
+    # item 2): einsum contraction vs pre-transposed batched GEMM vs the
+    # widened [re;im] gram matmul (ops.linalg._XCORR_AUTO_IMPLS; the
+    # reference's analogue is the hand cherk, src/linalg.cu:210-226)
+    from bifrost_tpu.ops.linalg import _XCORR_AUTO_IMPLS
+    per_impl = {}
+    for impl_name, impl_fn in sorted(_XCORR_AUTO_IMPLS.items()):
+        def body(i, carry, impl_fn=impl_fn):
+            # feed a carry-dependent zero into the operand: float 0*x
+            # is not algebraically foldable (NaN semantics), so the
+            # GEMMs gain a true loop-carried dependency — no hoisting,
+            # no dead-iteration elision — while the int8 values stay
+            # exact (carry is finite)
+            r = re + (carry[0, 0, 0] * jnp.float32(0.0)).astype(jnp.int8)
+            vis = impl_fn(r, im, r, im)
+            return 0.5 * carry + vis.real + vis.imag
+
+        x0 = jnp.zeros((F, n, n), jnp.float32)
+        fn = jax.jit(lambda x, body=body: lax.fori_loop(0, K, body, x))
+        try:
+            t = _bench_fn(fn, x0, iters=3) / K
+        except Exception as e:
+            per_impl[impl_name] = {'error': '%s: %s'
+                                   % (type(e).__name__, str(e)[:120])}
+            continue
+        # impl-independent xGPU-style metric: complex-MAC/s
+        cm = T * F * n * n / t / 1e12
+        # actual int MACs issued: the Hermitian 3-matmul forms issue
+        # 3; the cross forms and the widened gram issue 4
+        mac_mult = 3 if impl_name.endswith('3') else 4
+        per_impl[impl_name] = {
+            'cmacs_T': round(cm, 2), 'ms': round(t * 1e3, 3),
+            'issued_tops': round(2 * mac_mult * T * F * n * n / t
+                                 / 1e12, 2)}
+    timed = {k: v for k, v in per_impl.items() if 'cmacs_T' in v}
+    if not timed:
+        return {'config': 'correlation ci8 Nant=%d Npol=%d Nchan=%d T=%d'
+                          % (S, P, F, T),
+                'error': 'all impls failed', 'per_impl': per_impl}
+    # key on raw time, not the display-rounded rate (ties at low
+    # absolute rates would pick by dict order)
+    best = min(timed, key=lambda k: timed[k]['ms'])
+    t = timed[best]['ms'] / 1e3
+    cmacs = timed[best]['cmacs_T']
+    # cross-round comparable value: TOPS on the 3-matmul basis (r3's
+    # unit), regardless of which impl won
+    tops = 2 * 3 * T * F * n * n / t / 1e12
     # traffic per integration: voltage planes in (int8), visibility
     # accumulator read + write (f32)
     bytes_pass = (2 * T * F * n) + (2 * F * n * n * 4)
@@ -340,17 +394,20 @@ def bench_correlate_ci8(ceil):
     return {
         'config': 'correlation ci8 Nant=%d Npol=%d Nchan=%d T=%d'
                   % (S, P, F, T),
-        'value': tops, 'unit': 'int8 TOPS (3-matmul path)',
+        'value': tops, 'unit': 'int8 TOPS (3-matmul basis)',
+        'impl': best,
         'roofline': {
             'achieved_tops': tops,
+            'per_impl': per_impl,
             'matmul_int8_tops': ceil['matmul_int8_tops'],
             'mfu': tops / ceil['matmul_int8_tops'],
             'achieved_GBs': bw,
             'hbm_GBs': ceil['hbm_gbs'],
             'bw_frac': bw / ceil['hbm_gbs'],
             'cmacs_T': cmacs,
-            'bound': 'MXU int8 compute vs visibility-write bandwidth '
-                     '(T=512 integration balances them)'},
+            'bound': 'best framework layout (see per_impl for '
+                     'einsum/fmt/gram); MXU int8 vs visibility-write '
+                     'bandwidth'},
     }
 
 

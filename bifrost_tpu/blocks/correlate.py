@@ -24,22 +24,14 @@ def _cross_block(x, xg, reim):
     -> (F, Sr, P, S, P)."""
     import jax.numpy as jnp
     if reim:
+        from ..ops.linalg import xcorr_int8
         t, f, sr, p = x.shape[:4]
         s = xg.shape[2]
         re_i = x[..., 0].reshape(t, f, sr * p)
         im_i = x[..., 1].reshape(t, f, sr * p)
         re_j = xg[..., 0].reshape(t, f, s * p)
         im_j = xg[..., 1].reshape(t, f, s * p)
-        rr = jnp.einsum('tfi,tfj->fij', re_i, re_j,
-                        preferred_element_type=jnp.int32)
-        ii = jnp.einsum('tfi,tfj->fij', im_i, im_j,
-                        preferred_element_type=jnp.int32)
-        ir = jnp.einsum('tfi,tfj->fij', im_i, re_j,
-                        preferred_element_type=jnp.int32)
-        ri = jnp.einsum('tfi,tfj->fij', re_i, im_j,
-                        preferred_element_type=jnp.int32)
-        vis = (rr + ii).astype(jnp.float32) + \
-            1j * (ir - ri).astype(jnp.float32)
+        vis = xcorr_int8(re_i, im_i, re_j, im_j)
         return vis.reshape(f, sr, p, s, p)
     t, f, sr, p = x.shape
     s = xg.shape[2]
@@ -91,7 +83,49 @@ class CorrelateBlock(TransformBlock):
                 "(%d)" % (gulp_actual, self.nframe_per_integration))
         ohdr['gulp_nframe'] = min(ihdr['gulp_nframe'],
                                   self.nframe_per_integration)
+        self._prewarm_xcorr(itensor, gulp_actual)
         return ohdr
+
+    def _prewarm_xcorr(self, itensor, gulp_nframe):
+        """Probe the xcorr layout winner for this sequence's gulp shape
+        now, so on_data's jit trace (where measuring is impossible)
+        finds it in the cache — probe cost must not land as first-gulp
+        latency in a capture pipeline."""
+        from ..dtype import DataType
+        dt = DataType(itensor['dtype'])
+        if not (dt.kind == 'ci' and dt.nbits == 8):
+            return
+        from ..ops.linalg import xcorr_prewarm
+        _, f, s, p = itensor['shape'][:4]
+        n = s * p
+        try:
+            mesh = self.mesh
+            t_eff = gulp_nframe
+            if mesh is None:
+                xcorr_prewarm(t_eff, f, n)
+                return
+            # mirror _build's mesh sharding: inside shard_map the
+            # traced xcorr sees the per-shard time slice (and, with a
+            # station axis, the per-shard row block vs the gathered
+            # column axis)
+            from ..parallel.scope import (time_axis_name,
+                                          station_axis_name,
+                                          shardable_nframe)
+            if not shardable_nframe(mesh, gulp_nframe):
+                # _build falls through to the plain path: auto shape
+                # at the full gulp
+                xcorr_prewarm(t_eff, f, n)
+                return
+            t_eff = gulp_nframe // mesh.shape[time_axis_name(mesh)]
+            sname = station_axis_name(mesh)
+            if sname is not None and mesh.shape[sname] > 1 \
+                    and s % mesh.shape[sname] == 0:
+                sr = s // mesh.shape[sname]
+                xcorr_prewarm(t_eff, f, sr * p, n)
+            else:
+                xcorr_prewarm(t_eff, f, n)
+        except Exception:
+            pass    # probing is best-effort; the traced default works
 
     def _build(self, shape, dtype, reim, acc_is_none):
         import jax
@@ -99,18 +133,14 @@ class CorrelateBlock(TransformBlock):
 
         def local_vis(x):
             if reim:
-                # int8 MXU path: x (T, F, S, P, 2)
+                # int8 MXU path: x (T, F, S, P, 2); layout/kernel
+                # choice (einsum / pre-transposed GEMM / widened gram)
+                # is measured, see ops.linalg.xcorr_int8
+                from ..ops.linalg import xcorr_int8
                 t, f, s, p = x.shape[:4]
                 re = x[..., 0].reshape(t, f, s * p)
                 im = x[..., 1].reshape(t, f, s * p)
-                rr = jnp.einsum('tfi,tfj->fij', re, re,
-                                preferred_element_type=jnp.int32)
-                ii = jnp.einsum('tfi,tfj->fij', im, im,
-                                preferred_element_type=jnp.int32)
-                k = jnp.einsum('tfi,tfj->fij', im, re,
-                               preferred_element_type=jnp.int32)
-                vis = (rr + ii).astype(jnp.float32) + \
-                    1j * (k - jnp.swapaxes(k, -1, -2)).astype(jnp.float32)
+                vis = xcorr_int8(re, im)
                 vis = vis.reshape(f, s, p, s, p)
             else:
                 t, f, s, p = x.shape

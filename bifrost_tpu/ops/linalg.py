@@ -6,15 +6,38 @@ Two operations, mirroring bfLinAlgMatMul:
 - ``c = alpha * a @ b + beta * c``      (beamforming GEMM)
 - ``c = alpha * a @ a^H + beta * c``    (correlation, when b is None)
 
-The reference ships custom xGPU-style small-N kernels and a Cherk3mEx
-int8 path (reference: src/linalg.cu:130-148, 210-226).  On TPU the MXU
-natively multiplies int8 with int32 accumulation, so the complex-int8
-correlation is expressed as real int8 matmuls via the 3-multiply (Karatsuba)
-trick — the same trick Cherk3mEx uses — with
-``preferred_element_type=int32``, then scaled into the output dtype.
+The reference's identity here is hand-beating library kernels: a custom
+cherk below n=896 and a dp4a int8 path (reference: src/linalg.cu:210-226,
+src/linalg_kernels.cu:55).  The TPU equivalents implemented here:
+
+- **Planar complex GEMM.**  XLA lowers an interleaved complex64 dot to
+  real dots over de-interleaved copies; computing directly on separate
+  re/im planes with the Karatsuba 3-multiply skips that materialization
+  and one full real matmul: m1 = ar@br, m2 = ai@bi, m3 = (ar+ai)@(br+bi)
+  -> (m1-m2) + i(m3-m1-m2).
+- **bf16 hi-lo split.**  f32 operands split as x = hi + lo (two bf16
+  planes); x@y ~= hi@yh + (hi@yl + lo@yh), three bf16 MXU passes with
+  f32 accumulation — ~f32 result accuracy at the bf16 MXU rate,
+  dropping only the lo@lo term (~2^-16 relative).  This is the MXU
+  analogue of the reference's "compute in a cheaper type without losing
+  the answer" Cherk3mEx trick.
+- **Widened int8 gram.**  The ci8 a@a^H needs rr+ii and K-K^T
+  (K = im@re^T).  Either three int8 matmuls (the Cherk3mEx 3-multiply),
+  or ONE (2n, k)@(k, 2n) int8 matmul of the stacked [re; im] planes
+  whose 4 blocks contain every term — 4/3 the MACs but a single big
+  MXU-shaped kernel.  Which wins depends on XLA's lowering, so it is
+  measured (ops.mprobe), never asserted.
+
+Every implementation is exact-int (i8 paths) or accuracy-gated (float
+paths: before the speed race, each candidate's on-device deviation
+from the XLA baseline at the actual shape must stay inside the bf16
+accuracy class — see LinAlg._GATE_RTOL).  BF_LINALG_AB_IMPL /
+BF_LINALG_AAH_IMPL / BF_LINALG_I8_IMPL force a path.
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -22,7 +45,7 @@ from ..dtype import DataType
 from .common import as_jax, logical_dtype
 from .fft import _writeback
 
-__all__ = ['LinAlg', 'matmul']
+__all__ = ['LinAlg', 'matmul', 'xcorr_int8', 'xcorr_prewarm']
 
 
 def _int8_reim(x):
@@ -42,61 +65,321 @@ def _int8_reim(x):
     return None
 
 
-class LinAlg(object):
-    """Plan-style wrapper (reference: python/bifrost/linalg.py)."""
+# ---------------------------------------------------------------------------
+# real-matmul building blocks
+# ---------------------------------------------------------------------------
 
-    def __init__(self):
+def _mm_f32(a, b):
+    import jax.numpy as jnp
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+
+
+def _split_hilo(x):
+    """f32 -> (hi, lo) bf16 planes with x == hi + lo up to bf16(lo)
+    rounding (lo captures the next 8 mantissa bits)."""
+    import jax.numpy as jnp
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _mm_hilo(a, b):
+    """f32-accuracy-class matmul as three bf16 MXU passes with f32
+    accumulation (drops the lo@lo term, ~2^-16 relative)."""
+    import jax.numpy as jnp
+    ah, al = _split_hilo(a)
+    bh, bl = _split_hilo(b)
+    f32 = jnp.float32
+    return (jnp.matmul(ah, bh, preferred_element_type=f32)
+            + (jnp.matmul(ah, bl, preferred_element_type=f32)
+               + jnp.matmul(al, bh, preferred_element_type=f32)))
+
+
+def _cmm_planar(ar, ai, br, bi, mm):
+    """Complex matmul on planes, Karatsuba 3-multiply."""
+    m1 = mm(ar, br)
+    m2 = mm(ai, bi)
+    m3 = mm(ar + ai, br + bi)
+    return m1 - m2, m3 - m1 - m2
+
+
+def _planes(x):
+    import jax.numpy as jnp
+    if jnp.iscomplexobj(x):
+        return jnp.real(x), jnp.imag(x)
+    return x, None
+
+
+# ---------------------------------------------------------------------------
+# a @ b implementations (complex-capable GEMM)
+# ---------------------------------------------------------------------------
+
+def _ab_xla(a, b, c, alpha, beta):
+    import jax.numpy as jnp
+    acc = jnp.complex64 if jnp.iscomplexobj(a) or jnp.iscomplexobj(b) \
+        else jnp.float32
+    y = alpha * jnp.matmul(a, b, preferred_element_type=acc)
+    if beta != 0 and c is not None:
+        y = y + beta * c
+    return y
+
+
+def _ab_planar_with(mm):
+    def impl(a, b, c, alpha, beta):
+        import jax.numpy as jnp
+        ar, ai = _planes(a)
+        br, bi = _planes(b)
+        if ai is None and bi is None:
+            y = alpha * mm(ar, br).astype(jnp.float32)
+        else:
+            if ai is None:
+                yr, yi = mm(ar, br), mm(ar, bi)
+            elif bi is None:
+                yr, yi = mm(ar, br), mm(ai, br)
+            else:
+                yr, yi = _cmm_planar(ar, ai, br, bi, mm)
+            y = alpha * (yr + 1j * yi)
+        if beta != 0 and c is not None:
+            y = y + beta * c
+        return y
+    return impl
+
+
+_AB_IMPLS = {
+    'xla': _ab_xla,
+    'planar': _ab_planar_with(_mm_f32),
+    'planar_hilo': _ab_planar_with(_mm_hilo),
+}
+
+
+# ---------------------------------------------------------------------------
+# a @ a^H implementations (complex float)
+# ---------------------------------------------------------------------------
+
+def _aah_xla(a, c, alpha, beta):
+    import jax.numpy as jnp
+    y = alpha * jnp.matmul(a, jnp.conj(jnp.swapaxes(a, -1, -2)),
+                           preferred_element_type=jnp.complex64)
+    if beta != 0 and c is not None:
+        y = y + beta * c
+    return y
+
+
+def _aah_planar_with(mm):
+    def impl(a, c, alpha, beta):
+        import jax.numpy as jnp
+        ar, ai = _planes(a)
+        arT = jnp.swapaxes(ar, -1, -2)
+        if ai is None:
+            y = (alpha * mm(ar, arT)).astype(jnp.complex64)
+        else:
+            aiT = jnp.swapaxes(ai, -1, -2)
+            rr = mm(ar, arT)
+            ii = mm(ai, aiT)
+            k = mm(ai, arT)
+            y = alpha * ((rr + ii) +
+                         1j * (k - jnp.swapaxes(k, -1, -2)))
+        if beta != 0 and c is not None:
+            y = y + beta * c
+        return y
+    return impl
+
+
+_AAH_IMPLS = {
+    'xla': _aah_xla,
+    'planar': _aah_planar_with(_mm_f32),
+    'planar_hilo': _aah_planar_with(_mm_hilo),
+}
+
+
+# ---------------------------------------------------------------------------
+# int8 a @ a^H implementations (ci8 correlation)
+# ---------------------------------------------------------------------------
+
+def _aah_i8_3mm(re, im, c, alpha, beta):
+    """Three real int8 MXU matmuls, int32 accumulation:
+    A A^H = (re.re^T + im.im^T) + i(K - K^T),  K = im.re^T
+    (the Cherk3mEx reduction; reference: src/linalg.cu:130-148)."""
+    import jax.numpy as jnp
+    reT = jnp.swapaxes(re, -1, -2)
+    imT = jnp.swapaxes(im, -1, -2)
+    rr = jnp.matmul(re, reT, preferred_element_type=jnp.int32)
+    ii = jnp.matmul(im, imT, preferred_element_type=jnp.int32)
+    k = jnp.matmul(im, reT, preferred_element_type=jnp.int32)
+    y = (rr + ii).astype(jnp.float32) + \
+        1j * (k - jnp.swapaxes(k, -1, -2)).astype(jnp.float32)
+    y = alpha * y
+    if beta != 0 and c is not None:
+        y = y + beta * c
+    return y
+
+
+def _aah_i8_gram(re, im, c, alpha, beta):
+    """ONE widened int8 matmul: stack z = [re; im] on the row axis and
+    take z @ z^T; its 4 blocks hold rr, ri, ir, ii.  4/3 the MACs of
+    the 3-multiply but a single large MXU-shaped kernel; int32
+    accumulation keeps it exact.  yi needs no transpose: the ri block
+    IS K^T."""
+    import jax.numpy as jnp
+    n = re.shape[-2]
+    z = jnp.concatenate([re, im], axis=-2)
+    g = jnp.matmul(z, jnp.swapaxes(z, -1, -2),
+                   preferred_element_type=jnp.int32)
+    rr = g[..., :n, :n]
+    ri = g[..., :n, n:]     # re.im^T == K^T
+    ir = g[..., n:, :n]     # im.re^T == K
+    ii = g[..., n:, n:]
+    y = (rr + ii).astype(jnp.float32) + 1j * (ir - ri).astype(jnp.float32)
+    y = alpha * y
+    if beta != 0 and c is not None:
+        y = y + beta * c
+    return y
+
+
+_I8_IMPLS = {
+    'i8_3mm': _aah_i8_3mm,
+    'i8_gram': _aah_i8_gram,
+}
+
+
+def _force_env(var, allowed):
+    v = os.environ.get(var, '').strip().lower()
+    return v if v in allowed else None
+
+
+def _probe_wanted():
+    """Single source of truth for BF_LINALG_PROBE semantics: probe on
+    TPU unless '0', probe anywhere when '1'."""
+    probe_env = os.environ.get('BF_LINALG_PROBE', '').strip()
+    if probe_env == '1':
+        return True
+    if probe_env == '0':
+        return False
+    try:
         import jax
-        self._jit_ab = jax.jit(self._ab, static_argnames=('alpha', 'beta'))
-        self._jit_aah = jax.jit(self._aah, static_argnames=('alpha', 'beta'))
-        self._jit_aah_i8 = jax.jit(self._aah_int8,
-                                   static_argnames=('alpha', 'beta'))
+        return jax.default_backend() == 'tpu'
+    except Exception:
+        return False
+
+
+class LinAlg(object):
+    """Plan-style wrapper (reference: python/bifrost/linalg.py).
+
+    Implementation selection per call family: an env override wins
+    (BF_LINALG_AB_IMPL / BF_LINALG_AAH_IMPL / BF_LINALG_I8_IMPL);
+    otherwise on TPU the candidates are measured at the actual shape
+    and the winner cached (ops.mprobe policy); off-TPU the XLA path is
+    used (CPU lowering has no interleaved-complex penalty to dodge).
+    Float-path candidates are accuracy-gated before any timing: an
+    impl deviating from the XLA baseline by more than _GATE_RTOL
+    relative at the actual shape is excluded."""
+
+    def __init__(self, ab_impl=None, aah_impl=None, i8_impl=None):
+        self._force = {
+            'ab': ab_impl or _force_env('BF_LINALG_AB_IMPL', _AB_IMPLS),
+            'aah': aah_impl or _force_env('BF_LINALG_AAH_IMPL',
+                                          _AAH_IMPLS),
+            'i8': i8_impl or _force_env('BF_LINALG_I8_IMPL', _I8_IMPLS),
+        }
+        self.chosen = {}
+        self.probe_ms = {}
+        self._jits = {}
+
+    def _jit(self, family, name):
+        import jax
+        key = (family, name)
+        fn = self._jits.get(key)
+        if fn is None:
+            impls = {'ab': _AB_IMPLS, 'aah': _AAH_IMPLS,
+                     'i8': _I8_IMPLS}[family]
+            fn = jax.jit(impls[name], static_argnames=('alpha', 'beta'))
+            self._jits[key] = fn
+        return fn
+
+    def _pick(self, family, shapes_key, candidates, make_args,
+              gate=False):
+        """Winner for this call family at this shape.  ``make_args``
+        returns the positional operands WITHOUT alpha/beta/c — the
+        probe times the alpha=1, beta=0 form of each candidate.
+
+        With ``gate=True`` (complex float families) the candidates are
+        accuracy-gated before timing.  Both the gate and the timing run
+        at most once per (family, shape): a cached winner (in-process
+        or on disk) is returned without executing any candidate, so the
+        steady-state gulp loop pays only dict lookups."""
+        if self._force[family]:
+            self.chosen[family] = self._force[family]
+            return self._force[family]
+        if _probe_wanted() and len(candidates) > 1:
+            from . import mprobe
+            cached = mprobe.peek('linalg_%s' % family, shapes_key)
+            if cached is not None and cached[0] in candidates:
+                self.chosen[family] = cached[0]
+                self.probe_ms[family] = cached[1]
+                return cached[0]
+            probe_fns = {
+                n: (lambda f: lambda *a: f(*a, None, alpha=1.0,
+                                           beta=0.0))(
+                    self._jit(family, n))
+                for n in candidates}
+            persist = True
+            if gate:
+                keep, had_errors = self._accuracy_gate(probe_fns,
+                                                       make_args)
+                probe_fns = {n: probe_fns[n] for n in keep}
+                persist = not had_errors
+            winner, ms, _err = mprobe.select(
+                'linalg_%s' % family, shapes_key, probe_fns, make_args,
+                persist=persist)
+            if winner is not None:
+                self.chosen[family] = winner
+                self.probe_ms[family] = ms
+                return winner
+        default = {'ab': 'xla', 'aah': 'xla', 'i8': 'i8_3mm'}[family]
+        self.chosen[family] = default
+        return default
+
+    # a candidate deviating from the XLA baseline by more than this
+    # (relative, at the actual shape) is excluded from the speed race:
+    # the bound admits the hi-lo split's legitimate ~2^-16 truncation
+    # while catching a broken lowering outright
+    _GATE_RTOL = 1e-3
 
     @staticmethod
-    def _ab(a, b, c, alpha, beta):
+    def _accuracy_gate(impls, make_args, base='xla'):
+        """(keep, had_errors): candidates whose on-device deviation
+        from the XLA baseline at the actual shape stays inside the
+        bf16 accuracy class (_GATE_RTOL relative).  Runs once per
+        (family, shape) — only when no cached winner exists.
+        ``had_errors`` is True when any candidate raised (e.g. a
+        transient OOM): the caller must not freeze a winner chosen
+        from the reduced field to disk."""
         import jax.numpy as jnp
-        acc = jnp.complex64 if jnp.iscomplexobj(a) or jnp.iscomplexobj(b) \
-            else jnp.float32
-        y = alpha * jnp.matmul(a, b, preferred_element_type=acc)
-        if beta != 0 and c is not None:
-            y = y + beta * c
-        return y
+        args = make_args()
+        outs = {}
+        had_errors = False
+        for name, fn in impls.items():
+            try:
+                outs[name] = fn(*args)
+            except Exception:
+                had_errors = True
+        if base not in outs:
+            return list(outs), had_errors
+        ref = outs[base]
+        scale = float(jnp.max(jnp.abs(ref))) or 1.0
+        keep = []
+        for name, y in outs.items():
+            err = float(jnp.max(jnp.abs(y - ref))) / scale
+            if err <= LinAlg._GATE_RTOL:
+                keep.append(name)
+        return keep, had_errors
 
-    @staticmethod
-    def _aah(a, c, alpha, beta):
-        import jax.numpy as jnp
-        y = alpha * jnp.matmul(a, jnp.conj(jnp.swapaxes(a, -1, -2)),
-                               preferred_element_type=jnp.complex64)
-        if beta != 0 and c is not None:
-            y = y + beta * c
-        return y
-
-    @staticmethod
-    def _aah_int8(re, im, c, alpha, beta):
-        """Complex Hermitian rank-k update from int8 re/im planes with
-        three real int8 MXU matmuls, int32 accumulation:
-
-            A A^H = (re·reᵀ + im·imᵀ) + i(K - Kᵀ),   K = im·reᵀ
-
-        The Hermitian structure makes the cross term a single multiply —
-        the same reduction the reference's Cherk3mEx exploits
-        (reference: src/linalg.cu:130-148)."""
-        import jax.numpy as jnp
-        reT = jnp.swapaxes(re, -1, -2)
-        imT = jnp.swapaxes(im, -1, -2)
-        rr = jnp.matmul(re, reT, preferred_element_type=jnp.int32)
-        ii = jnp.matmul(im, imT, preferred_element_type=jnp.int32)
-        k = jnp.matmul(im, reT, preferred_element_type=jnp.int32)
-        y = (rr + ii).astype(jnp.float32) + \
-            1j * (k - jnp.swapaxes(k, -1, -2)).astype(jnp.float32)
-        y = alpha * y
-        if beta != 0 and c is not None:
-            y = y + beta * c
-        return y
+    # -- public API ---------------------------------------------------------
 
     def matmul(self, alpha, a, b, beta, c):
         """c = alpha*a@b + beta*c, or a@a^H when b is None
         (reference: bfLinAlgMatMul, src/linalg.cu:877)."""
+        import jax.numpy as jnp
         alpha = complex(alpha) if np.iscomplexobj(np.asarray(alpha)) \
             else float(alpha)
         beta = complex(beta) if np.iscomplexobj(np.asarray(beta)) \
@@ -105,17 +388,30 @@ class LinAlg(object):
         if b is None:
             reim = _int8_reim(a)
             if reim is not None:
-                y = self._jit_aah_i8(reim[0], reim[1], cj,
-                                     alpha=alpha, beta=beta)
+                re, im = reim
+                name = self._pick('i8', 'shape=%s' % (re.shape,),
+                                  _I8_IMPLS, lambda: (re, im))
+                y = self._jit('i8', name)(re, im, cj,
+                                          alpha=alpha, beta=beta)
             else:
                 aj = as_jax(a)
-                y = self._jit_aah(aj, cj, alpha=alpha, beta=beta)
+                # dtype is part of the key: a winner (and gate result)
+                # measured for f32 is invalid for c64 at the same shape
+                name = self._pick(
+                    'aah', 'shape=%s dt=%s' % (aj.shape, aj.dtype),
+                    _AAH_IMPLS, lambda: (aj,), gate=True)
+                y = self._jit('aah', name)(aj, cj,
+                                           alpha=alpha, beta=beta)
         else:
             aj, bj = as_jax(a), as_jax(b)
-            y = self._jit_ab(aj, bj, cj, alpha=alpha, beta=beta)
+            name = self._pick(
+                'ab', 'a=%s b=%s dt=%s,%s' % (aj.shape, bj.shape,
+                                              aj.dtype, bj.dtype),
+                _AB_IMPLS, lambda: (aj, bj), gate=True)
+            y = self._jit('ab', name)(aj, bj, cj,
+                                      alpha=alpha, beta=beta)
         if c is not None:
             odt = logical_dtype(c)
-            import jax.numpy as jnp
             tgt = jnp.dtype(odt.as_jax_dtype())
             if y.dtype != tgt:
                 if not np.issubdtype(tgt, np.complexfloating) and \
@@ -124,6 +420,175 @@ class LinAlg(object):
                 y = y.astype(tgt)
             return _writeback(y, c)
         return y
+
+
+# ---------------------------------------------------------------------------
+# cross-correlation entry point (FX correlator X-step; blocks.correlate
+# and bench config 5 both route here)
+# ---------------------------------------------------------------------------
+
+def _xcorr_einsum(re_i, im_i, re_j, im_j):
+    import jax.numpy as jnp
+    rr = jnp.einsum('tfi,tfj->fij', re_i, re_j,
+                    preferred_element_type=jnp.int32)
+    ii = jnp.einsum('tfi,tfj->fij', im_i, im_j,
+                    preferred_element_type=jnp.int32)
+    ir = jnp.einsum('tfi,tfj->fij', im_i, re_j,
+                    preferred_element_type=jnp.int32)
+    ri = jnp.einsum('tfi,tfj->fij', re_i, im_j,
+                    preferred_element_type=jnp.int32)
+    return (rr + ii).astype(jnp.float32) + \
+        1j * (ir - ri).astype(jnp.float32)
+
+
+def _xcorr_fmt(re_i, im_i, re_j, im_j):
+    """Pre-transpose to (F, n, T) / (F, T, n) so the contraction is a
+    canonical batched GEMM — the relayout is paid once, explicitly,
+    instead of inside XLA's dot lowering where it may not fuse."""
+    import jax.numpy as jnp
+
+    def t_in(x):                      # (T, F, n) -> (F, n, T)
+        return jnp.transpose(x, (1, 2, 0))
+
+    def t_jn(x):                      # (T, F, n) -> (F, T, n)
+        return jnp.transpose(x, (1, 0, 2))
+
+    a_re, a_im = t_in(re_i), t_in(im_i)
+    b_re, b_im = t_jn(re_j), t_jn(im_j)
+    mm = lambda x, y: jnp.matmul(x, y, preferred_element_type=jnp.int32)
+    rr = mm(a_re, b_re)
+    ii = mm(a_im, b_im)
+    ir = mm(a_im, b_re)
+    ri = mm(a_re, b_im)
+    return (rr + ii).astype(jnp.float32) + \
+        1j * (ir - ri).astype(jnp.float32)
+
+
+def _xcorr_einsum3(re_i, im_i, re_j, im_j):
+    """Auto-correlation only: the Hermitian structure makes the cross
+    term one matmul (K - K^T), 3 einsums instead of 4."""
+    import jax.numpy as jnp
+    rr = jnp.einsum('tfi,tfj->fij', re_i, re_i,
+                    preferred_element_type=jnp.int32)
+    ii = jnp.einsum('tfi,tfj->fij', im_i, im_i,
+                    preferred_element_type=jnp.int32)
+    k = jnp.einsum('tfi,tfj->fij', im_i, re_i,
+                   preferred_element_type=jnp.int32)
+    return (rr + ii).astype(jnp.float32) + \
+        1j * (k - jnp.swapaxes(k, -1, -2)).astype(jnp.float32)
+
+
+def _xcorr_fmt3(re_i, im_i, re_j, im_j):
+    """Auto-correlation only: pre-transposed batched GEMM form of the
+    3-matmul reduction."""
+    import jax.numpy as jnp
+    a_re = jnp.transpose(re_i, (1, 2, 0))           # (F, n, T)
+    a_im = jnp.transpose(im_i, (1, 2, 0))
+    b_re = jnp.transpose(re_i, (1, 0, 2))           # (F, T, n)
+    b_im = jnp.transpose(im_i, (1, 0, 2))
+    mm = lambda x, y: jnp.matmul(x, y, preferred_element_type=jnp.int32)
+    rr = mm(a_re, b_re)
+    ii = mm(a_im, b_im)
+    k = mm(a_im, b_re)
+    return (rr + ii).astype(jnp.float32) + \
+        1j * (k - jnp.swapaxes(k, -1, -2)).astype(jnp.float32)
+
+
+def _xcorr_gram(re_i, im_i, re_j, im_j):
+    """Auto-correlation only (i is j): one widened int8 gram matmul in
+    the (F, 2n, T) layout."""
+    import jax.numpy as jnp
+    z = jnp.concatenate([re_i, im_i], axis=-1)      # (T, F, 2n)
+    zt = jnp.transpose(z, (1, 2, 0))                # (F, 2n, T)
+    g = jnp.matmul(zt, jnp.transpose(z, (1, 0, 2)),
+                   preferred_element_type=jnp.int32)
+    n = re_i.shape[-1]
+    rr = g[..., :n, :n]
+    ri = g[..., :n, n:]
+    ir = g[..., n:, :n]
+    ii = g[..., n:, n:]
+    return (rr + ii).astype(jnp.float32) + 1j * (ir - ri).astype(jnp.float32)
+
+
+_XCORR_IMPLS = {
+    'einsum': _xcorr_einsum,
+    'fmt': _xcorr_fmt,
+}
+_XCORR_AUTO_IMPLS = dict(_XCORR_IMPLS, einsum3=_xcorr_einsum3,
+                         fmt3=_xcorr_fmt3, gram=_xcorr_gram)
+
+_xcorr_jits = {}
+_xcorr_chosen = {}
+
+
+def xcorr_int8(re_i, im_i, re_j=None, im_j=None, impl=None):
+    """FX-correlator cross-multiply on int8 planes.
+
+    (T, F, n_i) x (T, F, n_j) -> (F, n_i, n_j) complex64 visibilities
+    integrated over T (vis[f, i, j] = sum_t x_i x_j^*).  When re_j/im_j
+    are omitted the auto-correlation gains the widened-gram candidate.
+    Exact int32 accumulation on every path; the winning layout is
+    measured per shape on TPU (BF_LINALG_XCORR_IMPL forces one).
+    Reference: the xGPU-style cherk design point, src/linalg.cu:210-226.
+    """
+    import jax
+    auto = re_j is None
+    if auto:
+        re_j, im_j = re_i, im_i
+    impls = _XCORR_AUTO_IMPLS if auto else _XCORR_IMPLS
+    # the Hermitian 3-einsum form is the exact auto-correlation
+    # equivalent at 3/4 the MACs — the right default wherever no
+    # measurement is available
+    default = 'einsum3' if auto else 'einsum'
+    name = impl or _force_env('BF_LINALG_XCORR_IMPL', impls)
+    key = 'auto=%s i=%s j=%s' % (auto, re_i.shape, re_j.shape)
+    if name is None and isinstance(re_i, jax.core.Tracer):
+        # inside an outer jit trace (the block path): no measuring
+        # possible here — reuse a winner probed eagerly at this shape
+        # (blocks pre-warm via xcorr_prewarm at on_sequence), else
+        # consult the probe cache from an earlier session, else the
+        # default.  The cache peek is pure Python — trace-safe.  A
+        # miss falls back WITHOUT recording: a later eager prewarm at
+        # this shape must still be able to measure.
+        name = _xcorr_chosen.get(key)
+        if name is None:
+            from . import mprobe
+            cached = mprobe.peek('linalg_xcorr', key)
+            if cached is not None and cached[0] in impls:
+                _xcorr_chosen[key] = name = cached[0]
+            else:
+                name = default
+        return impls[name](re_i, im_i, re_j, im_j)
+    if name is None:
+        want = _probe_wanted()
+        if want and key not in _xcorr_chosen:
+            from . import mprobe
+            jitted = {n: _xcorr_jits.setdefault(n, jax.jit(f))
+                      for n, f in impls.items()}
+            winner, ms, _ = mprobe.select(
+                'linalg_xcorr', key, jitted,
+                lambda: (re_i, im_i, re_j, im_j))
+            _xcorr_chosen[key] = winner or default
+        name = _xcorr_chosen.get(key, default) if want else default
+    fn = _xcorr_jits.setdefault(name, jax.jit(impls[name]))
+    return fn(re_i, im_i, re_j, im_j)
+
+
+def xcorr_prewarm(t, f, n_i, n_j=None):
+    """Eagerly probe the xcorr layout winner at (T, F, n) so a later
+    jit-traced xcorr_int8 at the same shape picks it up.  Blocks call
+    this at on_sequence — probe cost lands at sequence start, never as
+    first-gulp latency (VERDICT r4 item 6 policy).  No-op when probing
+    is off (the traced call will use the default impl anyway)."""
+    if not _probe_wanted():
+        return
+    import jax.numpy as jnp
+    z = jnp.zeros((t, f, n_i), jnp.int8)
+    if n_j is None:
+        xcorr_int8(z, z)
+    else:
+        zj = jnp.zeros((t, f, n_j), jnp.int8)
+        xcorr_int8(z, z, zj, zj)
 
 
 _default = None

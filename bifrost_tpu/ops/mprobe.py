@@ -1,0 +1,163 @@
+"""Shared measured-implementation selection.
+
+The FDMT core probe (ops/fdmt.py) established the policy; this module
+generalizes it for other ops (LinAlg GEMM paths):
+
+- candidates are MEASURED at the actual shape, never asserted — r3's
+  artifact caught a hard-coded "TPU default" running 2.3x slower than
+  the alternative at the bench shape;
+- timing is best-of-N so first-session jitter (compile residue, tunnel
+  latency) cannot freeze a slower winner into the cache;
+- winners are cached in-process and on disk, keyed by backend, device
+  kind, package version and a caller-supplied shape signature;
+- the disk entry is written only when every candidate ran clean AND the
+  winner's margin over the runner-up exceeds a noise threshold — a
+  transient compile failure or a coin-flip ranking is re-measured next
+  session instead of being frozen (ADVICE r4).
+
+Reference analogue: the reference hand-picks kernels per shape at
+compile time (src/linalg.cu:210-226 drops to a custom cherk below
+n=896); on TPU the ranking depends on XLA's lowering, so it is probed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ['select', 'peek', 'backend_tag', 'cache_path']
+
+_cache = {}
+
+
+def peek(name, key):
+    """Cached (winner, ms, errors) for ``key`` or None — consults the
+    in-process and disk caches without measuring anything.  Safe to
+    call under a jax trace (pure-Python file read)."""
+    full_key = '%s|%s' % (backend_tag(), key)
+    fam = _cache.get(name, {})
+    if full_key in fam:
+        return fam[full_key]
+    try:
+        with open(cache_path(name)) as f:
+            disk = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if full_key in disk:
+        entry = (disk[full_key].get('winner'),
+                 disk[full_key].get('ms', {}), {})
+        _cache.setdefault(name, {})[full_key] = entry
+        return entry
+    return None
+
+
+def cache_path(name):
+    base = os.environ.get('BF_CACHE_DIR')
+    if base is None:
+        base = os.path.join(os.path.expanduser('~'), '.bifrost_tpu')
+    return os.path.join(base, '%s.json' % name)
+
+
+_backend_tag = None
+
+
+def backend_tag():
+    """backend:device-kind:version prefix for probe keys — a winner
+    measured on one TPU generation or package version must not be
+    reused where the ranking can differ.  Constant per process, so
+    memoized: peek() sits on the gulp hot path."""
+    global _backend_tag
+    if _backend_tag is not None:
+        return _backend_tag
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = 'unknown'
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.replace(' ', '_')
+    except Exception:
+        kind = 'unknown'
+    try:
+        from bifrost_tpu import __version__ as ver
+    except Exception:
+        ver = '0'
+    tag = '%s:%s:v%s' % (backend, kind, ver)
+    if backend != 'unknown':        # don't freeze a failed init
+        _backend_tag = tag
+    return tag
+
+
+def select(name, key, candidates, make_args, n_reps=3, noise=1.10,
+           n_calls=2, persist=True):
+    """Measure ``candidates`` and return (winner, ms_per_call, errors).
+
+    name        cache-file name (one JSON per op family)
+    key         shape/config signature (backend tag is prepended)
+    candidates  {impl_name: fn} — fn(*args) must be jittable-callable;
+                compile happens on the first timed-excluded call
+    make_args   () -> tuple of device arrays at the ACTUAL shape
+    n_calls     calls per timed rep (amortizes per-call dispatch)
+    persist     False if the caller already knows this measurement is
+                incomplete (e.g. a candidate errored upstream) — the
+                winner is used this session but not frozen to disk
+
+    A cached winner (in-process or disk — peek() may have populated
+    the in-process cache from disk) is revalidated against the current
+    candidate set: a stale name from an older build falls through to a
+    fresh measurement instead of crashing the caller.
+    """
+    full_key = '%s|%s' % (backend_tag(), key)
+    fam = _cache.setdefault(name, {})
+    if full_key in fam and fam[full_key][0] in candidates:
+        return fam[full_key]
+    path = cache_path(name)
+    disk = {}
+    try:
+        with open(path) as f:
+            disk = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if full_key in disk and disk[full_key].get('winner') in candidates:
+        entry = (disk[full_key]['winner'], disk[full_key].get('ms', {}),
+                 {})
+        fam[full_key] = entry
+        return entry
+
+    import jax
+    args = make_args()
+    ms = {}
+    errors = {}
+    for cname, fn in candidates.items():
+        try:
+            jax.block_until_ready(fn(*args))        # compile + drain
+            best = float('inf')
+            for _ in range(n_reps):
+                t0 = time.perf_counter()
+                for _ in range(n_calls):
+                    y = fn(*args)
+                jax.block_until_ready(y)
+                best = min(best, (time.perf_counter() - t0) / n_calls)
+            ms[cname] = round(best * 1e3, 3)
+        except Exception as e:
+            errors[cname] = '%s: %s' % (type(e).__name__, str(e)[:120])
+    if not ms:
+        return (None, {}, errors)
+    winner = min(ms, key=ms.get)
+    entry = (winner, ms, errors)
+    fam[full_key] = entry
+    ranked = sorted(ms.values())
+    decisive = len(ranked) < 2 or ranked[1] >= ranked[0] * noise
+    if persist and not errors and decisive:
+        disk[full_key] = {'winner': winner, 'ms': ms}
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + '.tmp%d' % os.getpid()
+            with open(tmp, 'w') as f:
+                json.dump(disk, f, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+    return entry

@@ -1,0 +1,229 @@
+"""Per-implementation oracles for the MXU GEMM paths (VERDICT r4 item
+2): every planar / hi-lo / gram candidate must match the float64 numpy
+oracle within its accuracy class, and the int8 paths must be exact, so
+the measured probe can choose on speed alone.  Reference bar for the
+capability: hand-tuned cherk/dp4a kernels, src/linalg.cu:210-226."""
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu.ops.linalg import (LinAlg, xcorr_int8, _AB_IMPLS,
+                                    _AAH_IMPLS, _I8_IMPLS,
+                                    _XCORR_AUTO_IMPLS)
+
+
+def _rand_c64(rng, shape):
+    return (rng.randn(*shape) + 1j * rng.randn(*shape)) \
+        .astype(np.complex64)
+
+
+@pytest.mark.parametrize('impl', sorted(_AB_IMPLS))
+def test_ab_impls_vs_f64_oracle(impl):
+    rng = np.random.RandomState(0)
+    a = _rand_c64(rng, (3, 24, 96))
+    b = _rand_c64(rng, (3, 96, 40))
+    la = LinAlg(ab_impl=impl)
+    y = np.asarray(la.matmul(1.5, a, b, 0.0, None))
+    oracle = 1.5 * (a.astype(np.complex128) @ b.astype(np.complex128))
+    # hi-lo split drops the lo@lo term; planar/xla are f32-class
+    rtol = 5e-4 if impl.endswith('_hilo') else 1e-4
+    np.testing.assert_allclose(y, oracle.astype(np.complex64),
+                               rtol=rtol, atol=rtol * 10)
+    assert la.chosen['ab'] == impl
+
+
+@pytest.mark.parametrize('impl', sorted(_AB_IMPLS))
+def test_ab_impls_real_and_mixed(impl):
+    rng = np.random.RandomState(1)
+    ar = rng.randn(8, 32).astype(np.float32)
+    bc = _rand_c64(rng, (32, 8))
+    la = LinAlg(ab_impl=impl)
+    y = np.asarray(la.matmul(1.0, ar, bc, 0.0, None))
+    oracle = ar.astype(np.complex128) @ bc.astype(np.complex128)
+    np.testing.assert_allclose(y, oracle.astype(np.complex64),
+                               rtol=5e-4, atol=5e-3)
+    # real x real stays real-valued
+    br = rng.randn(32, 8).astype(np.float32)
+    y2 = np.asarray(la.matmul(1.0, ar, br, 0.0, None))
+    np.testing.assert_allclose(y2, ar @ br, rtol=5e-4, atol=5e-3)
+
+
+@pytest.mark.parametrize('impl', sorted(_AAH_IMPLS))
+def test_aah_impls_vs_f64_oracle(impl):
+    rng = np.random.RandomState(2)
+    a = _rand_c64(rng, (2, 24, 64))
+    la = LinAlg(aah_impl=impl)
+    y = np.asarray(la.matmul(1.0, a, None, 0.0, None))
+    a128 = a.astype(np.complex128)
+    oracle = a128 @ np.conj(a128.transpose(0, 2, 1))
+    rtol = 5e-4 if impl.endswith('_hilo') else 1e-4
+    np.testing.assert_allclose(y, oracle.astype(np.complex64),
+                               rtol=rtol, atol=rtol * 100)
+    # the diagonal is |a|^2: strictly real
+    di = np.diagonal(y, axis1=-2, axis2=-1)
+    assert np.max(np.abs(di.imag)) <= 1e-2
+
+
+@pytest.mark.parametrize('impl', sorted(_I8_IMPLS))
+def test_i8_impls_exact(impl):
+    """Integer correlation must be bit-exact on every candidate."""
+    rng = np.random.RandomState(3)
+    n, k = 24, 48
+    re = rng.randint(-64, 64, size=(n, k)).astype(np.int8)
+    im = rng.randint(-64, 64, size=(n, k)).astype(np.int8)
+    a = bf.empty((n, k), 'ci8', 'system')
+    buf = a.as_numpy()
+    buf['re'], buf['im'] = re, im
+    ad = a.copy('tpu')
+    la = LinAlg(i8_impl=impl)
+    y = np.asarray(la.matmul(1.0, ad, None, 0.0, None))
+    c = re.astype(np.float64) + 1j * im
+    np.testing.assert_array_equal(y, (c @ np.conj(c.T))
+                                  .astype(np.complex64))
+    assert la.chosen['i8'] == impl
+
+
+@pytest.mark.parametrize('impl', sorted(_I8_IMPLS))
+def test_i8_impls_batched_beta(impl):
+    rng = np.random.RandomState(4)
+    b_, n, k = 3, 16, 32
+    re = rng.randint(-32, 32, size=(b_, n, k)).astype(np.int8)
+    im = rng.randint(-32, 32, size=(b_, n, k)).astype(np.int8)
+    a = bf.empty((b_, n, k), 'ci8', 'system')
+    buf = a.as_numpy()
+    buf['re'], buf['im'] = re, im
+    ad = a.copy('tpu')
+    c = bf.zeros((b_, n, n), 'cf32', 'tpu')
+    la = LinAlg(i8_impl=impl)
+    la.matmul(2.0, ad, None, 0.0, c)
+    v = re.astype(np.float64) + 1j * im
+    expect = 2.0 * (v @ np.conj(v.transpose(0, 2, 1)))
+    np.testing.assert_array_equal(np.asarray(c.data),
+                                  expect.astype(np.complex64))
+
+
+@pytest.mark.parametrize('impl', sorted(_XCORR_AUTO_IMPLS))
+def test_xcorr_auto_impls_exact(impl):
+    """Auto-correlation layouts: exact and identical across einsum /
+    pre-transposed / widened-gram candidates."""
+    import jax
+    rng = np.random.RandomState(5)
+    T, F, n = 12, 4, 10
+    re = rng.randint(-64, 64, size=(T, F, n)).astype(np.int8)
+    im = rng.randint(-64, 64, size=(T, F, n)).astype(np.int8)
+    import jax.numpy as jnp
+    y = np.asarray(xcorr_int8(jnp.asarray(re), jnp.asarray(im),
+                              impl=impl))
+    x = re.astype(np.float64) + 1j * im
+    oracle = np.einsum('tfi,tfj->fij', x, np.conj(x))
+    np.testing.assert_array_equal(y, oracle.astype(np.complex64))
+
+
+@pytest.mark.parametrize('impl', ['einsum', 'fmt'])
+def test_xcorr_cross_impls_exact(impl):
+    """Cross-correlation (different i/j station blocks, as in the
+    mesh-sharded correlator)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(6)
+    T, F, ni, nj = 8, 3, 6, 10
+    re_i = rng.randint(-64, 64, size=(T, F, ni)).astype(np.int8)
+    im_i = rng.randint(-64, 64, size=(T, F, ni)).astype(np.int8)
+    re_j = rng.randint(-64, 64, size=(T, F, nj)).astype(np.int8)
+    im_j = rng.randint(-64, 64, size=(T, F, nj)).astype(np.int8)
+    y = np.asarray(xcorr_int8(jnp.asarray(re_i), jnp.asarray(im_i),
+                              jnp.asarray(re_j), jnp.asarray(im_j),
+                              impl=impl))
+    xi = re_i.astype(np.float64) + 1j * im_i
+    xj = re_j.astype(np.float64) + 1j * im_j
+    oracle = np.einsum('tfi,tfj->fij', xi, np.conj(xj))
+    np.testing.assert_array_equal(y, oracle.astype(np.complex64))
+
+
+def test_prewarm_winner_reaches_traced_xcorr(monkeypatch, tmp_path):
+    """The production correlator calls xcorr_int8 under jax.jit, where
+    measuring is impossible; a winner probed eagerly at on_sequence
+    (xcorr_prewarm) must be what the traced call then uses."""
+    import jax
+    import jax.numpy as jnp
+    from bifrost_tpu.ops import linalg as L
+    monkeypatch.setenv('BF_LINALG_PROBE', '1')
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    monkeypatch.setattr(L, '_xcorr_chosen', {})
+    T, F, n = 6, 2, 8
+    L.xcorr_prewarm(T, F, n)
+    key = 'auto=True i=%s j=%s' % ((T, F, n), (T, F, n))
+    winner = L._xcorr_chosen.get(key)
+    assert winner in L._XCORR_AUTO_IMPLS
+
+    used = []
+    orig = dict(L._XCORR_AUTO_IMPLS)
+
+    def spy(name):
+        def f(*a):
+            used.append(name)
+            return orig[name](*a)
+        return f
+    monkeypatch.setattr(L, '_XCORR_AUTO_IMPLS',
+                        {k: spy(k) for k in orig})
+    rng = np.random.RandomState(8)
+    re = jnp.asarray(rng.randint(-64, 64, (T, F, n)).astype(np.int8))
+    im = jnp.asarray(rng.randint(-64, 64, (T, F, n)).astype(np.int8))
+    y = jax.jit(lambda r, i: L.xcorr_int8(r, i))(re, im)
+    assert used == [winner]
+    x = np.asarray(re, np.float64) + 1j * np.asarray(im, np.float64)
+    oracle = np.einsum('tfi,tfj->fij', x, np.conj(x))
+    np.testing.assert_array_equal(np.asarray(y),
+                                  oracle.astype(np.complex64))
+
+
+def test_traced_xcorr_consults_disk_cache(monkeypatch, tmp_path):
+    """A winner cached by an earlier session (disk) is honored by a
+    traced call even with no in-process prewarm."""
+    import json
+    import jax
+    import jax.numpy as jnp
+    from bifrost_tpu.ops import linalg as L
+    from bifrost_tpu.ops import mprobe
+    monkeypatch.setenv('BF_LINALG_PROBE', '1')
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    monkeypatch.setattr(L, '_xcorr_chosen', {})
+    monkeypatch.setattr(mprobe, '_cache', {})
+    T, F, n = 5, 2, 6
+    key = 'auto=True i=%s j=%s' % ((T, F, n), (T, F, n))
+    full_key = '%s|%s' % (mprobe.backend_tag(), key)
+    with open(mprobe.cache_path('linalg_xcorr'), 'w') as f:
+        json.dump({full_key: {'winner': 'gram', 'ms': {}}}, f)
+
+    used = []
+    orig = dict(L._XCORR_AUTO_IMPLS)
+
+    def spy(name):
+        def fn(*a):
+            used.append(name)
+            return orig[name](*a)
+        return fn
+    monkeypatch.setattr(L, '_XCORR_AUTO_IMPLS',
+                        {k: spy(k) for k in orig})
+    rng = np.random.RandomState(9)
+    re = jnp.asarray(rng.randint(-8, 8, (T, F, n)).astype(np.int8))
+    im = jnp.asarray(rng.randint(-8, 8, (T, F, n)).astype(np.int8))
+    jax.jit(lambda r, i: L.xcorr_int8(r, i))(re, im)
+    assert used == ['gram']
+
+
+def test_probe_selects_and_records(monkeypatch, tmp_path):
+    """With probing forced on (off-TPU), a winner is measured, recorded
+    in chosen/probe_ms, and the result still matches the oracle."""
+    monkeypatch.setenv('BF_LINALG_PROBE', '1')
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    rng = np.random.RandomState(7)
+    a = _rand_c64(rng, (2, 16, 32))
+    b = _rand_c64(rng, (2, 32, 16))
+    la = LinAlg()
+    y = np.asarray(la.matmul(1.0, a, b, 0.0, None))
+    oracle = a.astype(np.complex128) @ b.astype(np.complex128)
+    np.testing.assert_allclose(y, oracle.astype(np.complex64),
+                               rtol=5e-4, atol=5e-3)
+    assert la.chosen['ab'] in _AB_IMPLS
+    assert la.probe_ms.get('ab'), la.probe_ms
