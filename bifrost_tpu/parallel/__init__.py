@@ -14,7 +14,8 @@ pod slice.  This package provides:
 
 from .mesh import create_mesh, mesh_axes, local_mesh
 from .ops import (sharded_spectrometer, sharded_beamform,
-                  sharded_correlate, sharded_fir, spectrometer_step)
+                  sharded_correlate, sharded_fdmt, sharded_fir,
+                  spectrometer_step)
 from .fft import sharded_fft, distributed_fft_local
 from .scope import (time_axis_name, station_axis_name, time_axis_size,
                     time_sharding, replicated_sharding, shardable_nframe,

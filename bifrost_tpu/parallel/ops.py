@@ -20,6 +20,7 @@ bodies, so the collective patterns live in exactly one place.
 from __future__ import annotations
 
 __all__ = ['sharded_spectrometer', 'sharded_beamform', 'sharded_correlate',
+           'sharded_fdmt',
            'sharded_fir', 'spectrometer_step']
 
 
@@ -177,6 +178,56 @@ def sharded_fir(mesh, coeffs, time_axis_name='sp'):
     return shard_map(local_step, mesh=mesh,
                      in_specs=_P(time_axis_name),
                      out_specs=_P(time_axis_name))
+
+
+def sharded_fdmt(mesh, plan, time_axis_name='sp',
+                 negative_delays=False, core=None):
+    """Time-sharded FDMT over the mesh (long-sequence dedispersion).
+
+    FDMT output column t depends only on input columns
+    [t, t + max_delay) for positive delays (the mirror window for
+    negative), so each shard fetches a max_delay-wide halo from its
+    time neighbor via ppermute — edge shards receive zeros, which is
+    exactly the plan's out-of-range semantics — then runs the plan's
+    core on its local window.  Input (nchan, T) sharded over
+    ``time_axis_name``; output (max_delay, T) sharded the same way,
+    bit-compatible with the single-device core.
+
+    ``core`` defaults to the gather core (shape-generic under trace);
+    pass a measured winner (ops.fdmt._pick_core) for production.
+    Reference capability: bfFdmtExecute (src/fdmt.cu:718) on one GPU —
+    the halo exchange is the scale-out this framework adds.
+    """
+    import jax
+    import jax.numpy as jnp
+    shard_map = _shard_map()
+    H = int(plan.max_delay)
+    n = int(mesh.shape[time_axis_name])
+    if core is None:
+        core = plan._core_jax(negative_delays)
+
+    def local_step(x):
+        # x: (nchan, T/n)
+        if x.shape[1] < H:
+            raise ValueError(
+                "per-shard time %d < max_delay %d: the halo would "
+                "need a non-adjacent neighbor; use fewer shards or "
+                "longer gulps" % (x.shape[1], H))
+        if negative_delays:
+            halo = jax.lax.ppermute(
+                x[:, -H:], time_axis_name,
+                [(i, i + 1) for i in range(n - 1)])
+            xw = jnp.concatenate([halo, x], axis=1)
+            return core(xw)[:, H:]
+        halo = jax.lax.ppermute(
+            x[:, :H], time_axis_name,
+            [(i, i - 1) for i in range(1, n)])
+        xw = jnp.concatenate([x, halo], axis=1)
+        return core(xw)[:, :x.shape[1]]
+
+    return shard_map(local_step, mesh=mesh,
+                     in_specs=_P(None, time_axis_name),
+                     out_specs=_P(None, time_axis_name))
 
 
 def spectrometer_step(mesh):

@@ -9,7 +9,8 @@ import jax.numpy as jnp
 
 from bifrost_tpu.parallel import (create_mesh, sharded_spectrometer,
                                   sharded_beamform, sharded_correlate,
-                                  sharded_fir, spectrometer_step)
+                                  sharded_fdmt, sharded_fir,
+                                  spectrometer_step)
 
 
 def _mesh2d():
@@ -71,6 +72,35 @@ def test_sharded_fir_halo_exchange():
     xp = np.concatenate([np.zeros(2, np.float32), x])
     expect = sum(coeffs[t] * xp[2 - t:2 - t + 32] for t in range(3))
     np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+@pytest.mark.parametrize('negative', [False, True])
+def test_sharded_fdmt_matches_numpy_oracle(negative):
+    """Time-sharded FDMT with max_delay halo exchange == the float64
+    numpy oracle of the same plan (long-sequence dedispersion)."""
+    from bifrost_tpu.ops.fdmt import Fdmt
+    mesh = create_mesh({'sp': 8})
+    plan = Fdmt().init(32, 8, 1400.0, -0.1)
+    rng = np.random.RandomState(3)
+    x = rng.randn(32, 128).astype(np.float32)
+    fn = jax.jit(sharded_fdmt(mesh, plan, 'sp',
+                              negative_delays=negative))
+    got = np.asarray(fn(jnp.asarray(x)))
+    want = plan._core_numpy(x.astype(np.float64),
+                            negative_delays=negative)
+    rel = np.max(np.abs(got - want)) / (np.max(np.abs(want)) + 1e-30)
+    assert rel < 1e-5, rel
+
+
+def test_sharded_fdmt_rejects_short_shards():
+    """A per-shard window smaller than max_delay cannot be served by an
+    adjacent-neighbor halo and must be rejected loudly."""
+    from bifrost_tpu.ops.fdmt import Fdmt
+    mesh = create_mesh({'sp': 8})
+    plan = Fdmt().init(32, 16, 1400.0, -0.1)
+    x = jnp.zeros((32, 64), jnp.float32)    # 8 cols/shard < 16
+    with pytest.raises(ValueError, match='max_delay'):
+        jax.jit(sharded_fdmt(mesh, plan, 'sp'))(x)
 
 
 def test_full_spectrometer_step_dryrun():
