@@ -302,16 +302,57 @@ def run_correctness_gate():
     }
 
 
-def _backend_alive(timeout=180.0, retries=None):
-    """Initialize the jax backend with a deadline, retrying over
-    several minutes.  The tunneled TPU plugin can hang indefinitely
-    when its terminal is down; a bench that never prints is worse than
-    one that reports the outage — but a SINGLE 180 s attempt turns a
-    transient tunnel blip into an rc=2 driver artifact (VERDICT r3
-    item 1), so we probe in fresh subprocesses (a hung in-process init
-    cannot be retried: the second call just blocks on the same PJRT
-    init lock) and only initialize in-process once a probe succeeds."""
+def _probe_backend(timeout=180.0, retries=None):
+    """(healthy, history): probe the tunneled backend in FRESH
+    subprocesses with backoff, never touching this process's PJRT
+    state.  ``history`` records every attempt for the artifact, so a
+    dead-tunnel run still documents what was tried (VERDICT r4
+    item 4)."""
     import subprocess
+    if retries is None:
+        try:
+            retries = int(os.environ.get('BF_BENCH_INIT_RETRIES', '3'))
+        except ValueError:
+            retries = 3
+    here = os.path.dirname(os.path.abspath(__file__))
+    probe_py = os.path.join(here, 'tools', 'tpu_probe.py')
+    history = []
+    if not os.path.exists(probe_py):
+        return True, [{'note': 'no probe tool; assuming alive'}]
+    env = dict(os.environ, BF_PROBE_DEADLINE=str(timeout))
+    for attempt in range(1 + max(retries, 0)):
+        if attempt:
+            time.sleep(min(45.0 * attempt, 120.0))
+        entry = {'t': time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                    time.gmtime())}
+        try:
+            p = subprocess.run([sys.executable, probe_py], env=env,
+                               capture_output=True, text=True,
+                               timeout=timeout + 60)
+            entry['rc'] = p.returncode
+            try:
+                entry.update(json.loads(
+                    (p.stdout or '').strip().splitlines()[-1]))
+            except (ValueError, IndexError):
+                pass
+        except subprocess.TimeoutExpired:
+            entry['rc'] = 'timeout'
+        history.append(entry)
+        if entry.get('rc') == 0:
+            return True, history
+    return False, history
+
+
+def _backend_alive(timeout=180.0, retries=None):
+    """Probe in fresh subprocesses (a hung in-process init cannot be
+    retried: the second call just blocks on the same PJRT init lock),
+    then initialize THIS process's backend once a probe succeeds.  A
+    failed (raised, not hung) in-process init after a healthy probe is
+    a tunnel blip between the two — re-probe and retry rather than
+    giving up.  Only child entrypoints call this; the parent
+    aggregator never initializes a backend in-process (VERDICT r4
+    item 5).  BF_SKIP_PROBE=1 (set by _run_isolated: the parent just
+    proved health) skips the redundant probe subprocess."""
     import threading
 
     def init_inprocess(deadline):
@@ -335,31 +376,15 @@ def _backend_alive(timeout=180.0, retries=None):
             retries = int(os.environ.get('BF_BENCH_INIT_RETRIES', '3'))
         except ValueError:
             retries = 3
-    here = os.path.dirname(os.path.abspath(__file__))
-    probe_py = os.path.join(here, 'tools', 'tpu_probe.py')
-    if not os.path.exists(probe_py):
-        return init_inprocess(timeout)
-    env = dict(os.environ, BF_PROBE_DEADLINE=str(timeout))
+    skip_probe = os.environ.get('BF_SKIP_PROBE') == '1'
     for attempt in range(1 + max(retries, 0)):
         if attempt:
             time.sleep(min(45.0 * attempt, 120.0))
-        try:
-            p = subprocess.run([sys.executable, probe_py], env=env,
-                               capture_output=True, text=True,
-                               timeout=timeout + 60)
-        except subprocess.TimeoutExpired:
-            continue
-        if p.returncode == 0:
-            # tunnel healthy: bring up this process's backend (bounded;
-            # a healthy probe makes a hang here very unlikely).  A
-            # failed in-process init after a healthy probe means the
-            # tunnel blipped between the two — keep retrying rather
-            # than burning the remaining attempts (though a HUNG
-            # in-process init cannot be retried: the next call would
-            # block on the same PJRT init lock, so further loop
-            # iterations only help when init raised quickly).
-            if init_inprocess(timeout):
-                return True
+        if skip_probe:
+            return init_inprocess(timeout)
+        healthy, _hist = _probe_backend(timeout, retries=0)
+        if healthy and init_inprocess(timeout):
+            return True
     return False
 
 
@@ -553,7 +578,7 @@ def bench_pallas_smoke():
     return out
 
 
-def _run_isolated(argv, timeout=900):
+def _run_isolated(argv, timeout=900, env_extra=None):
     """Run a bench entrypoint in a FRESH subprocess and parse the last
     JSON line of its stdout.  Isolation matters on the tunneled
     backend: one op hitting UNIMPLEMENTED poisons every subsequent op
@@ -564,7 +589,10 @@ def _run_isolated(argv, timeout=900):
     # the parent already proved the backend alive; a child hitting a
     # mid-suite tunnel drop must fail fast with its graceful rc=2 JSON
     # rather than burn the isolation timeout in _backend_alive retries
-    env = dict(os.environ, BF_BENCH_INIT_RETRIES='0')
+    env = dict(os.environ, BF_BENCH_INIT_RETRIES='0',
+               BF_SKIP_PROBE='1')
+    if env_extra:
+        env.update(env_extra)
     try:
         p = subprocess.run([sys.executable] + argv, cwd=here,
                            capture_output=True, text=True,
@@ -607,22 +635,17 @@ def run_suite_into(result):
     platform = result.get('platform', 'unknown')
     detail = {'primary': dict(result), 'platform': platform}
 
-    def attempt(fn):
-        try:
-            return fn()
-        except Exception as e:
-            return {'error': '%s: %s' % (type(e).__name__,
-                                         str(e)[:300])}
-
-    gate = attempt(run_correctness_gate)
+    # every device-touching step runs in its own subprocess — the
+    # parent aggregates JSON and never initializes PJRT, so no hung
+    # init can cost the whole artifact (VERDICT r4 item 5)
+    gate = _run_isolated(['bench.py', '--check'])
     result['check_ok'] = bool(gate.get('ok'))
     result['check'] = {k: gate[k] for k in
                        ('stokes_rel_err', 'deterministic', 'failures',
                         'error') if k in gate}
     detail['gate'] = gate
 
-    import bench_suite
-    ceil = attempt(bench_suite.measure_ceilings)
+    ceil = _run_isolated(['bench.py', '--ceilings'])
     detail['ceilings'] = ceil
     result['ceilings'] = {k: round(v, 2) for k, v in ceil.items()
                           if isinstance(v, float)}
@@ -665,23 +688,8 @@ def run_suite_into(result):
         if cid == 7:
             argv += ['--msps-pipe', str(result['value'])]
         res = _run_isolated(argv)
-        res.pop('config_id', None)
+        compact = _compact_config(res)
         detail['config_%d' % cid] = res
-        compact = {}
-        for k in ('config', 'value', 'unit', 'vs_baseline', 'error',
-                  'serial_s', 'pipeline_s', 'reference_bar',
-                  'delivered_frac', 'delivery_ok'):
-            if k in res:
-                compact[k] = (round(res[k], 2)
-                              if isinstance(res[k], float) else res[k])
-        roof = res.get('roofline', {})
-        for k in ('bw_frac', 'mfu', 'bound', 'pps_native_engine',
-                  'goodput_Gbps'):
-            if k in roof:
-                compact[k] = (round(roof[k], 3)
-                              if isinstance(roof[k], float) else roof[k])
-        if 'core_compare' in res:
-            compact['core_compare'] = res['core_compare']
         configs[str(cid)] = compact
     result['configs'] = configs
 
@@ -709,56 +717,186 @@ def run_suite_into(result):
     return result
 
 
-def main():
-    if not _backend_alive():
-        print(json.dumps({
-            'metric': 'backend initialization',
-            'error': 'jax backend failed to initialize after repeated '
-                     'probes with backoff (~15 min total; accelerator '
-                     'tunnel down?)',
-            'value': 0.0, 'unit': 'Msamples/s', 'vs_baseline': 0.0}))
-        return 2
-    if '--check' in sys.argv:
-        res = run_correctness_gate()
-        print(json.dumps(res))
-        return 0 if res['ok'] else 1
-    if '--fft-impl' in sys.argv:
-        print(json.dumps(bench_fft_impls()))
-        return 0
-    if '--spectrometer' in sys.argv:
-        print(json.dumps(bench_spectrometer_kernel()))
-        return 0
-    if '--pallas-smoke' in sys.argv:
-        res = bench_pallas_smoke()
-        print(json.dumps(res))
-        return 0 if res.get('ok') or res.get('skipped') else 1
-    msps, impl_record = build_and_run()
-    import jax
+# the one projection both the healthy and the degraded artifact use,
+# so the two can never silently report different fields
+_COMPACT_KEYS = ('config', 'value', 'unit', 'vs_baseline', 'error',
+                 'serial_s', 'pipeline_s', 'reference_bar',
+                 'delivered_frac', 'delivery_ok')
+_COMPACT_ROOF_KEYS = ('bw_frac', 'mfu', 'bound', 'pps_native_engine',
+                      'goodput_Gbps', 'burst_eff', 'offered_pkts')
+
+
+def _compact_config(res):
+    """Project a config subprocess result onto the driver-line keys."""
+    res.pop('config_id', None)
+    compact = {}
+    for k in _COMPACT_KEYS:
+        if k in res:
+            compact[k] = (round(res[k], 2)
+                          if isinstance(res[k], float) else res[k])
+    roof = res.get('roofline', {})
+    for k in _COMPACT_ROOF_KEYS:
+        if k in roof:
+            compact[k] = (round(roof[k], 3)
+                          if isinstance(roof[k], float) else roof[k])
+    if 'core_compare' in res:
+        compact['core_compare'] = res['core_compare']
+    return compact
+
+
+def degraded_result(history, reason=None):
+    """Dead-backend artifact that still proves everything provable
+    without a chip (VERDICT r4 item 4): host-only configs 1/6, the
+    last-known-good chip artifact flagged stale, and the probe
+    history — instead of a bare error line."""
+    here = os.path.dirname(os.path.abspath(__file__))
     result = {
         'metric': 'Guppi spectroscopy pipeline (FFT-detect-reduce) '
                   'throughput per chip',
-        # a 'cpu' platform marks a fallback-validation run, NOT chip
-        # numbers — keep the label so artifacts can't be misread
-        'platform': jax.devices()[0].platform,
-        'value': round(msps, 1),
-        'unit': 'Msamples/s',
-        'vs_baseline': round(msps / A100_BASELINE_MSPS, 4),
-        # the impl record the executed FusedBlock published (ProcLog
-        # <block>/impl): the artifact's label provably comes from the
-        # executed pipeline, not a re-derivation
-        'impl_record': impl_record,
-        'impl': chain_traffic_model(impl_record)[1],
+        'error': reason or (
+            'jax backend failed to initialize after repeated probes '
+            'with backoff (accelerator tunnel down?); host-only '
+            'evidence below'),
+        'platform': 'none',
+        'value': 0.0, 'unit': 'Msamples/s', 'vs_baseline': 0.0,
+        'probe_history': history,
+        'configs': {},
     }
-    if '--flagship-only' not in sys.argv:
-        # fold gate + all suite configs + ceilings + FFT-impl compare
-        # into the one line the driver records (VERDICT r2 item 1);
-        # any sub-benchmark failure degrades to an error field instead
-        # of losing the whole artifact
+    # configs 1 (host sigproc) and 6 (capture loopback) need no chip
+    for cid in (1, 6):
+        res = _run_isolated(['bench_suite.py', '--config', str(cid)],
+                            env_extra={'JAX_PLATFORMS': 'cpu'})
+        result['configs'][str(cid)] = _compact_config(res)
+    # newest chip-measured suite artifact, clearly flagged stale
+    import glob
+    best = None
+    for pathn in sorted(glob.glob(
+            os.path.join(here, 'BENCH_SUITE_r*.json'))):
         try:
-            result = run_suite_into(result)
-        except Exception as e:
-            result['suite_error'] = '%s: %s' % (type(e).__name__,
-                                                str(e)[:300])
+            with open(pathn) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if d.get('platform') == 'tpu':
+            best = (pathn, d)
+    if best:
+        pathn, d = best
+        # commit date, not mtime: a fresh checkout resets mtimes, and
+        # 'captured' must mean when the measurement was taken
+        captured = None
+        try:
+            import subprocess
+            p = subprocess.run(
+                ['git', 'log', '-1', '--format=%cI', '--',
+                 os.path.basename(pathn)],
+                cwd=here, capture_output=True, text=True, timeout=30)
+            captured = (p.stdout or '').strip() or None
+        except Exception:
+            pass
+        if not captured:
+            captured = time.strftime(
+                '%Y-%m-%dT%H:%M:%SZ',
+                time.gmtime(os.path.getmtime(pathn)))
+        result['last_known_good'] = {
+            'file': os.path.basename(pathn),
+            'stale': True,
+            'captured': captured,
+            'flagship': d.get('primary', {}),
+        }
+    # round-long watcher history, when a watcher has been running
+    watch = os.path.join(here, 'bench_watch.log')
+    try:
+        with open(watch) as f:
+            result['watch_log_tail'] = f.read().splitlines()[-12:]
+    except OSError:
+        pass
+    return result
+
+
+_CHILD_MODES = ('--check', '--fft-impl', '--spectrometer',
+                '--pallas-smoke', '--ceilings', '--flagship-only')
+
+
+def main():
+    if any(m in sys.argv for m in _CHILD_MODES):
+        # child entrypoints own a backend; the parent below never does
+        if not _backend_alive():
+            print(json.dumps({
+                'metric': 'backend initialization',
+                'error': 'jax backend failed to initialize',
+                'value': 0.0, 'unit': 'Msamples/s',
+                'vs_baseline': 0.0}))
+            return 2
+        if '--check' in sys.argv:
+            res = run_correctness_gate()
+            print(json.dumps(res))
+            return 0 if res['ok'] else 1
+        if '--fft-impl' in sys.argv:
+            print(json.dumps(bench_fft_impls()))
+            return 0
+        if '--spectrometer' in sys.argv:
+            print(json.dumps(bench_spectrometer_kernel()))
+            return 0
+        if '--pallas-smoke' in sys.argv:
+            res = bench_pallas_smoke()
+            print(json.dumps(res))
+            return 0 if res.get('ok') or res.get('skipped') else 1
+        if '--ceilings' in sys.argv:
+            import bench_suite
+            print(json.dumps(bench_suite.measure_ceilings()))
+            return 0
+        # --flagship-only: the ring-pipeline measurement itself
+        msps, impl_record = build_and_run()
+        import jax
+        print(json.dumps({
+            'metric': 'Guppi spectroscopy pipeline (FFT-detect-reduce) '
+                      'throughput per chip',
+            # a 'cpu' platform marks a fallback-validation run, NOT
+            # chip numbers — keep the label so artifacts can't be
+            # misread
+            'platform': jax.devices()[0].platform,
+            'value': round(msps, 1),
+            'unit': 'Msamples/s',
+            'vs_baseline': round(msps / A100_BASELINE_MSPS, 4),
+            # the impl record the executed FusedBlock published
+            # (ProcLog <block>/impl): the artifact's label provably
+            # comes from the executed pipeline, not a re-derivation
+            'impl_record': impl_record,
+            'impl': chain_traffic_model(impl_record)[1],
+        }))
+        return 0
+
+    # PARENT AGGREGATOR: probes via subprocesses, runs every
+    # measurement via _run_isolated, and only assembles JSON — no code
+    # path here can hit the documented un-retryable PJRT init hang
+    # (VERDICT r4 item 5)
+    healthy, history = _probe_backend()
+    if not healthy:
+        print(json.dumps(degraded_result(history)))
+        return 2
+    result = _run_isolated(['bench.py', '--flagship-only'],
+                           timeout=2400)
+    if 'value' not in result or result.get('error'):
+        # healthy probe but the flagship child failed: degrade with
+        # the child's error attached — and a reason that does NOT
+        # claim an infra outage the probe history would contradict
+        deg = degraded_result(
+            history,
+            reason='flagship pipeline subprocess failed (backend '
+                   'probes were healthy — see flagship_error); '
+                   'host-only evidence below')
+        deg['flagship_error'] = result.get('error', 'no output')
+        print(json.dumps(deg))
+        return 2
+    # fold gate + all suite configs + ceilings + FFT-impl compare
+    # into the one line the driver records (VERDICT r2 item 1); any
+    # sub-benchmark failure degrades to an error field instead of
+    # losing the whole artifact
+    try:
+        result = run_suite_into(result)
+    except Exception as e:
+        result['suite_error'] = '%s: %s' % (type(e).__name__,
+                                            str(e)[:300])
     print(json.dumps(result))
 
 
