@@ -487,6 +487,50 @@ def bench_spectrometer_kernel():
     return out
 
 
+def bench_traffic_probe():
+    """Cross-check chain_traffic_model's hand bytes-per-sample
+    constants against the compiled program's own accounting (VERDICT
+    r4 item 8): jit-lower the SAME composed stage chain the FusedBlock
+    runs, at the bench gulp shape, and read XLA's 'bytes accessed' for
+    the compiled executable.  The roofline's denominator can no longer
+    drift silently — the artifact records modeled vs compiled and
+    whether they agree within 15%.
+
+    Caveat recorded in the result: for the Pallas whole-chain kernel,
+    XLA models only the custom call's operands and results — which IS
+    the model's claim (nothing else leaves VMEM), so agreement there
+    confirms the interface traffic, not the kernel's internals."""
+    import jax
+    import jax.numpy as jnp
+    from bifrost_tpu.stages import compose_stages, walk_headers
+    stages = flagship_stages()
+    headers = walk_headers(stages, flagship_header())
+    shape = (NTIME, NPOL, NFINE, 2)
+    fn, info = compose_stages(stages, headers, shape, 'int8')
+    modeled, label = chain_traffic_model(info)
+    nsamples = NTIME * NPOL * NFINE
+    out = {'impl': label,
+           'modeled_bytes_per_sample': modeled,
+           'nsamples_per_gulp': nsamples}
+    try:
+        compiled = jax.jit(fn).lower(
+            jax.ShapeDtypeStruct(shape, jnp.int8)).compile()
+        ca = compiled.cost_analysis()
+    except Exception as e:
+        out['error'] = '%s: %s' % (type(e).__name__, str(e)[:200])
+        return out
+    d = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    bytes_acc = float(d.get('bytes accessed', 0.0) or 0.0)
+    if not bytes_acc:
+        out['error'] = 'cost_analysis reported no bytes accessed'
+        return out
+    measured = bytes_acc / nsamples
+    out['compiled_bytes_per_sample'] = round(measured, 2)
+    out['ratio_compiled_over_model'] = round(measured / modeled, 3)
+    out['within_15pct'] = bool(abs(measured / modeled - 1.0) <= 0.15)
+    return out
+
+
 def bench_pallas_smoke():
     """Compile-and-run every Pallas kernel at tiny shapes on the LIVE
     backend (VERDICT r3 item 7): CI runs them interpret-mode only, so
@@ -707,6 +751,19 @@ def run_suite_into(result):
                               if k in smoke}
     detail['pallas_smoke'] = smoke
 
+    traffic = _run_isolated(['bench.py', '--traffic'])
+    # the probe re-derives the impl in its own subprocess; if the
+    # substitution decision diverged from the flagship run's published
+    # record, the probe validated the WRONG denominator — flag it
+    # rather than letting the artifact read as 'roofline validated'
+    if 'impl' in traffic and traffic['impl'] != impl:
+        traffic['impl_mismatch'] = (
+            'probe compiled %s but the flagship ran %s; the roofline '
+            'denominator is unvalidated' % (traffic['impl'], impl))
+        traffic['within_15pct'] = False
+    result['traffic_model'] = traffic
+    detail['traffic_model'] = traffic
+
     name = 'BENCH_SUITE_r05.json' if platform == 'tpu' \
         else 'BENCH_SUITE_%s_validation.json' % platform
     try:
@@ -814,7 +871,8 @@ def degraded_result(history, reason=None):
 
 
 _CHILD_MODES = ('--check', '--fft-impl', '--spectrometer',
-                '--pallas-smoke', '--ceilings', '--flagship-only')
+                '--pallas-smoke', '--ceilings', '--traffic',
+                '--flagship-only')
 
 
 def main():
@@ -844,6 +902,9 @@ def main():
         if '--ceilings' in sys.argv:
             import bench_suite
             print(json.dumps(bench_suite.measure_ceilings()))
+            return 0
+        if '--traffic' in sys.argv:
+            print(json.dumps(bench_traffic_probe()))
             return 0
         # --flagship-only: the ring-pipeline measurement itself
         msps, impl_record = build_and_run()
