@@ -27,6 +27,14 @@ src/linalg_kernels.cu:55).  The TPU equivalents implemented here:
   whose 4 blocks contain every term — 4/3 the MACs but a single big
   MXU-shaped kernel.  Which wins depends on XLA's lowering, so it is
   measured (ops.mprobe), never asserted.
+- **cf16 plane operands.**  A cf16 ring array feeds the planar GEMMs
+  as raw f16 planes — never promoted to complex64 — so the HBM read
+  is half-width, the lever at bandwidth-bound beamform shapes.  The
+  hi-lo split is EXACT for f16 planes (a f16 value splits exactly
+  into two bf16 terms), so the traffic cut costs no accuracy.  A
+  single-pass bf16 candidate (full MXU rate, ~2^-8 rounding) exists
+  but fails the default accuracy gate by construction — it races only
+  under an explicit BF_LINALG_GATE_RTOL widening or a forced impl.
 
 Every implementation is exact-int (i8 paths) or accuracy-gated (float
 paths: before the speed race, each candidate's on-device deviation
@@ -48,21 +56,37 @@ from .fft import _writeback
 __all__ = ['LinAlg', 'matmul', 'xcorr_int8', 'xcorr_prewarm']
 
 
-def _int8_reim(x):
-    """Extract (re, im) int8 arrays from a ci8 bf ndarray without promoting
-    to complex — keeps the MXU int8 path honest."""
+def _reim_planes(x, kind, nbits, dev_dtype):
+    """(re, im) planes of a bf ndarray of the given complex dtype, or
+    None — never promoting to a wider complex type, so the device read
+    stays at the narrow width."""
     from ..ndarray import ndarray as bf_ndarray
     import jax.numpy as jnp
-    if isinstance(x, bf_ndarray) and x.dtype.kind == 'ci' \
-            and x.dtype.nbits == 8:
+    if isinstance(x, bf_ndarray) and x.dtype.kind == kind \
+            and x.dtype.nbits == nbits:
         if x.space == 'tpu':
-            arr = x.data  # trailing (re, im) axis of length 2, int8
-            if arr.dtype == jnp.int8 and arr.shape[-1] == 2:
+            arr = x.data  # trailing (re, im) axis of length 2
+            if arr.shape[-1] == 2 and arr.dtype == dev_dtype:
                 return arr[..., 0], arr[..., 1]
             return None
         buf = x.as_numpy()
         return jnp.asarray(buf['re']), jnp.asarray(buf['im'])
     return None
+
+
+def _int8_reim(x):
+    """ci8 planes — keeps the MXU int8 path honest."""
+    import jax.numpy as jnp
+    return _reim_planes(x, 'ci', 8, jnp.int8)
+
+
+def _cf16_reim(x):
+    """cf16 planes: half-width HBM reads straight into the planar
+    GEMMs (the reference's Cherk3mEx cf16 design point,
+    src/linalg.cu:210-226) — the lever at bandwidth-bound beamform
+    shapes."""
+    import jax.numpy as jnp
+    return _reim_planes(x, 'cf', 16, jnp.float16)
 
 
 # ---------------------------------------------------------------------------
@@ -95,19 +119,53 @@ def _mm_hilo(a, b):
                + jnp.matmul(al, bh, preferred_element_type=f32)))
 
 
+def _mm_bf16(a, b):
+    """ONE bf16 MXU pass with f32 accumulation: full MXU rate, bf16
+    input rounding (~2^-8 relative — measured ~4e-3 even for f16
+    planes, above the default accuracy gate).  Races only when the
+    operator explicitly widens the gate (BF_LINALG_GATE_RTOL) or
+    forces the impl; never admitted unchecked."""
+    import jax.numpy as jnp
+    return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
 def _cmm_planar(ar, ai, br, bi, mm):
-    """Complex matmul on planes, Karatsuba 3-multiply."""
+    """Complex matmul on planes, Karatsuba 3-multiply.  The m3 addends
+    are widened to f32 first: for f16 planes, re+im can overflow the
+    f16 range (max 65504) for values that are individually in range —
+    the HBM read already happened, so the cast is free."""
+    import jax.numpy as jnp
+
+    def wide(x):
+        return x.astype(jnp.float32) if x.dtype.itemsize < 4 else x
+
     m1 = mm(ar, br)
     m2 = mm(ai, bi)
-    m3 = mm(ar + ai, br + bi)
+    m3 = mm(wide(ar) + wide(ai), wide(br) + wide(bi))
     return m1 - m2, m3 - m1 - m2
 
 
 def _planes(x):
+    """(re, im) planes of an operand.  Operands arrive either as jax
+    complex/real arrays or as an (re, im) plane tuple (the cf16 device
+    rep — never promoted to complex64 so its HBM reads stay
+    half-width)."""
     import jax.numpy as jnp
+    if isinstance(x, tuple):
+        return x
     if jnp.iscomplexobj(x):
         return jnp.real(x), jnp.imag(x)
     return x, None
+
+
+def _as_complex(x):
+    """Operand as a complex/real jax array (the XLA-baseline impls
+    need the interleaved form; plane tuples are combined here)."""
+    import jax.numpy as jnp
+    if isinstance(x, tuple):
+        return x[0].astype(jnp.float32) + 1j * x[1].astype(jnp.float32)
+    return x
 
 
 # ---------------------------------------------------------------------------
@@ -116,6 +174,7 @@ def _planes(x):
 
 def _ab_xla(a, b, c, alpha, beta):
     import jax.numpy as jnp
+    a, b = _as_complex(a), _as_complex(b)
     acc = jnp.complex64 if jnp.iscomplexobj(a) or jnp.iscomplexobj(b) \
         else jnp.float32
     y = alpha * jnp.matmul(a, b, preferred_element_type=acc)
@@ -149,6 +208,7 @@ _AB_IMPLS = {
     'xla': _ab_xla,
     'planar': _ab_planar_with(_mm_f32),
     'planar_hilo': _ab_planar_with(_mm_hilo),
+    'planar_bf16': _ab_planar_with(_mm_bf16),
 }
 
 
@@ -158,6 +218,7 @@ _AB_IMPLS = {
 
 def _aah_xla(a, c, alpha, beta):
     import jax.numpy as jnp
+    a = _as_complex(a)
     y = alpha * jnp.matmul(a, jnp.conj(jnp.swapaxes(a, -1, -2)),
                            preferred_element_type=jnp.complex64)
     if beta != 0 and c is not None:
@@ -189,6 +250,7 @@ _AAH_IMPLS = {
     'xla': _aah_xla,
     'planar': _aah_planar_with(_mm_f32),
     'planar_hilo': _aah_planar_with(_mm_hilo),
+    'planar_bf16': _aah_planar_with(_mm_bf16),
 }
 
 
@@ -342,18 +404,32 @@ class LinAlg(object):
     # a candidate deviating from the XLA baseline by more than this
     # (relative, at the actual shape) is excluded from the speed race:
     # the bound admits the hi-lo split's legitimate ~2^-16 truncation
-    # while catching a broken lowering outright
+    # while catching a broken lowering outright.  The single-pass bf16
+    # candidate (~2^-8) always fails this default — it only races
+    # under an explicit widening (BF_LINALG_GATE_RTOL) or a force.
     _GATE_RTOL = 1e-3
+    # candidates that are by construction below f32 accuracy class:
+    # these must NEVER be admitted without a passing gate measurement
+    _LOSSY = frozenset(['planar_bf16'])
+
+    @staticmethod
+    def _gate_rtol():
+        try:
+            return float(os.environ.get('BF_LINALG_GATE_RTOL', '')
+                         or LinAlg._GATE_RTOL)
+        except ValueError:
+            return LinAlg._GATE_RTOL
 
     @staticmethod
     def _accuracy_gate(impls, make_args, base='xla'):
         """(keep, had_errors): candidates whose on-device deviation
-        from the XLA baseline at the actual shape stays inside the
-        bf16 accuracy class (_GATE_RTOL relative).  Runs once per
-        (family, shape) — only when no cached winner exists.
-        ``had_errors`` is True when any candidate raised (e.g. a
-        transient OOM): the caller must not freeze a winner chosen
-        from the reduced field to disk."""
+        from the XLA baseline at the actual shape stays inside
+        _gate_rtol() relative.  Runs once per (family, shape) — only
+        when no cached winner exists.  ``had_errors`` is True when any
+        candidate raised (e.g. a transient OOM): the caller must not
+        freeze a winner chosen from the reduced field to disk.  If the
+        baseline itself raised, no accuracy evaluation is possible —
+        lossy candidates are dropped rather than admitted unchecked."""
         import jax.numpy as jnp
         args = make_args()
         outs = {}
@@ -364,13 +440,15 @@ class LinAlg(object):
             except Exception:
                 had_errors = True
         if base not in outs:
-            return list(outs), had_errors
+            return [n for n in outs if n not in LinAlg._LOSSY], \
+                had_errors
         ref = outs[base]
         scale = float(jnp.max(jnp.abs(ref))) or 1.0
+        rtol = LinAlg._gate_rtol()
         keep = []
         for name, y in outs.items():
             err = float(jnp.max(jnp.abs(y - ref))) / scale
-            if err <= LinAlg._GATE_RTOL:
+            if err <= rtol:
                 keep.append(name)
         return keep, had_errors
 
@@ -385,6 +463,20 @@ class LinAlg(object):
         beta = complex(beta) if np.iscomplexobj(np.asarray(beta)) \
             else float(beta)
         cj = as_jax(c) if (c is not None and beta != 0) else None
+
+        def operand(x):
+            """(jax array or (re, im) f16 plane tuple, key fragment).
+            cf16 stays planar end-to-end — half-width HBM reads are
+            the point (reference: Cherk3mEx cf16,
+            src/linalg.cu:210-226); dtype is part of the key because a
+            winner (and gate result) measured for f32 is invalid for
+            c64 or cf16 at the same shape."""
+            cf = _cf16_reim(x)
+            if cf is not None:
+                return cf, '%s cf16' % (cf[0].shape,)
+            xj = as_jax(x)
+            return xj, '%s %s' % (xj.shape, xj.dtype)
+
         if b is None:
             reim = _int8_reim(a)
             if reim is not None:
@@ -394,20 +486,19 @@ class LinAlg(object):
                 y = self._jit('i8', name)(re, im, cj,
                                           alpha=alpha, beta=beta)
             else:
-                aj = as_jax(a)
-                # dtype is part of the key: a winner (and gate result)
-                # measured for f32 is invalid for c64 at the same shape
-                name = self._pick(
-                    'aah', 'shape=%s dt=%s' % (aj.shape, aj.dtype),
-                    _AAH_IMPLS, lambda: (aj,), gate=True)
+                aj, akey = operand(a)
+                # gate unconditionally: real-float races include the
+                # lossy single-pass bf16 candidate too
+                name = self._pick('aah', 'a=%s' % akey, _AAH_IMPLS,
+                                  lambda: (aj,), gate=True)
                 y = self._jit('aah', name)(aj, cj,
                                            alpha=alpha, beta=beta)
         else:
-            aj, bj = as_jax(a), as_jax(b)
+            aj, akey = operand(a)
+            bj, bkey = operand(b)
             name = self._pick(
-                'ab', 'a=%s b=%s dt=%s,%s' % (aj.shape, bj.shape,
-                                              aj.dtype, bj.dtype),
-                _AB_IMPLS, lambda: (aj, bj), gate=True)
+                'ab', 'a=%s b=%s' % (akey, bkey), _AB_IMPLS,
+                lambda: (aj, bj), gate=True)
             y = self._jit('ab', name)(aj, bj, cj,
                                       alpha=alpha, beta=beta)
         if c is not None:
