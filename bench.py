@@ -590,6 +590,26 @@ def bench_pallas_smoke():
                               % (type(e).__name__, str(e)[:150])}
     oks.append(out['fdmt_pallas']['ok'])
 
+    # fused Hermitian int8 correlation kernel (measured xcorr
+    # candidate 'pallas'; integer arithmetic must be bit-exact)
+    try:
+        from bifrost_tpu.ops.pallas_kernels import xcorr_herm
+        Tc, Fc, nc = 16, 4, 256
+        re8 = rng.randint(-64, 64, (Tc, Fc, nc)).astype(np.int8)
+        im8 = rng.randint(-64, 64, (Tc, Fc, nc)).astype(np.int8)
+        got = np.asarray(xcorr_herm(jnp.asarray(re8),
+                                    jnp.asarray(im8),
+                                    interpret=False))
+        x = re8.astype(np.float64) + 1j * im8
+        want = np.einsum('tfi,tfj->fij', x, np.conj(x))
+        out['xcorr_herm'] = {
+            'ok': bool(np.array_equal(got,
+                                      want.astype(np.complex64)))}
+    except Exception as e:
+        out['xcorr_herm'] = {'ok': False, 'error': '%s: %s'
+                             % (type(e).__name__, str(e)[:150])}
+    oks.append(out['xcorr_herm']['ok'])
+
     # stokes-detect elementwise kernel (stages.DetectStage fast path)
     try:
         from bifrost_tpu.ops import pallas_kernels as _pk

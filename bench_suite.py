@@ -369,11 +369,17 @@ def bench_correlate_ci8(ceil):
 
     # every framework auto-correlation layout is measured (VERDICT r4
     # item 2): einsum contraction vs pre-transposed batched GEMM vs the
-    # widened [re;im] gram matmul (ops.linalg._XCORR_AUTO_IMPLS; the
-    # reference's analogue is the hand cherk, src/linalg.cu:210-226)
+    # widened [re;im] gram matmul vs the fused Hermitian Pallas kernel
+    # (ops.linalg._XCORR_AUTO_IMPLS; the reference's analogue is the
+    # hand cherk, src/linalg.cu:210-226)
     from bifrost_tpu.ops.linalg import _XCORR_AUTO_IMPLS
     per_impl = {}
     for impl_name, impl_fn in sorted(_XCORR_AUTO_IMPLS.items()):
+        if impl_name == 'pallas' and not on_tpu:
+            per_impl[impl_name] = {
+                'skipped': 'tpu-only (interpret mode is orders of '
+                           'magnitude too slow at the bench shape)'}
+            continue
         def body(i, carry, impl_fn=impl_fn):
             # feed a carry-dependent zero into the operand: float 0*x
             # is not algebraically foldable (NaN semantics), so the
@@ -394,9 +400,11 @@ def bench_correlate_ci8(ceil):
             continue
         # impl-independent xGPU-style metric: complex-MAC/s
         cm = T * F * n * n / t / 1e12
-        # actual int MACs issued: the Hermitian 3-matmul forms issue
-        # 3; the cross forms and the widened gram issue 4
-        mac_mult = 3 if impl_name.endswith('3') else 4
+        # actual int MACs issued: the Hermitian 3-matmul forms (and
+        # the fused Pallas kernel) issue 3; the cross forms and the
+        # widened gram issue 4
+        mac_mult = 3 if impl_name.endswith('3') \
+            or impl_name == 'pallas' else 4
         per_impl[impl_name] = {
             'cmacs_T': round(cm, 2), 'ms': round(t * 1e3, 3),
             'issued_tops': round(2 * mac_mult * T * F * n * n / t

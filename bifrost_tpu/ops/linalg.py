@@ -601,15 +601,48 @@ def _xcorr_gram(re_i, im_i, re_j, im_j):
     return (rr + ii).astype(jnp.float32) + 1j * (ir - ri).astype(jnp.float32)
 
 
+def _xcorr_pallas(re_i, im_i, re_j, im_j):
+    """Auto-correlation only: the fused Hermitian Pallas kernel — all
+    three int8 MXU dots and the visibility epilogue stay in VMEM, one
+    HBM write per channel (ops.pallas_kernels.xcorr_herm).  Races
+    measured; auto-dropped where Mosaic rejects the shape."""
+    from .pallas_kernels import xcorr_herm
+    return xcorr_herm(re_i, im_i)
+
+
 _XCORR_IMPLS = {
     'einsum': _xcorr_einsum,
     'fmt': _xcorr_fmt,
 }
 _XCORR_AUTO_IMPLS = dict(_XCORR_IMPLS, einsum3=_xcorr_einsum3,
-                         fmt3=_xcorr_fmt3, gram=_xcorr_gram)
+                         fmt3=_xcorr_fmt3, gram=_xcorr_gram,
+                         pallas=_xcorr_pallas)
 
 _xcorr_jits = {}
 _xcorr_chosen = {}
+
+
+def _xcorr_race_impls(impls):
+    """Candidates eligible for the measured race on this backend.  The
+    pallas kernel races only on TPU and only when the cheap Pallas
+    availability probe passes: off-TPU its interpret-mode fallback is
+    orders of magnitude too slow to time at production shapes, and on
+    a backend where Pallas doesn't run, an ungated failure inside a
+    live pipeline process could poison every subsequent op (the lesson
+    bench._run_isolated documents).  A forced BF_LINALG_XCORR_IMPL or
+    explicit impl= still dispatches it regardless."""
+    if 'pallas' not in impls:
+        return impls
+    try:
+        import jax
+        on_tpu = jax.default_backend() == 'tpu'
+    except Exception:
+        on_tpu = False
+    if on_tpu:
+        from .pallas_kernels import available
+        if available():
+            return impls
+    return {k: v for k, v in impls.items() if k != 'pallas'}
 
 
 def xcorr_int8(re_i, im_i, re_j=None, im_j=None, impl=None):
@@ -655,7 +688,7 @@ def xcorr_int8(re_i, im_i, re_j=None, im_j=None, impl=None):
         if want and key not in _xcorr_chosen:
             from . import mprobe
             jitted = {n: _xcorr_jits.setdefault(n, jax.jit(f))
-                      for n, f in impls.items()}
+                      for n, f in _xcorr_race_impls(impls).items()}
             winner, ms, _ = mprobe.select(
                 'linalg_xcorr', key, jitted,
                 lambda: (re_i, im_i, re_j, im_j))

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ['available', 'stokes_detect']
+__all__ = ['available', 'stokes_detect', 'xcorr_herm']
 
 _checked = None
 
@@ -89,6 +89,60 @@ def stokes_detect(xr, xi, yr, yi, tile=512):
         out_shape=jax.ShapeDtypeStruct((T, 4, F), jnp.float32),
     )(xr, xi, yr, yi)
     return out
+
+
+def xcorr_herm(re, im, interpret=None):
+    """Fused int8 Hermitian auto-correlation, one channel per program.
+
+    Per frequency channel: the three Hermitian int8 MXU dots
+    (rr, ii, K with K = im^T.re contracting time) accumulate in VMEM
+    int32 and the visibility epilogue (re = rr+ii, im = K - K^T) is
+    applied before anything returns to HBM — so neither the widened
+    (2n)^2 gram intermediate nor the three separate int32 products are
+    ever materialized in HBM, and each visibility block is written
+    exactly once.  This is the TPU expression of the reference's
+    hand-kernel move (dp4a cherk with register accumulation,
+    src/linalg_kernels.cu:55); it races in the measured xcorr
+    selection (ops.linalg) and is dropped automatically wherever
+    Mosaic rejects it (e.g. shapes whose per-channel footprint exceeds
+    VMEM).
+
+    re, im: (T, F, n) int8 -> (F, n, n) complex64 visibilities.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    T, F, n = re.shape
+    if interpret is None:
+        # off-TPU the kernel still functions (slowly) in interpret
+        # mode, so CPU probe races complete without errors
+        interpret = jax.default_backend() != 'tpu'
+    dn = (((0,), (0,)), ((), ()))      # contract time (lhs-transposed)
+
+    def kernel(re_ref, im_ref, or_ref, oi_ref):
+        r = re_ref[:, 0, :]
+        i = im_ref[:, 0, :]
+        rr = jax.lax.dot_general(r, r, dn,
+                                 preferred_element_type=jnp.int32)
+        ii = jax.lax.dot_general(i, i, dn,
+                                 preferred_element_type=jnp.int32)
+        k = jax.lax.dot_general(i, r, dn,
+                                preferred_element_type=jnp.int32)
+        or_ref[0] = (rr + ii).astype(jnp.float32)
+        oi_ref[0] = (k - k.T).astype(jnp.float32)
+
+    spec_in = pl.BlockSpec((T, 1, n), lambda f: (0, f, 0))
+    spec_out = pl.BlockSpec((1, n, n), lambda f: (f, 0, 0))
+    vr, vi = pl.pallas_call(
+        kernel,
+        grid=(F,),
+        in_specs=[spec_in, spec_in],
+        out_specs=[spec_out, spec_out],
+        out_shape=[jax.ShapeDtypeStruct((F, n, n), jnp.float32)] * 2,
+        interpret=interpret,
+    )(re, im)
+    return vr + 1j * vi
 
 
 def fdmt_step(d1, d2, passthrough, rows_hi_max, sgn, T, interpret=False):

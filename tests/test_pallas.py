@@ -26,3 +26,19 @@ def test_stokes_detect_matches_jnp():
                        np.abs(x) ** 2 - np.abs(y) ** 2,
                        2 * xy.real, -2 * xy.imag], axis=1)
     np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-4)
+
+
+def test_xcorr_herm_exact_interpret():
+    """Fused Hermitian int8 correlation kernel vs the integer oracle
+    at a lane-aligned shape (interpret mode; the on-chip compile is
+    gated by bench.py --pallas-smoke)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(1)
+    T, F, n = 16, 3, 256
+    re = rng.randint(-64, 64, (T, F, n)).astype(np.int8)
+    im = rng.randint(-64, 64, (T, F, n)).astype(np.int8)
+    got = np.asarray(pk.xcorr_herm(jnp.asarray(re), jnp.asarray(im),
+                                   interpret=True))
+    x = re.astype(np.float64) + 1j * im
+    want = np.einsum('tfi,tfj->fij', x, np.conj(x))
+    np.testing.assert_array_equal(got, want.astype(np.complex64))
