@@ -37,6 +37,7 @@ class FdmtBlock(TransformBlock):
         self.exponent = exponent
         self.negative_delays = negative_delays
         self.fdmt = Fdmt()
+        self._mesh_fns = {}
 
     def define_valid_input_spaces(self):
         return ('tpu',)
@@ -72,6 +73,8 @@ class FdmtBlock(TransformBlock):
         self.dm_step = max_dm / self.max_delay
         self.fdmt.init(nchan, self.max_delay, f0, df, self.exponent,
                        space='tpu')
+        # cached mesh fns close over the previous sequence's plan
+        self._mesh_fns = {}
         # Pre-warm at sequence start, before any gulp flows: the
         # measured core probe + XLA compile otherwise land inside the
         # first on_data — and in the reference's world a first-gulp
@@ -86,9 +89,20 @@ class FdmtBlock(TransformBlock):
                 shape = tuple(int(s) if s != -1 else
                               int(gulp) + self.max_delay
                               for s in itensor['shape'])
-                self.fdmt.warmup(
-                    shape, DataType(itensor['dtype']).as_jax_dtype(),
-                    negative_delays=self.negative_delays)
+                mesh_fn = self._mesh_fn(shape)
+                if mesh_fn is not None:
+                    # the mesh path serves every full gulp: warm ITS
+                    # compile (the single-device warmup would build a
+                    # fn the steady state never executes)
+                    import jax
+                    import jax.numpy as jnp
+                    jax.block_until_ready(
+                        mesh_fn(jnp.zeros(shape, jnp.float32)))
+                else:
+                    self.fdmt.warmup(
+                        shape,
+                        DataType(itensor['dtype']).as_jax_dtype(),
+                        negative_delays=self.negative_delays)
             except Exception:
                 pass    # fall back to lazy build at first gulp
         ohdr = deepcopy(ihdr)
@@ -112,10 +126,63 @@ class FdmtBlock(TransformBlock):
         (reference: blocks/fdmt.py define_input_overlap_nframe)."""
         return self.max_delay
 
+    def _mesh_fn(self, shape):
+        """Time-sharded transform over the scope mesh when the gulp
+        admits it (2-D (nchan, T) data, time divisible by the mesh's
+        time axis, per-shard window >= max_delay for the adjacent-
+        neighbor halo).  Bit-compatible with the single-device core —
+        parallel.ops.sharded_fdmt exchanges a max_delay halo via
+        ppermute, so a shrunk final gulp simply falls back.  Built
+        once per shape; None caches negative decisions too."""
+        key = tuple(shape)
+        if key in self._mesh_fns:
+            return self._mesh_fns[key]
+        fn = None
+        mesh = self.mesh
+        if mesh is not None and len(shape) == 2:
+            from ..parallel.scope import time_axis_name
+            tname = time_axis_name(mesh)
+            if tname is not None:
+                n = int(mesh.shape[tname])
+                T = int(shape[-1])
+                if n > 1 and T % n == 0 and T // n >= self.max_delay:
+                    import jax
+                    import jax.numpy as jnp
+                    from jax.sharding import (NamedSharding,
+                                              PartitionSpec as P)
+                    from ..parallel.ops import sharded_fdmt
+                    # per-shard windows are (nchan, T/n + halo): probe
+                    # the measured core winner at that width rather
+                    # than running the mesh path on the unmeasured
+                    # gather core (the probe is cached/locked, so a
+                    # ragged later shape reuses it)
+                    core = self.fdmt._pick_core(
+                        self.negative_delays,
+                        shape=(int(shape[0]),
+                               T // n + self.max_delay))
+                    sharded = jax.jit(sharded_fdmt(
+                        mesh, self.fdmt, tname,
+                        negative_delays=self.negative_delays,
+                        core=core))
+                    in_sh = NamedSharding(mesh, P(None, tname))
+
+                    def fn(x, _sh=sharded, _in=in_sh):
+                        # mirror Fdmt._get_fn's wrapper: integer input
+                        # dtypes must compute (and publish) as f32
+                        x = x.astype(jnp.float32)
+                        return _sh(jax.device_put(x, _in))
+        self._mesh_fns[key] = fn
+        return fn
+
     def on_data(self, ispan, ospan):
         if ispan.nframe <= self.max_delay:
             return 0
-        ospan.set(self.fdmt.execute(ispan.data,
+        x = ispan.data
+        fn = self._mesh_fn(x.shape)
+        if fn is not None:
+            ospan.set(fn(getattr(x, 'data', x)))
+            return
+        ospan.set(self.fdmt.execute(x,
                                     negative_delays=self.negative_delays))
 
 
