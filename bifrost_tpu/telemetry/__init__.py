@@ -1,44 +1,249 @@
-"""Telemetry (opt-in stub).
+"""Local-only usage telemetry (reference:
+python/bifrost/telemetry/__init__.py:86-360).
 
-The reference ships opt-out usage reporting with an install UUID and
-HTTP POSTs (reference: python/bifrost/telemetry/__init__.py:86-197).
-This build deliberately ships a NO-OP implementation with the same API:
-nothing is ever collected or transmitted.  ``python -m
-bifrost_tpu.telemetry --disable`` is accepted for compatibility.
+The reference aggregates per-name call counts and timings and POSTs
+them to the maintainers (opt-out, install UUID).  This build keeps the
+full aggregation capability and the same decorator API but is
+**strictly local and opt-in**: aggregates merge into a JSON file under
+the local cache directory (``BF_CACHE_DIR`` or ``~/.bifrost_tpu``) and
+NOTHING is ever transmitted anywhere — there is no network code in
+this module.  Operators can inspect the file directly or via
+``python -m bifrost_tpu.telemetry --status``.
+
+Differences from the reference, deliberately:
+
+- default is DISABLED (the reference defaults enabled with opt-out);
+  ``enable()`` / ``python -m bifrost_tpu.telemetry --enable`` persists
+  the opt-in, ``disable()`` persists the opt-out;
+- the "send" step is a local file merge, never an HTTP POST;
+- no install key / UUID is generated.
 """
 
 from __future__ import annotations
 
-import functools
+import atexit
+import inspect
+import json
+import os
+import time
+from functools import wraps
+from threading import RLock
 
-__all__ = ['track_module', 'track_function', 'enable', 'disable',
-           'is_active']
+__all__ = ['is_active', 'enable', 'disable', 'track_script',
+           'track_module', 'track_function', 'track_function_timed',
+           'track_method', 'track_method_timed', 'usage_path']
 
-_active = False
+MAX_ENTRIES = 100     # flush the in-memory cache after this many names
+
+
+def _state_dir():
+    base = os.environ.get('BF_CACHE_DIR')
+    if base is None:
+        base = os.path.join(os.path.expanduser('~'), '.bifrost_tpu')
+    return base
+
+
+def _state_path():
+    return os.path.join(_state_dir(), 'telemetry_state')
+
+
+def usage_path():
+    """Path of the local usage-aggregate JSON file."""
+    return os.path.join(_state_dir(), 'telemetry_usage.json')
+
+
+class _LocalClient(object):
+    """Per-name (count, timed_count, total_seconds) aggregator with a
+    bounded in-memory cache, flushed by merge into the local JSON file
+    (the reference's _TelemetryClient with the network removed)."""
+    _lock = RLock()
+
+    def __init__(self):
+        self._cache = {}
+        self._session_start = time.time()
+        self._flush_blocked = False
+        self.active = self._load_state()
+        atexit.register(self.flush)
+
+    @staticmethod
+    def _load_state():
+        try:
+            with open(_state_path()) as f:
+                return f.read().strip() == 'enabled'
+        except OSError:
+            return False                      # opt-in: default off
+
+    @staticmethod
+    def _save_state(text):
+        try:
+            os.makedirs(_state_dir(), exist_ok=True)
+            with open(_state_path(), 'w') as f:
+                f.write(text)
+        except OSError:
+            pass
+
+    def track(self, name, timing=0.0):
+        if not self.active:
+            return False
+        with self._lock:
+            entry = self._cache.setdefault(name, [0, 0, 0.0])
+            entry[0] += 1
+            if timing > 0:
+                entry[1] += 1
+                entry[2] += timing
+            # a failed flush (read-only cache dir) must not turn every
+            # later tracked call into repeated failing syscalls: back
+            # off until an explicit flush()/disable() retries
+            if len(self._cache) >= MAX_ENTRIES \
+                    and not self._flush_blocked:
+                if not self.flush():
+                    self._flush_blocked = True
+        return True
+
+    def flush(self):
+        """Merge the cache into the LOCAL usage file (atomic replace,
+        serialized across processes by an fcntl lock so concurrent
+        exits cannot drop each other's counts).  This is the whole of
+        the reference's 'send' step — no bytes leave the machine.
+        Returns True when the cache was persisted."""
+        with self._lock:
+            if not self._cache:
+                return True
+            path = usage_path()
+            lockf = None
+            try:
+                os.makedirs(_state_dir(), exist_ok=True)
+                try:
+                    import fcntl
+                    lockf = open(path + '.lock', 'w')
+                    fcntl.flock(lockf, fcntl.LOCK_EX)
+                except (ImportError, OSError):
+                    lockf = None
+                data = {}
+                try:
+                    with open(path) as f:
+                        data = json.load(f)
+                except (OSError, ValueError):
+                    pass
+                for name, (n, nt, total) in self._cache.items():
+                    old = data.get(name, [0, 0, 0.0])
+                    data[name] = [old[0] + n, old[1] + nt,
+                                  round(old[2] + total, 6)]
+                tmp = path + '.tmp%d' % os.getpid()
+                with open(tmp, 'w') as f:
+                    json.dump(data, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+                self._cache.clear()
+                self._flush_blocked = False
+                return True
+            except OSError:
+                return False
+            finally:
+                if lockf is not None:
+                    lockf.close()
+
+    def enable(self):
+        self.active = True
+        self._save_state('enabled')
+
+    def disable(self):
+        self.flush()
+        self.active = False
+        self._save_state('disabled')
+
+
+_client = _LocalClient()
 
 
 def is_active():
-    return _active
+    """Whether local usage aggregation is on (never implies any
+    transmission — there is none)."""
+    return _client.active
 
 
 def enable():
-    """Telemetry collection is not implemented; this is a no-op."""
-    return False
-
-
-def disable():
+    """Opt in to LOCAL usage aggregation (persists)."""
+    _client.enable()
     return True
 
 
+def disable():
+    """Opt out (persists); flushes any pending aggregates first."""
+    _client.disable()
+    return True
+
+
+def track_script():
+    """Record the use of a tool/script (reference: track_script)."""
+    caller = inspect.currentframe().f_back
+    name = os.path.basename(caller.f_globals.get('__file__', '<repl>'))
+    _client.track('bifrost_tpu.tools.' + name)
+
+
 def track_module():
-    pass
+    """Record the import of a module (reference: track_module)."""
+    caller = inspect.currentframe().f_back
+    _client.track(caller.f_globals.get('__name__', '<unknown>'))
+
+
+def _qualname(fn):
+    frame = inspect.currentframe().f_back.f_back
+    mod = frame.f_globals.get('__name__', '<unknown>')
+    return '%s.%s()' % (mod, fn.__name__)
 
 
 def track_function(fn=None):
-    if fn is None:
+    """Decorator: count calls of ``fn`` (no timing)."""
+    if fn is None:                  # bare @track_function() usage
         return track_function
+    name = _qualname(fn)
 
-    @functools.wraps(fn)
+    @wraps(fn)
     def wrapper(*args, **kwargs):
-        return fn(*args, **kwargs)
+        result = fn(*args, **kwargs)
+        _client.track(name)
+        return result
+    return wrapper
+
+
+def track_function_timed(fn):
+    """Decorator: count calls of ``fn`` with execution time."""
+    name = _qualname(fn)
+
+    @wraps(fn)
+    def wrapper(*args, **kwargs):
+        t0 = time.perf_counter()
+        result = fn(*args, **kwargs)
+        _client.track(name, time.perf_counter() - t0)
+        return result
+    return wrapper
+
+
+def track_method(method):
+    """Decorator: count calls of a method, keyed by concrete class."""
+    frame = inspect.currentframe().f_back
+    mod = frame.f_globals.get('__name__', '<unknown>')
+    name = mod + '.%s.' + method.__name__ + '()'
+
+    @wraps(method)
+    def wrapper(*args, **kwargs):
+        result = method(*args, **kwargs)
+        _client.track(name % type(args[0]).__name__)
+        return result
+    return wrapper
+
+
+def track_method_timed(method):
+    """Decorator: count calls of a method with execution time."""
+    frame = inspect.currentframe().f_back
+    mod = frame.f_globals.get('__name__', '<unknown>')
+    name = mod + '.%s.' + method.__name__ + '()'
+
+    @wraps(method)
+    def wrapper(*args, **kwargs):
+        t0 = time.perf_counter()
+        result = method(*args, **kwargs)
+        _client.track(name % type(args[0]).__name__,
+                      time.perf_counter() - t0)
+        return result
     return wrapper

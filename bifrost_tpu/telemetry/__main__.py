@@ -1,13 +1,45 @@
-import sys
+"""CLI: toggle/inspect LOCAL usage aggregation (reference:
+python/bifrost/telemetry/__main__.py — minus the install key, which
+this build never generates; nothing is ever transmitted)."""
 
-from . import disable, enable, is_active
+import argparse
+import json
 
-if '--disable' in sys.argv:
-    disable()
-    print("bifrost_tpu telemetry is a no-op stub; nothing to disable.")
-elif '--enable' in sys.argv:
+from . import disable, enable, is_active, usage_path
+
+parser = argparse.ArgumentParser(
+    description='update the bifrost_tpu LOCAL telemetry setting '
+                '(aggregates stay on this machine; no network)')
+group = parser.add_mutually_exclusive_group(required=False)
+group.add_argument('-e', '--enable', action='store_true',
+                   help='enable local usage aggregation')
+group.add_argument('-d', '--disable', action='store_true',
+                   help='disable local usage aggregation')
+parser.add_argument('-s', '--status', action='store_true',
+                    help='show the aggregated usage counters')
+args = parser.parse_args()
+
+if args.enable:
     enable()
-    print("bifrost_tpu telemetry is a no-op stub; nothing was enabled.")
-else:
-    print("telemetry active: %s (always False in bifrost_tpu)"
-          % is_active())
+elif args.disable:
+    disable()
+
+# 'in-active' is the reference CLI's exact wording (its __main__.py
+# status line), kept for output parity — not a typo
+print("bifrost_tpu local telemetry is %s (file: %s)"
+      % ('active' if is_active() else 'in-active', usage_path()))
+
+if args.status:
+    try:
+        with open(usage_path()) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    if not data:
+        print("  no usage recorded")
+    for name in sorted(data):
+        n, nt, total = data[name]
+        line = "  %-60s %8d calls" % (name, n)
+        if nt:
+            line += "  %.3fs total" % total
+        print(line)

@@ -1,0 +1,126 @@
+"""Local-only telemetry (reference capability:
+python/bifrost/telemetry/__init__.py:86-360, minus the network — this
+build aggregates to a local JSON file, opt-in, and has no transport)."""
+
+import importlib
+import json
+import subprocess
+import sys
+
+import numpy as np  # noqa: F401  (parity with sibling test imports)
+import pytest
+
+
+@pytest.fixture
+def tele(monkeypatch, tmp_path):
+    """A fresh telemetry module state rooted in tmp_path."""
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    from bifrost_tpu import telemetry as T
+    client = T._LocalClient()
+    monkeypatch.setattr(T, '_client', client)
+    return T
+
+
+def test_default_disabled_and_no_file(tele, tmp_path):
+    assert not tele.is_active()
+    assert not tele._client.track('bifrost_tpu.whatever')
+    tele._client.flush()
+    assert not (tmp_path / 'telemetry_usage.json').exists()
+
+
+def test_enable_track_flush_merge(tele, tmp_path):
+    tele.enable()
+    assert tele.is_active()
+
+    @tele.track_function
+    def f(x):
+        return x + 1
+
+    @tele.track_function_timed
+    def g(x):
+        return x * 2
+
+    assert f(1) == 2 and f(2) == 3 and g(3) == 6
+    assert f.__name__ == 'f'              # wraps preserved
+    tele._client.flush()
+    data = json.loads((tmp_path / 'telemetry_usage.json').read_text())
+    fname = [k for k in data if k.endswith('.f()')]
+    gname = [k for k in data if k.endswith('.g()')]
+    assert fname and data[fname[0]][0] == 2
+    assert gname and data[gname[0]][0] == 1
+    assert data[gname[0]][1] == 1 and data[gname[0]][2] >= 0.0
+
+    # merge across sessions: a second flush ADDS
+    f(4)
+    tele._client.flush()
+    data2 = json.loads((tmp_path / 'telemetry_usage.json').read_text())
+    assert data2[fname[0]][0] == 3
+
+
+def test_disable_persists_and_stops_tracking(tele, tmp_path):
+    tele.enable()
+    tele.disable()
+    assert not tele.is_active()
+    assert (tmp_path / 'telemetry_state').read_text() == 'disabled'
+    assert not tele._client.track('bifrost_tpu.x')
+    # a fresh client (next session) reads the persisted opt-out
+    assert not tele._LocalClient().active
+
+
+def test_track_method_keys_by_class(tele, tmp_path):
+    tele.enable()
+
+    class A:
+        @tele.track_method
+        def run(self):
+            return 'a'
+
+    assert A().run() == 'a'
+    tele._client.flush()
+    data = json.loads((tmp_path / 'telemetry_usage.json').read_text())
+    assert any('.A.run()' in k for k in data), data
+
+
+def test_flush_backoff_on_failure(tele, monkeypatch):
+    """A failing flush (e.g. read-only cache dir) must not turn every
+    later tracked call into repeated failing syscalls; an explicit
+    flush retries."""
+    import os as _os
+    tele.enable()
+    orig = _os.replace
+    calls = []
+
+    def failing(src, dst):
+        calls.append(1)
+        raise OSError('read-only')
+
+    monkeypatch.setattr(_os, 'replace', failing)
+    for i in range(tele.MAX_ENTRIES + 5):
+        tele._client.track('bifrost_tpu.n%d' % i)
+    assert tele._client._flush_blocked
+    n_attempts = len(calls)
+    tele._client.track('bifrost_tpu.more')      # backed off: no I/O
+    assert len(calls) == n_attempts
+    monkeypatch.setattr(_os, 'replace', orig)
+    assert tele._client.flush()                 # explicit retry works
+    assert not tele._client._flush_blocked
+
+
+def test_module_has_no_network_code():
+    """The privacy stance is structural: no transport modules are ever
+    imported by the telemetry package."""
+    import bifrost_tpu.telemetry as T
+    src = open(T.__file__).read()
+    for needle in ('urllib', 'urlopen', 'http', 'socket', 'requests'):
+        assert needle not in src, needle
+
+
+def test_cli_status(tmp_path):
+    out = subprocess.run(
+        [sys.executable, '-m', 'bifrost_tpu.telemetry', '--status'],
+        capture_output=True, text=True, timeout=120,
+        env=dict(__import__('os').environ, BF_CACHE_DIR=str(tmp_path),
+                 JAX_PLATFORMS='cpu'),
+        cwd='/root/repo')
+    assert out.returncode == 0, out.stderr
+    assert 'in-active' in out.stdout

@@ -136,8 +136,15 @@ def test_proclog_roundtrip():
     assert 'process_time' in perf
 
 
-def test_telemetry_stub():
+def test_telemetry_decorators_inert_when_disabled(monkeypatch,
+                                                  tmp_path):
+    """The decorator API works regardless of state; with aggregation
+    off (the isolated default) nothing is recorded.  Full behavior:
+    tests/test_telemetry.py."""
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
     import bifrost_tpu.telemetry as tel
+    client = tel._LocalClient()
+    monkeypatch.setattr(tel, '_client', client)
     assert tel.is_active() is False
     tel.track_module()
 
@@ -145,6 +152,7 @@ def test_telemetry_stub():
     def f(x):
         return x + 1
     assert f(1) == 2
+    assert not client._cache
 
 
 def test_header_standard():
