@@ -610,9 +610,17 @@ def _xcorr_pallas(re_i, im_i, re_j, im_j):
     return xcorr_herm(re_i, im_i)
 
 
+def _xcorr_pallas_cross(re_i, im_i, re_j, im_j):
+    """Cross blocks (station-sharded mesh form): four fused int8 MXU
+    dots per channel (ops.pallas_kernels.xcorr_cross)."""
+    from .pallas_kernels import xcorr_cross
+    return xcorr_cross(re_i, im_i, re_j, im_j)
+
+
 _XCORR_IMPLS = {
     'einsum': _xcorr_einsum,
     'fmt': _xcorr_fmt,
+    'pallas': _xcorr_pallas_cross,
 }
 _XCORR_AUTO_IMPLS = dict(_XCORR_IMPLS, einsum3=_xcorr_einsum3,
                          fmt3=_xcorr_fmt3, gram=_xcorr_gram,
@@ -687,14 +695,16 @@ def xcorr_int8(re_i, im_i, re_j=None, im_j=None, impl=None):
         want = _probe_wanted()
         if want and key not in _xcorr_chosen:
             from . import mprobe
-            jitted = {n: _xcorr_jits.setdefault(n, jax.jit(f))
+            # jit cache keyed by family too: 'pallas' names different
+            # kernels in the auto and cross families
+            jitted = {n: _xcorr_jits.setdefault((auto, n), jax.jit(f))
                       for n, f in _xcorr_race_impls(impls).items()}
             winner, ms, _ = mprobe.select(
                 'linalg_xcorr', key, jitted,
                 lambda: (re_i, im_i, re_j, im_j))
             _xcorr_chosen[key] = winner or default
         name = _xcorr_chosen.get(key, default) if want else default
-    fn = _xcorr_jits.setdefault(name, jax.jit(impls[name]))
+    fn = _xcorr_jits.setdefault((auto, name), jax.jit(impls[name]))
     return fn(re_i, im_i, re_j, im_j)
 
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ['available', 'stokes_detect', 'xcorr_herm']
+__all__ = ['available', 'stokes_detect', 'xcorr_herm', 'xcorr_cross']
 
 _checked = None
 
@@ -91,6 +91,27 @@ def stokes_detect(xr, xi, yr, yi, tile=512):
     return out
 
 
+# shared scaffolding for the correlation kernels: contract the time
+# axis of (T, n) operands (lhs-transposed) with exact int32
+# accumulation; interpret-mode default keeps off-TPU probe races
+# functional (slowly) instead of erroring
+_XCORR_DN = (((0,), (0,)), ((), ()))
+
+
+def _dot_i32(a, b):
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.dot_general(a, b, _XCORR_DN,
+                               preferred_element_type=jnp.int32)
+
+
+def _xcorr_interpret(interpret):
+    if interpret is not None:
+        return interpret
+    import jax
+    return jax.default_backend() != 'tpu'
+
+
 def xcorr_herm(re, im, interpret=None):
     """Fused int8 Hermitian auto-correlation, one channel per program.
 
@@ -108,27 +129,21 @@ def xcorr_herm(re, im, interpret=None):
     VMEM).
 
     re, im: (T, F, n) int8 -> (F, n, n) complex64 visibilities.
+    For cross blocks (different i/j station sets) see xcorr_cross.
     """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     T, F, n = re.shape
-    if interpret is None:
-        # off-TPU the kernel still functions (slowly) in interpret
-        # mode, so CPU probe races complete without errors
-        interpret = jax.default_backend() != 'tpu'
-    dn = (((0,), (0,)), ((), ()))      # contract time (lhs-transposed)
+    interpret = _xcorr_interpret(interpret)
 
     def kernel(re_ref, im_ref, or_ref, oi_ref):
         r = re_ref[:, 0, :]
         i = im_ref[:, 0, :]
-        rr = jax.lax.dot_general(r, r, dn,
-                                 preferred_element_type=jnp.int32)
-        ii = jax.lax.dot_general(i, i, dn,
-                                 preferred_element_type=jnp.int32)
-        k = jax.lax.dot_general(i, r, dn,
-                                preferred_element_type=jnp.int32)
+        rr = _dot_i32(r, r)
+        ii = _dot_i32(i, i)
+        k = _dot_i32(i, r)
         or_ref[0] = (rr + ii).astype(jnp.float32)
         oi_ref[0] = (k - k.T).astype(jnp.float32)
 
@@ -142,6 +157,50 @@ def xcorr_herm(re, im, interpret=None):
         out_shape=[jax.ShapeDtypeStruct((F, n, n), jnp.float32)] * 2,
         interpret=interpret,
     )(re, im)
+    return vr + 1j * vi
+
+
+def xcorr_cross(re_i, im_i, re_j, im_j, interpret=None):
+    """Fused int8 cross-correlation, one channel per program (the
+    station-sharded mesh correlator's row-block x gathered-columns
+    form).  vis[f, a, b] = sum_t x_i[t, f, a] * conj(x_j[t, f, b]):
+    four int8 MXU dots accumulate in VMEM int32 and the complex
+    epilogue (rr+ii, ir-ri) is fused — no int32 products reach HBM.
+
+    re_i, im_i: (T, F, n_i) int8;  re_j, im_j: (T, F, n_j) int8
+    -> (F, n_i, n_j) complex64.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    T, F, ni = re_i.shape
+    nj = re_j.shape[-1]
+    interpret = _xcorr_interpret(interpret)
+
+    def kernel(ri_ref, ii_ref, rj_ref, ij_ref, or_ref, oi_ref):
+        ri = ri_ref[:, 0, :]
+        imi = ii_ref[:, 0, :]
+        rj = rj_ref[:, 0, :]
+        imj = ij_ref[:, 0, :]
+        rr = _dot_i32(ri, rj)
+        ii = _dot_i32(imi, imj)
+        ir = _dot_i32(imi, rj)
+        ri_ = _dot_i32(ri, imj)
+        or_ref[0] = (rr + ii).astype(jnp.float32)
+        oi_ref[0] = (ir - ri_).astype(jnp.float32)
+
+    spec_i = pl.BlockSpec((T, 1, ni), lambda f: (0, f, 0))
+    spec_j = pl.BlockSpec((T, 1, nj), lambda f: (0, f, 0))
+    spec_out = pl.BlockSpec((1, ni, nj), lambda f: (f, 0, 0))
+    vr, vi = pl.pallas_call(
+        kernel,
+        grid=(F,),
+        in_specs=[spec_i, spec_i, spec_j, spec_j],
+        out_specs=[spec_out, spec_out],
+        out_shape=[jax.ShapeDtypeStruct((F, ni, nj), jnp.float32)] * 2,
+        interpret=interpret,
+    )(re_i, im_i, re_j, im_j)
     return vr + 1j * vi
 
 

@@ -124,7 +124,7 @@ def test_xcorr_auto_impls_exact(impl):
     np.testing.assert_array_equal(y, oracle.astype(np.complex64))
 
 
-@pytest.mark.parametrize('impl', ['einsum', 'fmt'])
+@pytest.mark.parametrize('impl', ['einsum', 'fmt', 'pallas'])
 def test_xcorr_cross_impls_exact(impl):
     """Cross-correlation (different i/j station blocks, as in the
     mesh-sharded correlator)."""
