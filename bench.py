@@ -610,6 +610,28 @@ def bench_pallas_smoke():
                              % (type(e).__name__, str(e)[:150])}
     oks.append(out['xcorr_herm']['ok'])
 
+    # fused cross-correlation kernel (station-sharded mesh form)
+    try:
+        from bifrost_tpu.ops.pallas_kernels import xcorr_cross
+        Tc, Fc, ni, nj = 16, 4, 128, 256
+        ri8 = rng.randint(-64, 64, (Tc, Fc, ni)).astype(np.int8)
+        ii8 = rng.randint(-64, 64, (Tc, Fc, ni)).astype(np.int8)
+        rj8 = rng.randint(-64, 64, (Tc, Fc, nj)).astype(np.int8)
+        ij8 = rng.randint(-64, 64, (Tc, Fc, nj)).astype(np.int8)
+        got = np.asarray(xcorr_cross(
+            jnp.asarray(ri8), jnp.asarray(ii8),
+            jnp.asarray(rj8), jnp.asarray(ij8), interpret=False))
+        xi = ri8.astype(np.float64) + 1j * ii8
+        xj = rj8.astype(np.float64) + 1j * ij8
+        want = np.einsum('tfi,tfj->fij', xi, np.conj(xj))
+        out['xcorr_cross'] = {
+            'ok': bool(np.array_equal(got,
+                                      want.astype(np.complex64)))}
+    except Exception as e:
+        out['xcorr_cross'] = {'ok': False, 'error': '%s: %s'
+                              % (type(e).__name__, str(e)[:150])}
+    oks.append(out['xcorr_cross']['ok'])
+
     # stokes-detect elementwise kernel (stages.DetectStage fast path)
     try:
         from bifrost_tpu.ops import pallas_kernels as _pk
