@@ -145,3 +145,57 @@ def test_fdmt_probe_outside_on_data(monkeypatch):
     in_data = [s for flag, s in probes if flag]
     assert not in_data, \
         'FDMT core probe executed inside on_data at shapes %s' % in_data
+
+
+def test_xcorr_probe_outside_on_data(monkeypatch, tmp_path):
+    """With measured layout probing forced on, CorrelateBlock's xcorr
+    probe must run at on_sequence (xcorr_prewarm); no mprobe.select
+    may execute inside on_data — the traced call finds the winner in
+    the cache."""
+    from bifrost_tpu.blocks.correlate import CorrelateBlock
+    from bifrost_tpu.ops import mprobe
+    from bifrost_tpu.ops import linalg as L
+    from bifrost_tpu.dtype import ci8 as ci8_dtype
+
+    monkeypatch.setenv('BF_LINALG_PROBE', '1')
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    monkeypatch.setattr(L, '_xcorr_chosen', {})
+    state = {'in_on_data': False}
+    probes = []
+    orig_select = mprobe.select
+    orig_on_data = CorrelateBlock.on_data
+
+    def spy_select(name, *a, **k):
+        probes.append((state['in_on_data'], name))
+        return orig_select(name, *a, **k)
+
+    def spy_on_data(self, ispan, ospan):
+        state['in_on_data'] = True
+        try:
+            return orig_on_data(self, ispan, ospan)
+        finally:
+            state['in_on_data'] = False
+
+    monkeypatch.setattr(mprobe, 'select', spy_select)
+    monkeypatch.setattr(CorrelateBlock, 'on_data', spy_on_data)
+
+    rng = np.random.RandomState(3)
+    T, F, S, P = 16, 2, 3, 2
+    raw = np.zeros((T, F, S, P), dtype=ci8_dtype)
+    raw['re'] = rng.randint(-16, 16, size=raw.shape)
+    raw['im'] = rng.randint(-16, 16, size=raw.shape)
+    hdr = simple_header([-1, F, S, P], 'ci8',
+                        labels=['time', 'freq', 'station', 'pol'],
+                        gulp_nframe=8)
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock([raw[:8], raw[8:]], hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.correlate(b, nframe_per_integration=16)
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    assert sink.result() is not None
+    xsel = [(ind, n) for ind, n in probes if n == 'linalg_xcorr']
+    assert xsel, 'xcorr layout probe never ran (prewarm missing)'
+    assert not any(ind for ind, _ in xsel), \
+        'xcorr probe executed inside on_data (not pre-warmed)'
