@@ -160,6 +160,7 @@ def test_xcorr_probe_outside_on_data(monkeypatch, tmp_path):
     monkeypatch.setenv('BF_LINALG_PROBE', '1')
     monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
     monkeypatch.setattr(L, '_xcorr_chosen', {})
+    monkeypatch.setattr(mprobe, '_cache', {})
     state = {'in_on_data': False}
     probes = []
     orig_select = mprobe.select
@@ -199,3 +200,9 @@ def test_xcorr_probe_outside_on_data(monkeypatch, tmp_path):
     assert xsel, 'xcorr layout probe never ran (prewarm missing)'
     assert not any(ind for ind, _ in xsel), \
         'xcorr probe executed inside on_data (not pre-warmed)'
+    # the prewarmed winner must be keyed at the shape the traced
+    # on_data call actually looks up — a t_eff/shape mismatch would
+    # pass the asserts above while the gulps silently run the default
+    n = S * P
+    key = 'auto=True i=%s j=%s' % ((8, F, n), (8, F, n))
+    assert key in L._xcorr_chosen, (key, L._xcorr_chosen)
