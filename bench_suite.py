@@ -755,9 +755,18 @@ def main(argv=None):
                    '%s: %s' % (type(e).__name__, e)}
         res['value'] = round(res['value'], 2) if 'value' in res else None
         if 'roofline' in res:
-            res['roofline'] = {k: (round(v, 3)
-                                   if isinstance(v, float) else v)
-                               for k, v in res['roofline'].items()}
+            roof = {k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in res['roofline'].items()}
+            # a fraction above 1 means the ceiling probe under-measured
+            # THIS session (it is noisy through the tunnel); publish
+            # the contradiction as such instead of an impossible claim
+            bad = [k for k in ('bw_frac', 'mfu', 'hbm_frac')
+                   if isinstance(roof.get(k), float) and roof[k] > 1.02]
+            if bad:
+                roof['ceiling_inconsistent'] = (
+                    '%s > 1: the session ceiling probe under-measured; '
+                    'treat the fraction as ~1.0' % '/'.join(bad))
+            res['roofline'] = roof
         print(json.dumps({'config_id': c, **res}))
     return 0
 

@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import threading
 
-from . import ndarray as _nd
+# NOTE: `from . import ndarray` would resolve to the ndarray CLASS
+# (the package __init__ re-binds the name); import the constructors
+# directly
+from .ndarray import empty as _nd_empty
 
 __all__ = ['TempStorage']
 
@@ -28,7 +31,7 @@ class TempStorage(object):
             cur = self._buffers.get(key)
             if (cur is None or tuple(cur.shape) != tuple(shape)
                     or cur.dtype != dtype):
-                cur = _nd.empty(shape, dtype, self.space)
+                cur = _nd_empty(shape, dtype, self.space)
                 self._buffers[key] = cur
             return cur
 
@@ -40,7 +43,7 @@ class TempStorage(object):
             with self.parent._lock:
                 buf = self.parent._buffers.get('__raw__')
                 if buf is None or buf.shape[0] < self.nbytes:
-                    buf = _nd.empty((self.nbytes,), 'u8', self.parent.space)
+                    buf = _nd_empty((self.nbytes,), 'u8', self.parent.space)
                     self.parent._buffers['__raw__'] = buf
                 return buf
 
