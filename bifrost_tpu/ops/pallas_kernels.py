@@ -48,7 +48,7 @@ def enabled():
     return flag in ('1', 'true', 'yes', 'on') and available()
 
 
-def stokes_detect(xr, xi, yr, yi, tile=512):
+def stokes_detect(xr, xi, yr, yi, tile=512, interpret=False):
     """Stokes I,Q,U,V from dual-pol complex voltages given as re/im
     planes, as a tiled Pallas kernel.
 
@@ -87,6 +87,7 @@ def stokes_detect(xr, xi, yr, yi, tile=512):
         in_specs=[spec, spec, spec, spec],
         out_specs=pl.BlockSpec((T, 4, tile), lambda j: (0, 0, j)),
         out_shape=jax.ShapeDtypeStruct((T, 4, F), jnp.float32),
+        interpret=interpret,
     )(xr, xi, yr, yi)
     return out
 
