@@ -764,7 +764,7 @@ def run_suite_into(result):
                       'see pallas fused-spectrometer path)')}
     configs['2'] = c2
     ceil_f = {k: v for k, v in ceil.items() if isinstance(v, float)}
-    for cid in (1, 3, 4, 5, 6, 7):
+    for cid in (1, 3, 4, 5, 6, 7, 8):
         argv = ['bench_suite.py', '--config', str(cid)]
         if cid in (3, 4, 5) and ceil_f:
             # pass ceilings only when actually measured — an empty
@@ -806,7 +806,12 @@ def run_suite_into(result):
     result['traffic_model'] = traffic
     detail['traffic_model'] = traffic
 
-    name = 'BENCH_SUITE_r05.json' if platform == 'tpu' \
+    # capture label from the watcher (BF_BENCH_ROUND, default stamped
+    # by capture date) so future runs are never mislabeled with a
+    # stale hardcoded round number
+    round_tag = os.environ.get('BF_BENCH_ROUND') or \
+        time.strftime('r%Y%m%d', time.gmtime())
+    name = 'BENCH_SUITE_%s.json' % round_tag if platform == 'tpu' \
         else 'BENCH_SUITE_%s_validation.json' % platform
     try:
         with open(os.path.join(here, name), 'w') as f:

@@ -23,7 +23,7 @@ Usage mirrors the reference::
     bf.get_default_pipeline().run()
 """
 
-__version__ = '0.3.0'
+__version__ = '0.4.0'
 
 # Honor JAX_PLATFORMS even under PJRT plugins that ignore the env var
 # (the tunneled TPU plugin in this environment does): apply it through

@@ -4,6 +4,20 @@ python/bifrost/blocks/copy.py:45-71).
 Conversion between host storage and the device representation is defined
 in :mod:`bifrost_tpu.devrep` (bit-exact round trips; complex never
 crosses the host boundary — see xfer.py).
+
+Both directions ride the async transfer engine (bifrost_tpu.xfer):
+
+- host→device gulps are staged through the engine's reusable buffer
+  ring and shipped with a non-blocking device_put (devrep → xfer);
+- device→host gulps are committed as *deferred fills*
+  (xfer.HostFill): the span publishes immediately, the D2H readback
+  runs in flight, and readers of the output ring materialize the bytes
+  only when they first touch them — the writer thread never pays the
+  per-gulp hard sync the old ``np.asarray`` path did.
+
+``sync_strict=True`` (scope tunable) or BF_SYNC_STRICT=1 restores the
+fully synchronous behavior: every D2H completes before the span
+commits (the strict-mode completion bound).
 """
 
 from __future__ import annotations
@@ -34,15 +48,33 @@ class CopyBlock(TransformBlock):
     def on_sequence(self, iseq):
         return deepcopy(iseq.header)
 
+    def _d2h_strict(self):
+        """Synchronous D2H required?  Scope sync_strict wins; else the
+        engine's global async switch (BF_SYNC_STRICT / BF_XFER_ASYNC)."""
+        from .. import xfer
+        if self.sync_strict is not None:
+            return bool(self.sync_strict)
+        return not xfer.async_enabled()
+
     def on_data(self, ispan, ospan):
         ispace = ispan.ring.space
         ospace = ospan.ring.space
         if ospace == 'tpu' and ispace != 'tpu':
             buf = ispan.data.as_numpy()
-            ospan.set(to_device_rep(buf, ispan.dtype))
+            # engine-created device array: the committed chunk is
+            # exclusively this ring's (donation-eligible downstream)
+            ospan.set(to_device_rep(buf, ispan.dtype), owned=True)
         elif ispace == 'tpu' and ospace != 'tpu':
-            from_device_rep(ispan.data, ospan.dtype,
-                            ospan.data.as_numpy())
+            out = ospan.data.as_numpy()
+            if self._d2h_strict():
+                from_device_rep(ispan.data, ospan.dtype, out)
+            else:
+                # non-blocking: commit the span now, let the engine's
+                # bounded queue + the reader materialize the bytes
+                from .. import xfer
+                fill = xfer.engine().host_fill(ispan.data, ospan.dtype,
+                                               out)
+                ospan.set_fill(fill)
         elif ispace == 'tpu' and ospace == 'tpu':
             ospan.set(ispan.data)
         else:

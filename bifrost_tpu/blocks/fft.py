@@ -14,36 +14,55 @@ __all__ = ['FftBlock', 'fft']
 
 
 class _StageBlock(TransformBlock):
-    """TransformBlock driven by a single Stage."""
+    """TransformBlock driven by a single Stage.
+
+    With donation active (BlockScope(donate=True) / BF_DONATE=1) and an
+    exclusively-owned input chunk (ring.ReadSpan.take_data), the gulp
+    is passed through a donating jit so XLA can reuse its HBM buffer in
+    place for same-shape intermediates/outputs — an unfused stage chain
+    then recycles one gulp buffer per hop instead of allocating one.
+    (The donation resolve/take/fallback protocol is shared with
+    FusedBlock via TransformBlock._donation_on/_take_donatable.)"""
 
     def __init__(self, iring, stage, *args, **kwargs):
         super(_StageBlock, self).__init__(iring, *args, **kwargs)
         self._stage = stage
-        self._plan = None
-        self._plan_key = None
+        self._plans = {}       # (shape, dtype, donate) -> jitted fn
+        self._donate_on = None
 
     def define_valid_input_spaces(self):
         return ('tpu',)
 
     def on_sequence(self, iseq):
         self._ihdr = iseq.header
-        self._plan_key = None
+        self._plans = {}
+        self._donate_on = None
         return self._stage.transform_header(iseq.header)
 
     def define_output_nframes(self, input_nframe):
         return self._stage.output_nframe(input_nframe)
 
-    def on_data(self, ispan, ospan):
+    def _plan_for(self, x, donate):
         import jax
-        x = ispan.data
-        key = (tuple(x.shape), str(x.dtype))
-        if self._plan_key != key:
+        from ..ops.common import donating_jit
+        key = (tuple(x.shape), str(x.dtype), bool(donate))
+        fn = self._plans.get(key)
+        if fn is None:
             idt = DataType(self._ihdr['_tensor']['dtype'])
             meta = {'shape': list(x.shape), 'dtype': idt,
                     'reim': idt.kind == 'ci'}
-            self._plan = jax.jit(self._stage.build(meta))
-            self._plan_key = key
-        ospan.set(self._plan(x))
+            built = self._stage.build(meta)
+            fn = donating_jit(built, donate_argnums=(0,)) if donate \
+                else jax.jit(built)
+            self._plans[key] = fn
+        return fn
+
+    def on_data(self, ispan, ospan):
+        x = self._take_donatable(ispan)
+        donate = x is not None
+        if not donate:
+            x = ispan.data
+        ospan.set(self._plan_for(x, donate)(x), owned=True)
 
 
 class FftBlock(_StageBlock):

@@ -85,7 +85,10 @@ def stream_synchronize(*arrays):
     for a in arrays:
         if hasattr(a, 'as_jax') and a.space == 'tpu':
             a = a.data
-        if isinstance(a, jax.Array):
+        if isinstance(a, jax.Array) and not a.is_deleted():
+            # deleted arrays were donated downstream (xfer buffer
+            # donation): their computation was consumed — nothing left
+            # to wait on
             a.block_until_ready()
 
 
@@ -104,7 +107,9 @@ def force_completion(*arrays):
     for a in arrays:
         if hasattr(a, 'as_jax') and getattr(a, 'space', None) == 'tpu':
             a = a.data
-        if isinstance(a, jax.Array) and a.size:
+        if isinstance(a, jax.Array) and a.size and not a.is_deleted():
+            # donated (deleted) arrays are skipped — see
+            # stream_synchronize
             x = jnp.ravel(a)[0]
             if jnp.issubdtype(a.dtype, jnp.complexfloating):
                 x = jnp.real(x)

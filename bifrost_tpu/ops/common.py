@@ -2,12 +2,41 @@
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 from ..dtype import DataType
 
 __all__ = ['as_jax', 'as_logical_numpy', 'logical_dtype', 'astype',
-           'complexify']
+           'complexify', 'donating_jit']
+
+
+def donating_jit(fn, donate_argnums=(), **jit_kwargs):
+    """``jax.jit`` with ``donate_argnums`` for the gulp path: the donated
+    argument's HBM buffer may be reused in place for any same-shape
+    intermediate or output of the computation.
+
+    Donation is best-effort by design — when no output/temp matches the
+    donated buffer's layout XLA simply allocates as usual, and jax
+    emits a 'Some donated buffers were not usable' warning.  That
+    warning is noise on a heterogeneous chain (the input gulp rarely
+    matches the reduced output), so it is silenced — re-checked at each
+    plan build so the filter survives test harnesses that reset the
+    warning state, but never registered twice (process-global filter
+    growth would otherwise be unbounded across sequences).
+
+    Callers MUST pass arrays they exclusively own at the donated
+    positions (ring.ReadSpan.take_data provides the exclusivity proof
+    on the gulp path): a donated array is deleted after the call and
+    any later use raises."""
+    import jax
+    pattern = r'Some donated buffers were not usable.*'
+    if not any(f[0] == 'ignore' and f[1] is not None
+               and getattr(f[1], 'pattern', None) == pattern
+               for f in warnings.filters):
+        warnings.filterwarnings('ignore', message=pattern)
+    return jax.jit(fn, donate_argnums=donate_argnums, **jit_kwargs)
 
 
 def complexify(arr, dtype):

@@ -303,6 +303,10 @@ _I8_IMPLS = {
     'i8_gram': _aah_i8_gram,
 }
 
+#: (family, shapes_key) -> fallback impl frozen after a probe where
+#: every candidate errored — in-process only (see LinAlg._pick)
+_NEG_PROBE_CACHE = {}
+
 
 def _force_env(var, allowed):
     v = os.environ.get(var, '').strip().lower()
@@ -368,11 +372,27 @@ class LinAlg(object):
         accuracy-gated before timing.  Both the gate and the timing run
         at most once per (family, shape): a cached winner (in-process
         or on disk) is returned without executing any candidate, so the
-        steady-state gulp loop pays only dict lookups."""
+        steady-state gulp loop pays only dict lookups.  When every
+        candidate errors, the fallback default is remembered in-process
+        (negative cache) so steady-state calls stop re-running the full
+        gate+race every gulp."""
         if self._force[family]:
             self.chosen[family] = self._force[family]
             return self._force[family]
+        default = {'ab': 'xla', 'aah': 'xla', 'i8': 'i8_3mm'}[family]
+        if gate:
+            # the gate width is part of the measurement's identity: a
+            # winner admitted under a widened BF_LINALG_GATE_RTOL (e.g.
+            # the ~2^-8 single-pass bf16 path) must never be served to
+            # a default-gate session from the shared disk cache
+            rtol = self._gate_rtol()
+            if rtol != LinAlg._GATE_RTOL:
+                shapes_key = '%s|gate_rtol=%g' % (shapes_key, rtol)
         if _probe_wanted() and len(candidates) > 1:
+            neg = _NEG_PROBE_CACHE.get((family, shapes_key))
+            if neg is not None:
+                self.chosen[family] = neg
+                return neg
             from . import mprobe
             cached = mprobe.peek('linalg_%s' % family, shapes_key)
             if cached is not None and cached[0] in candidates:
@@ -397,7 +417,10 @@ class LinAlg(object):
                 self.chosen[family] = winner
                 self.probe_ms[family] = ms
                 return winner
-        default = {'ab': 'xla', 'aah': 'xla', 'i8': 'i8_3mm'}[family]
+            # every candidate errored (or was gated out): freeze the
+            # fallback for this shape in-process — not to disk, so a
+            # transient failure is re-measured next session
+            _NEG_PROBE_CACHE[(family, shapes_key)] = default
         self.chosen[family] = default
         return default
 

@@ -44,7 +44,7 @@ __all__ = ['Pipeline', 'BlockScope', 'Block', 'SourceBlock',
            'MultiTransformBlock', 'TransformBlock', 'SinkBlock',
            'get_default_pipeline', 'get_current_block_scope',
            'block_scope', 'block_view', 'get_ring', 'izip',
-           'PipelineInitError', 'EndOfDataStop']
+           'PipelineInitError', 'EndOfDataStop', 'resolve_donate']
 
 
 def izip(*iterables):
@@ -84,6 +84,16 @@ def block_scope(*args, **kwargs):
     return BlockScope(*args, **kwargs)
 
 
+def resolve_donate(scope):
+    """Effective buffer-donation setting for ``scope``: the ``donate``
+    tunable when set anywhere in the scope chain, else the BF_DONATE
+    environment default (off)."""
+    d = scope.donate
+    if d is not None:
+        return bool(d)
+    return os.environ.get('BF_DONATE', '0') == '1'
+
+
 class BlockScope(object):
     """Nestable configuration scope; unset attributes inherit from the
     enclosing scope (reference: pipeline.py:84-162).
@@ -92,7 +102,10 @@ class BlockScope(object):
     (index into jax.devices(); 'gpu' accepted as alias), mesh (a
     jax.sharding.Mesh for sharded ops within the scope), fuse,
     share_temp_storage, sync_depth (device run-ahead in gulps; default
-    DEFAULT_SYNC_DEPTH — peak device memory grows with it).
+    DEFAULT_SYNC_DEPTH — peak device memory grows with it), donate
+    (opt-in XLA buffer donation of exclusively-owned gulp inputs on
+    device blocks; requires single-consumer topology — see
+    docs/transfer.md; default off, BF_DONATE=1 enables globally).
     """
 
     #: default device run-ahead (gulps) when sync_depth is unset;
@@ -103,12 +116,12 @@ class BlockScope(object):
 
     _TUNABLES = ('gulp_nframe', 'buffer_nframe', 'buffer_factor', 'core',
                  'device', 'mesh', 'share_temp_storage', 'sync_depth',
-                 'sync_strict')
+                 'sync_strict', 'donate')
 
     def __init__(self, name=None, gulp_nframe=None, buffer_nframe=None,
                  buffer_factor=None, core=None, gpu=None, device=None,
                  mesh=None, share_temp_storage=False, fuse=False,
-                 sync_depth=None, sync_strict=None):
+                 sync_depth=None, sync_strict=None, donate=None):
         if name is None:
             name = 'BlockScope_%i' % BlockScope.instance_count
             BlockScope.instance_count += 1
@@ -122,6 +135,7 @@ class BlockScope(object):
         self._share_temp_storage = share_temp_storage
         self._sync_depth = sync_depth
         self._sync_strict = sync_strict
+        self._donate = donate
         self._fused = fuse
         self._temp_storage = {}
         self._parent_scope = get_current_block_scope() \
@@ -579,10 +593,18 @@ class Block(BlockScope):
 
     # -- dispatch-ahead backpressure --------------------------------------
     def _sync_gulp(self, ospans):
-        """Bound device run-ahead: enqueue this gulp's device arrays and,
-        once ``sync_depth`` gulps are outstanding, drain half the queue
-        with ONE wait (on the newest drained gulp — TPU executes in
-        enqueue order, so that implies the older ones finished).
+        """Bound device run-ahead: enqueue this gulp's device arrays
+        and, once ``sync_depth`` gulps are outstanding, drain all but
+        the newest with ONE wait (on the newest drained gulp — TPU
+        executes in enqueue order, so that implies the older ones
+        finished).  Steady state is therefore ONE hard host sync per
+        ``sync_depth`` gulps, the bound the transfer-engine telemetry
+        (``pipeline.sync_waits`` / ``pipeline.gulps``) verifies.
+        After a drain the device holds one queued gulp of lookahead —
+        enough to cover the host's per-gulp prep in the steady state
+        (host dispatch is faster than device execution on the hot
+        paths); a host-bound pipeline is bottlenecked by the host
+        under ANY drain policy.
 
         Amortizing the wait matters: a block_until_ready per gulp
         serializes the host against the device and halves pipeline
@@ -596,6 +618,11 @@ class Block(BlockScope):
         with BF_ASSUME_IN_ORDER=0 (out-of-order backend) every popped
         gulp is waited on instead.
 
+        The drain also retires any completed async host transfers in
+        the process transfer engine (xfer.TransferEngine.drain) — the
+        non-blocking D2H completion queue is emptied here instead of
+        at each readback.
+
         Strict mode (``sync_strict=True`` scope attribute, or
         BF_SYNC_STRICT=1): forces completion via a one-element value
         readback instead of block_until_ready.  On backends where
@@ -604,6 +631,8 @@ class Block(BlockScope):
         outputs; without it the sync_depth memory bound is best-effort
         there."""
         import os
+        from . import xfer
+        from .telemetry import counters
         depth = self.sync_depth if self.sync_depth is not None \
             else BlockScope.DEFAULT_SYNC_DEPTH
         strict = self.sync_strict
@@ -612,20 +641,43 @@ class Block(BlockScope):
         pend = getattr(self, '_pending_outputs', None)
         if pend is None:
             pend = self._pending_outputs = deque()
+        counters.inc('pipeline.gulps')
         arrays = [s._device_array for s in ospans
                   if getattr(s, '_device_array', None) is not None]
         if arrays:
+            # device-output gulps: the denominator for the hard-sync
+            # rate (waits per device gulp <= 1/sync_depth steady-state)
+            counters.inc('pipeline.gulps_device')
             pend.append(arrays)
         if len(pend) > depth:
-            drain = max(1, depth // 2)
-            popped = [pend.popleft() for _ in range(drain)]
+            popped = [pend.popleft() for _ in range(len(pend) - 1)]
             wait = device.force_completion if strict \
                 else device.stream_synchronize
+
+            def live(gulp):
+                # donated (deleted) arrays cannot be waited on and
+                # prove nothing about completion — waiting on them
+                # would be a silent no-op while the telemetry claims
+                # the run-ahead bound held
+                return [a for a in gulp
+                        if not getattr(a, 'is_deleted',
+                                       lambda: False)()]
             if device.execution_in_order():
-                wait(*popped[-1])
+                # newest popped gulp with anything left to wait on
+                for gulp in reversed(popped):
+                    arrs = live(gulp)
+                    if arrs:
+                        counters.inc('pipeline.sync_waits')
+                        wait(*arrs)
+                        break
             else:
                 for gulp in popped:
-                    wait(*gulp)
+                    arrs = live(gulp)
+                    if arrs:
+                        counters.inc('pipeline.sync_waits')
+                        wait(*arrs)
+        # retire completed async D2H transfers without blocking
+        xfer.engine().drain()
 
     # -- overridables ------------------------------------------------------
     def _define_output_nframes(self, input_nframes):
@@ -896,6 +948,28 @@ class TransformBlock(MultiTransformBlock):
     def __init__(self, iring, *args, **kwargs):
         super(TransformBlock, self).__init__([iring], *args, **kwargs)
         self.iring = self.irings[0]
+
+    # -- buffer donation (shared by FusedBlock / _StageBlock) -------------
+    def _donation_on(self):
+        """Effective donation setting (scope tunable / BF_DONATE),
+        resolved once per sequence (subclasses reset ``_donate_on`` to
+        None in on_sequence)."""
+        if getattr(self, '_donate_on', None) is None:
+            self._donate_on = resolve_donate(self)
+        return self._donate_on
+
+    def _take_donatable(self, ispan):
+        """The input span's device chunk claimed exclusively for
+        donation, or None (donation off / exclusivity unprovable —
+        callers fall back to ``ispan.data``).  Counts donation
+        hits/misses."""
+        if not self._donation_on():
+            return None
+        from .telemetry import counters
+        x = ispan.take_data()
+        counters.inc('donation.hits' if x is not None
+                     else 'donation.misses')
+        return x
 
     def _define_valid_input_spaces(self):
         return [self.define_valid_input_spaces()]

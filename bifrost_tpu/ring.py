@@ -185,6 +185,11 @@ class _HostStorage(object):
     def discard_before(self, offset):
         pass  # byte buffer reclaims implicitly
 
+    def fill_ghost_mirror(self, offset, nbyte):
+        """Ghost maintenance for a deferred fill (xfer.HostFill) that
+        landed after the span's commit-time mirror ran."""
+        self.commit_ghost(offset, nbyte)
+
 
 def _build_stitcher(plan, taxis):
     """Compile a stitcher for a piece plan: ('z', nframe) zero-fill and
@@ -228,7 +233,11 @@ class _DeviceStorage(object):
     CHANGELOG)."""
 
     def __init__(self):
-        self.chunks = {}   # abs byte offset -> (nbyte, jax.Array, time_axis)
+        # abs byte offset -> (nbyte, jax.Array, time_axis, owned)
+        # ``owned`` marks chunks whose array the framework created for
+        # this ring exclusively (H2D staging output, a jitted stage's
+        # result) — only those are eligible for buffer donation.
+        self.chunks = {}
         self._offsets = []          # sorted keys of self.chunks
         from .utils import ObjectCache
         # piece plan -> jitted stitcher; LRU-bounded so shifting
@@ -245,11 +254,27 @@ class _DeviceStorage(object):
             self._offsets = sorted(self.chunks)
         self.size, self.ghost, self.nringlet = size, ghost, nringlet
 
-    def put(self, offset, nbyte, array, time_axis):
+    def put(self, offset, nbyte, array, time_axis, owned=False):
         import bisect
         if offset not in self.chunks:
             bisect.insort(self._offsets, offset)
-        self.chunks[offset] = (nbyte, array, time_axis)
+        self.chunks[offset] = (nbyte, array, time_axis, owned)
+
+    def take(self, offset, nbyte):
+        """Claim exclusive ownership of the chunk covering EXACTLY
+        [offset, offset+nbyte) for buffer donation: removes it from the
+        map and returns the array, or None when no owned chunk covers
+        the request exactly.  Later reads of the range see a gap (zero
+        fill) — callers must guarantee single-consumption."""
+        hit = self.chunks.get(offset)
+        if hit is None or hit[0] != nbyte or not hit[3]:
+            return None
+        del self.chunks[offset]
+        try:
+            self._offsets.remove(offset)
+        except ValueError:
+            pass
+        return hit[1]
 
     def get(self, offset, nbyte, frame_nbyte, zeros_fn):
         """Assemble the logical array covering [offset, offset+nbyte).
@@ -266,7 +291,7 @@ class _DeviceStorage(object):
         plan, arrs, covered, taxis = [], [], offset, 0
         while covered < end and i < len(self._offsets):
             o = self._offsets[i]
-            cn, arr, ctaxis = self.chunks[o]
+            cn, arr, ctaxis = self.chunks[o][:3]
             i += 1
             if o + cn <= covered:
                 continue
@@ -298,11 +323,14 @@ class _DeviceStorage(object):
         return fn(*arrs)
 
     def discard_before(self, offset):
-        dead = [o for o, (cn, _, _) in self.chunks.items() if o + cn <= offset]
+        dead = [o for o, c in self.chunks.items() if o + c[0] <= offset]
         for o in dead:
             del self.chunks[o]
         if dead:
             self._offsets = sorted(self.chunks)
+
+    def fill_ghost_mirror(self, offset, nbyte):
+        pass   # device rings have no byte buffer / ghost region
 
 
 # ---------------------------------------------------------------------------
@@ -385,6 +413,9 @@ class Ring(object):
         self._eod = False
         self._nwrite_open = 0
         self._nread_open = 0
+        #: committed-but-in-flight D2H fills (xfer.HostFill): readers
+        #: gate on overlapping fills before touching span data
+        self._pending_fills = []
 
     # -- views ------------------------------------------------------------
     def view(self):
@@ -407,10 +438,23 @@ class Ring(object):
             if (size == self._size and ghost == self._ghost and
                     nringlet == self._nringlet):
                 return
-            # Wait until no spans are open anywhere before re-laying-out
+            # Wait until no spans are open anywhere AND no deferred D2H
+            # fill still targets the old buffer (its cached view would
+            # dangle after re-layout).  Waiting a fill drops the lock,
+            # so re-check both conditions until stable
             # (reference: RingReallocLock, ring_impl.cpp:60-84).
-            while self._nwrite_open or self._nread_open:
-                self._span_cond.wait()
+            while True:
+                while self._nwrite_open or self._nread_open:
+                    self._span_cond.wait()
+                fills = [f for f in self._pending_fills if not f.done]
+                if not fills:
+                    break
+                self._lock.release()
+                try:
+                    for f in fills:
+                        f.wait()
+                finally:
+                    self._lock.acquire()
             old = copy(self._storage)
             old.buf = getattr(self._storage, 'buf', None)
             self._storage.allocate(size, ghost, nringlet,
@@ -694,6 +738,54 @@ class Ring(object):
     def _overwritten_in(self, begin, nbyte):
         with self._lock:
             return max(0, min(self._tail - begin, nbyte))
+
+    # -- deferred D2H fills (xfer.HostFill) -------------------------------
+    def _register_fill(self, fill):
+        with self._lock:
+            self._pending_fills.append(fill)
+
+    def _fills_overlapping(self, begin, nbyte):
+        """Snapshot of incomplete fills overlapping [begin, begin+nbyte)
+        in absolute offsets; also prunes completed fills.  Callers wait
+        the returned fills OUTSIDE the ring lock."""
+        with self._lock:
+            self._pending_fills = [f for f in self._pending_fills
+                                   if not f.done]
+            return [f for f in self._pending_fills
+                    if f.begin is not None
+                    and f.begin < begin + nbyte
+                    and begin < f.begin + f.nbyte]
+
+    def _fills_before(self, limit):
+        """Incomplete fills whose bytes a reservation ending past
+        ``limit + size`` is about to overwrite (modular reuse of the
+        same buffer region) — the writer completes these before any new
+        bytes land."""
+        with self._lock:
+            self._pending_fills = [f for f in self._pending_fills
+                                   if not f.done]
+            return [f for f in self._pending_fills
+                    if f.begin is not None and f.begin < limit]
+
+    # -- device-chunk donation hook ---------------------------------------
+    def _take_exclusive(self, begin, nbyte):
+        """Claim the committed device chunk covering exactly
+        [begin, begin+nbyte) for buffer donation, or None when
+        exclusivity cannot be established: the chunk must be
+        framework-owned and this ring must have exactly one reader
+        holding exactly one open span (the caller's).  This is a
+        point-in-time check — a second reader that is momentarily
+        between spans (e.g. an unguaranteed monitor tap) is NOT
+        detected and would later see zero-fill where the donated chunk
+        was.  Donation is therefore opt-in (BF_DONATE /
+        BlockScope(donate=True)) and requires a single-consumer
+        topology by contract — see docs/transfer.md."""
+        if self.space != 'tpu':
+            return None
+        with self._lock:
+            if self._nread_open != 1 or len(self._guarantees) > 1:
+                return None
+            return self._storage.take(begin, nbyte)
 
 
 class RingView(object):
@@ -985,11 +1077,19 @@ class WriteSpan(_SpanAPI):
         self._commit_nbyte = None
         self._device_array = None
         self._native_id = None
+        self._owned = False
+        self._fill = None
         self._begin = ring._reserve_span(self._nbyte, nonblocking,
                                          span=self)
         with ring._lock:
             ring._open_wspans.append(self)
             ring._nwrite_open += 1
+        # A wrapped reservation reuses buffer bytes a still-pending
+        # deferred fill targets; complete those before writing.
+        if ring.space != 'tpu' and getattr(ring, '_pending_fills', None):
+            limit = self._begin + self._nbyte - ring.total_span
+            for f in ring._fills_before(limit):
+                f.wait()
         # Default to committing 0 frames so an exception in on_data doesn't
         # publish garbage (reference: ring2.py:463-464).
         self.commit_nframe = 0
@@ -1007,15 +1107,29 @@ class WriteSpan(_SpanAPI):
     def data(self, array):
         self.set(array)
 
-    def set(self, array):
-        """Publish a computed gulp into this span."""
+    def set(self, array, owned=False):
+        """Publish a computed gulp into this span.  ``owned=True``
+        (device rings) marks the array as created exclusively for this
+        ring — the committed chunk is then eligible for buffer donation
+        downstream (ring._take_exclusive)."""
         if self._ring.space == 'tpu':
             if isinstance(array, ndarray):
                 array = array.as_jax()
             self._device_array = array
+            self._owned = bool(owned)
         else:
             from .ndarray import copy_array
             copy_array(self.data, array)
+        return self
+
+    def set_fill(self, fill):
+        """Publish this host span's bytes as a deferred D2H fill
+        (xfer.HostFill targeting a view of this span): the span commits
+        immediately and readers gate on the fill, so the writer never
+        hard-syncs on the transfer."""
+        if self._ring.space == 'tpu':
+            raise ValueError("set_fill is for host-space rings")
+        self._fill = fill
         return self
 
     def commit(self, nframe):
@@ -1030,8 +1144,30 @@ class WriteSpan(_SpanAPI):
 
     def close(self):
         commit_nbyte = self.commit_nframe * self.frame_nbyte
-        if self._ring.space != 'tpu' and commit_nbyte:
-            self._ring._storage.commit_ghost(self._begin, commit_nbyte)
+        if self._ring.space != 'tpu':
+            if self._fill is not None:
+                if commit_nbyte == self._nbyte:
+                    # commit now, bytes later: the fill redoes the
+                    # ghost mirror once data lands; readers gate on it
+                    self._fill.attach(self._ring, self._begin,
+                                      commit_nbyte)
+                    self._ring._register_fill(self._fill)
+                elif commit_nbyte:
+                    # PARTIAL commit: the fill targets the full span
+                    # view, but the truncated tail's bytes roll back
+                    # and become re-reservable the moment this commit
+                    # lands — complete the fill NOW, while the whole
+                    # reservation is still ours
+                    self._fill.attach(self._ring, self._begin,
+                                      commit_nbyte)
+                    self._fill.wait()
+                else:
+                    # nothing published: a late write would land in
+                    # re-reservable bytes
+                    self._fill.cancel()
+            elif commit_nbyte:
+                self._ring._storage.commit_ghost(self._begin,
+                                                 commit_nbyte)
         self._ring._commit_span(self, commit_nbyte)
 
     def _finalize_storage(self, commit_nbyte):
@@ -1045,7 +1181,8 @@ class WriteSpan(_SpanAPI):
                 idx = [slice(None)] * arr.ndim
                 idx[taxis] = slice(0, nframe_c)
                 arr = arr[tuple(idx)]
-            self._ring._storage.put(self._begin, commit_nbyte, arr, taxis)
+            self._ring._storage.put(self._begin, commit_nbyte, arr,
+                                    taxis, owned=self._owned)
 
 
 class ReadSpan(_SpanAPI):
@@ -1062,6 +1199,11 @@ class ReadSpan(_SpanAPI):
         self.requested_frame_offset = frame_offset
         self.nframe_skipped = min(self.frame_offset - frame_offset, nframe)
         if self._ring.space != 'tpu' and nbyte:
+            # materialize any in-flight D2H fill overlapping this span
+            # before exposing its bytes (outside the ring lock; by now
+            # the transfer has usually finished — residual wait only)
+            for f in self._ring._fills_overlapping(begin, nbyte):
+                f.wait()
             self._ring._storage.refresh_ghost(begin, nbyte)
         self._data = None
 
@@ -1082,6 +1224,22 @@ class ReadSpan(_SpanAPI):
         else:
             self._data = self._host_view(writeable=False)
         return self._data
+
+    def take_data(self):
+        """Device rings: claim this span's committed chunk exclusively
+        for buffer donation (the array is consumed in place by a
+        donating jit and must not be read again).  Returns the array,
+        or None when exclusivity cannot be proven — partial span,
+        multi-chunk stitch, multi-reader ring, or a chunk the framework
+        does not own (WriteSpan.set(..., owned=True)).  Callers fall
+        back to ``.data`` on None."""
+        if self._ring.space != 'tpu' or self._data is not None \
+                or not self._nbyte:
+            return None
+        arr = self._ring._take_exclusive(self._begin, self._nbyte)
+        if arr is not None:
+            self._data = arr
+        return arr
 
     @property
     def nframe_overwritten(self):

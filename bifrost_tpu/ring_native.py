@@ -102,6 +102,28 @@ class _NativeStorage(object):
     def discard_before(self, offset):
         pass
 
+    def fill_ghost_mirror(self, offset, nbyte):
+        """Re-run the wrap-around ghost mirror after a deferred D2H
+        fill (xfer.HostFill) landed: the C core mirrored at commit
+        time, BEFORE the fill's bytes existed, so a wrapped span's
+        overflow must be mirrored back to the buffer start again."""
+        lib = self._ring._lib
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        size = ctypes.c_longlong()
+        ghost = ctypes.c_longlong()
+        nrl = ctypes.c_longlong()
+        native.check(lib.bft_ring_geometry(
+            self._ring._handle, ctypes.byref(buf), ctypes.byref(size),
+            ctypes.byref(ghost), ctypes.byref(nrl)), 'geometry')
+        bo = offset % size.value
+        over = bo + nbyte - size.value
+        if over <= 0:
+            return
+        lane = size.value + ghost.value
+        base = np.ctypeslib.as_array(buf, shape=(nrl.value * lane,))
+        lanes = base.reshape(nrl.value, lane)
+        lanes[:, :over] = lanes[:, size.value:size.value + over]
+
 
 class NativeRing(Ring):
     def __init__(self, space='system', name=None, owner=None, core=None):
@@ -149,6 +171,18 @@ class NativeRing(Ring):
 
     # -- geometry ---------------------------------------------------------
     def resize(self, contiguous_bytes, total_bytes=None, nringlet=1):
+        # deferred D2H fills hold numpy views into the current native
+        # buffer; complete them before the core may re-layout it.
+        # (Best-effort for the native core: a fill registered between
+        # the last check and the C resize could still target the old
+        # buffer — in practice resizes happen at sequence start and
+        # fills drain within the engine's bounded depth.)
+        for _ in range(8):
+            fills = [f for f in self._pending_fills if not f.done]
+            if not fills:
+                break
+            for f in fills:
+                f.wait()
         native.check(self._lib.bft_ring_resize(
             self._handle, contiguous_bytes,
             -1 if total_bytes is None else total_bytes, nringlet),

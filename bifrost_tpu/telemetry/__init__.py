@@ -29,9 +29,12 @@ import time
 from functools import wraps
 from threading import RLock
 
+from . import counters  # noqa: F401  (always-on perf counters)
+
 __all__ = ['is_active', 'enable', 'disable', 'track_script',
            'track_module', 'track_function', 'track_function_timed',
-           'track_method', 'track_method_timed', 'usage_path']
+           'track_method', 'track_method_timed', 'usage_path',
+           'counters']
 
 MAX_ENTRIES = 100     # flush the in-memory cache after this many names
 
@@ -122,7 +125,23 @@ class _LocalClient(object):
                 data = {}
                 try:
                     with open(path) as f:
-                        data = json.load(f)
+                        loaded = json.load(f)
+                    # validate entry shape: a malformed/corrupted usage
+                    # file (truncated write, foreign JSON) must cost at
+                    # most the bad entries — never a TypeError out of
+                    # track() or the atexit handler.  Good entries are
+                    # [count, timed_count, seconds] with numeric slots.
+                    if isinstance(loaded, dict):
+                        for name, entry in loaded.items():
+                            if (isinstance(name, str)
+                                    and isinstance(entry, (list, tuple))
+                                    and len(entry) >= 3
+                                    and all(isinstance(v, (int, float))
+                                            and not isinstance(v, bool)
+                                            for v in entry[:3])):
+                                data[name] = [int(entry[0]),
+                                              int(entry[1]),
+                                              float(entry[2])]
                 except (OSError, ValueError):
                     pass
                 for name, (n, nt, total) in self._cache.items():

@@ -1,0 +1,62 @@
+"""In-process performance counters for the transfer engine and the
+pipeline gulp loop.
+
+Unlike the usage telemetry in :mod:`bifrost_tpu.telemetry` (opt-in,
+persisted), these are always-on, process-local integers with no
+persistence and no I/O: the hot paths (per-gulp transfer issue, sync
+waits, donation hits) increment them under a lock, and benchmarks /
+tests read a snapshot to verify overlap claims (e.g. "hard syncs per
+gulp dropped from 1 to <= 1/sync_depth").
+
+Counter names used by the framework:
+
+- ``xfer.h2d_issued`` / ``xfer.h2d_bytes``  host->device transfers
+- ``xfer.h2d_staged``                      H2D via a reused staging slot
+- ``xfer.h2d_unstaged``                    H2D that fell back to a fresh
+                                           defensive copy
+- ``xfer.d2h_issued`` / ``xfer.d2h_bytes``  device->host transfers
+- ``xfer.d2h_async``                       D2H issued non-blocking
+                                           (copy_to_host_async + queue)
+- ``xfer.sync_waits``                      hard host blocks inside a
+                                           transfer (result not ready)
+- ``pipeline.sync_waits``                  dispatch-ahead drain waits in
+                                           Block._sync_gulp
+- ``pipeline.gulps``                       gulps processed through
+                                           Block._sync_gulp
+- ``donation.hits`` / ``donation.misses``   gulp inputs donated to XLA /
+                                           eligible but not exclusive
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+__all__ = ['inc', 'get', 'snapshot', 'reset']
+
+_lock = threading.Lock()
+_counts = defaultdict(int)
+
+
+def inc(name, n=1):
+    """Add ``n`` to counter ``name`` (thread-safe)."""
+    with _lock:
+        _counts[name] += n
+
+
+def get(name):
+    """Current value of counter ``name`` (0 if never incremented)."""
+    with _lock:
+        return _counts.get(name, 0)
+
+
+def snapshot():
+    """Copy of all counters as a plain dict."""
+    with _lock:
+        return dict(_counts)
+
+
+def reset():
+    """Zero all counters (tests/benchmarks)."""
+    with _lock:
+        _counts.clear()

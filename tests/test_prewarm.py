@@ -23,9 +23,9 @@ def test_fused_plan_builds_outside_on_data(monkeypatch):
     orig_build = FusedBlock._build_plan
     orig_on_data = FusedBlock.on_data
 
-    def spy_build(self, shape, dtype):
+    def spy_build(self, shape, dtype, donate=False):
         builds.append(state['in_on_data'])
-        return orig_build(self, shape, dtype)
+        return orig_build(self, shape, dtype, donate=donate)
 
     def spy_on_data(self, ispan, ospan):
         state['in_on_data'] = True

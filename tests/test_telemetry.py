@@ -106,6 +106,36 @@ def test_flush_backoff_on_failure(tele, monkeypatch):
     assert not tele._client._flush_blocked
 
 
+def test_flush_survives_corrupted_usage_file(tele, tmp_path):
+    """A malformed telemetry_usage.json (truncated write, foreign JSON)
+    must cost at most the bad entries — flush() may never raise
+    TypeError/IndexError out of track() or the atexit handler."""
+    tele.enable()
+    usage = tmp_path / 'telemetry_usage.json'
+    # entry shapes that used to explode the merge loop
+    usage.write_text(json.dumps({
+        'bifrost_tpu.bad_scalar': 42,              # not a list
+        'bifrost_tpu.bad_short': [1],              # too short
+        'bifrost_tpu.bad_types': ['x', None, {}],  # non-numeric slots
+        'bifrost_tpu.good': [3, 1, 0.5],           # valid, must survive
+    }))
+    tele._client.track('bifrost_tpu.good')
+    tele._client.track('bifrost_tpu.fresh')
+    assert tele._client.flush()
+    data = json.loads(usage.read_text())
+    assert data['bifrost_tpu.good'][0] == 4        # merged, not reset
+    assert data['bifrost_tpu.fresh'][0] == 1
+    for bad in ('bad_scalar', 'bad_short', 'bad_types'):
+        assert 'bifrost_tpu.%s' % bad not in data
+
+    # a top-level non-dict document is discarded wholesale
+    usage.write_text(json.dumps([1, 2, 3]))
+    tele._client.track('bifrost_tpu.after_list')
+    assert tele._client.flush()
+    data = json.loads(usage.read_text())
+    assert data['bifrost_tpu.after_list'][0] == 1
+
+
 def test_module_has_no_network_code():
     """The privacy stance is structural: no transport modules are ever
     imported by the telemetry package."""

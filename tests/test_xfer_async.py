@@ -1,0 +1,441 @@
+"""Async transfer engine (bifrost_tpu.xfer): staging aliasing safety,
+out-of-order completion drain, deferred D2H ring fills, buffer
+donation bit-exactness, and the sync_strict fallback."""
+
+import gc
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu import xfer
+from bifrost_tpu.telemetry import counters
+from tests.util import NumpySourceBlock, GatherSink, simple_header
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    counters.reset()
+    yield
+    xfer.reset_engine()
+
+
+# ---------------------------------------------------------------------------
+# staging aliasing safety (the bug the old defensive copy guarded)
+# ---------------------------------------------------------------------------
+
+def test_to_device_does_not_alias_recycled_host_memory():
+    """A writer recycling its host buffer right after to_device must
+    not corrupt the device array — the exact CPU-backend zero-copy bug
+    the old defensive copy guarded against."""
+    eng = xfer.TransferEngine()
+    ringbuf = np.arange(64 * 1024, dtype=np.float32).reshape(64, 1024)
+    want = ringbuf.copy()
+    d = eng.to_device(ringbuf)
+    ringbuf[...] = -1.0                 # writer recycles the gulp
+    assert np.array_equal(np.asarray(d), want)
+
+
+def test_to_device_alias_safe_under_inflight_compute():
+    """Recycling the source while a dispatched computation is still
+    running must not change its result (staging buffers are never
+    reused while any consumer may read them)."""
+    import jax
+    eng = xfer.TransferEngine()
+    fn = jax.jit(lambda x: (x @ x).sum())
+    src = np.full((512, 512), 1.0, np.float32)
+    d = eng.to_device(src)
+    y = fn(d)                           # async dispatch reads d
+    del d
+    src[...] = 0.0                      # recycle immediately
+    gc.collect()
+    # a second transfer of the same shape must not steal the buffer
+    eng.to_device(np.zeros((512, 512), np.float32))
+    assert float(y) == 512.0 * 512 * 512
+
+
+def test_staging_pool_recycles_only_completed_transfers():
+    """Copying-backend protocol (forced via zero_copy=False): a slot
+    returns to the pool only once its transfer is observed complete;
+    a slot whose array died unobserved is dropped, not reused."""
+    eng = xfer.TransferEngine(staging=2, zero_copy=False)
+    a = np.ones((256, 256), np.float32)
+    d1 = eng.to_device(a)
+    d1.block_until_ready()
+    assert counters.get('xfer.h2d_staged') == 1
+    # d1 complete and still alive: its slot is reclaimable
+    d2 = eng.to_device(a * 2)
+    assert counters.get('xfer.h2d_staged') == 2
+    pool = eng._pool
+    assert pool._nalloc[((256, 256), 'float32')] <= 2
+    # kill an array whose completion was never observed after this
+    # point: the pool must DROP the slot (nalloc decremented), never
+    # hand its buffer out for reuse
+    slot_entry = [s for s in pool._busy if s.ref() is d2]
+    assert slot_entry
+    del d2
+    gc.collect()
+    assert slot_entry[0].recycled
+    buf_id = id(slot_entry[0].buf)
+    free = pool._free.get(((256, 256), 'float32'), [])
+    assert all(id(b) != buf_id for b in free)
+
+
+# ---------------------------------------------------------------------------
+# non-blocking D2H: futures, queue bound, out-of-order drain
+# ---------------------------------------------------------------------------
+
+def test_staging_pool_survives_donated_arrays():
+    """Regression: the pool's reclaim scan must not poll is_ready() on
+    an array that was donated (deleted) downstream — that crashes the
+    runtime.  And deletion happens at DISPATCH time, proving nothing
+    about the DMA, so the slot must be DROPPED (never reused)."""
+    from bifrost_tpu.ops.common import donating_jit
+    eng = xfer.TransferEngine(staging=2, zero_copy=False)
+    a = np.ones((128, 128), np.float32)
+    d = eng.to_device(a)
+    d.block_until_ready()
+    pool = eng._pool
+    slot = [s for s in pool._busy if s.ref() is d][0]
+    buf_id = id(slot.buf)
+    fn = donating_jit(lambda x: x + 1.0, donate_argnums=(0,))
+    y = fn(d)                       # d is now deleted, slot still bound
+    assert d.is_deleted()
+    d2 = eng.to_device(a * 3)       # triggers the reclaim scan
+    assert np.array_equal(np.asarray(d2), a * 3)
+    assert float(y[0, 0]) == 2.0
+    # the donated slot was retired, not recycled into the free list
+    assert slot.recycled
+    assert all(id(b) != buf_id
+               for bufs in pool._free.values() for b in bufs)
+
+
+def test_to_device_empty_array():
+    """Zero-size gulps must transfer cleanly (regression: the aligned
+    allocator rejected empty shapes)."""
+    eng = xfer.TransferEngine()
+    for zc in (True, False):
+        e = xfer.TransferEngine(zero_copy=zc)
+        d = e.to_device(np.empty((0, 4), np.float32))
+        assert np.asarray(d).shape == (0, 4)
+    assert np.asarray(eng.to_device(np.float32(3.0))).shape == ()
+
+
+def test_early_completed_fill_still_mirrors_ghost(monkeypatch):
+    """Regression: with the async queue disabled but the fill path
+    active (sync_strict=False scope + BF_XFER_ASYNC=0), fills complete
+    BEFORE the span closes; the ghost mirror for wrapped spans must
+    still run (at attach), or readers of wrapped bytes see stale
+    data."""
+    monkeypatch.setenv('BF_XFER_ASYNC', '0')
+    # Python ring core: its commit-time ghost mirror is SKIPPED for
+    # spans carrying a fill (the fill owns mirroring), so an
+    # early-completed fill relies entirely on the attach-time mirror.
+    # (The native core re-mirrors inside bft_ring_commit, which runs
+    # after a synchronously-completed fill's write — covered there.)
+    monkeypatch.setenv('BF_NO_NATIVE', '1')
+    from bifrost_tpu.ring import Ring
+    rng = np.random.RandomState(21)
+    data = rng.randn(24, 16).astype(np.float32)
+    hdr = simple_header([-1, 16], 'f32', gulp_nframe=8)
+    ring = Ring(space='system')
+    eng = xfer.TransferEngine()
+    with ring.begin_writing() as w:
+        # 20-frame buffer, 8-frame spans: the third span ([16, 24))
+        # wraps and writes frames 20-23 through the ghost region
+        with w.begin_sequence(hdr, 8, 20) as seq:
+            for g0 in (0, 8, 16):
+                dev = eng.to_device(data[g0:g0 + 8])
+                with seq.reserve(8) as sp:
+                    fill = eng.host_fill(dev, 'f32',
+                                         sp.data.as_numpy())
+                    assert fill.done   # completed BEFORE close/attach
+                    sp.set_fill(fill)
+                    sp.commit(8)
+            # a reader whose span starts INSIDE the wrapped region
+            # ([18, 22)) reads the mirrored start-of-buffer bytes —
+            # the path only the attach-time mirror feeds (a reader
+            # framed like the writer reads back through the ghost
+            # area directly and would never notice a missing mirror)
+            with ring.open_earliest_sequence(guarantee=False) as rs:
+                with rs.acquire(18, 4) as span:
+                    got = np.array(span.data.as_numpy(), copy=True)
+    np.testing.assert_allclose(got, data[18:22], rtol=1e-6)
+
+
+def test_out_of_order_completion_drain():
+    """Futures may be resolved in any order; the engine's drain retires
+    whatever completed without disturbing the rest."""
+    eng = xfer.TransferEngine(depth=16)
+    arrs = [np.full((32, 32), i, np.float32) for i in range(8)]
+    futs = [eng.to_host_async(eng.to_device(a)) for a in arrs]
+    # resolve a scattered subset first, then drain, then the rest
+    for i in (5, 1, 6, 2):
+        assert np.array_equal(futs[i].result(), arrs[i])
+    eng.drain()
+    for i in (7, 0, 3, 4):
+        assert np.array_equal(futs[i].result(), arrs[i])
+    assert eng.outstanding == 0
+
+
+def test_async_queue_bound_forces_oldest():
+    """More than ``depth`` outstanding transfers retire the oldest
+    first — bounded backpressure, not unbounded growth."""
+    eng = xfer.TransferEngine(depth=2)
+    futs = [eng.to_host_async(eng.to_device(
+        np.full((16,), i, np.float32))) for i in range(6)]
+    # the first four must have been forced by the bound
+    assert all(f.done for f in futs[:4])
+    assert eng.outstanding <= 2
+
+
+def test_complex_roundtrip_via_futures():
+    eng = xfer.TransferEngine()
+    c = (np.random.RandomState(0).randn(32, 16) +
+         1j * np.random.RandomState(1).randn(32, 16)).astype(np.complex64)
+    fut = eng.to_host_async(eng.to_device(c))
+    got = fut.result()
+    assert got.dtype == np.complex64
+    np.testing.assert_allclose(got, c, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# deferred D2H ring fills through a real pipeline
+# ---------------------------------------------------------------------------
+
+def _chain_stages():
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    return [FftStage('fine_time', axis_labels='freq'),
+            DetectStage('stokes', axis='pol'),
+            ReduceStage('freq', 4)]
+
+
+def _make_raw(nt=64, npol=2, nf=256, seed=7):
+    rng = np.random.RandomState(seed)
+    raw = np.zeros((nt, npol, nf), dtype=np.dtype([('re', 'i1'),
+                                                   ('im', 'i1')]))
+    raw['re'] = rng.randint(-64, 64, raw.shape)
+    raw['im'] = rng.randint(-64, 64, raw.shape)
+    return raw
+
+
+def _run_chain(raw, ngulp=6, **scope):
+    hdr = simple_header([-1, raw.shape[1], raw.shape[2]], 'ci8',
+                        labels=['time', 'pol', 'fine_time'])
+    with bf.Pipeline(**scope) as p:
+        src = NumpySourceBlock([raw.copy() for _ in range(ngulp)], hdr,
+                               gulp_nframe=raw.shape[0])
+        b = bf.blocks.copy(src, space='tpu')
+        fb = bf.blocks.fused(b, _chain_stages())
+        b2 = bf.blocks.copy(fb, space='system')
+        sink = GatherSink(b2)
+        p.run()
+    return sink.result(), fb
+
+
+def test_async_d2h_fills_deliver_correct_data():
+    """CopyBlock's deferred-fill D2H must deliver byte-identical data
+    to the synchronous path, and must actually run async (d2h_async
+    counter) with hard syncs bounded by sync_depth."""
+    raw = _make_raw()
+    out_async, _ = _run_chain(raw, ngulp=8, sync_depth=4)
+    snap = counters.snapshot()
+    assert snap.get('xfer.d2h_async', 0) >= 8
+    waits = snap.get('pipeline.sync_waits', 0)
+    dev_gulps = snap.get('pipeline.gulps_device', 1)
+    assert waits <= dev_gulps / 4.0 + 1
+    counters.reset()
+    out_sync, _ = _run_chain(raw, ngulp=8, sync_depth=4,
+                             sync_strict=True)
+    assert np.array_equal(out_async, out_sync)
+
+
+def test_sync_strict_fallback_is_synchronous():
+    """sync_strict=True must route every D2H through the blocking path
+    (no deferred fills, no async queue)."""
+    raw = _make_raw(seed=3)
+    _run_chain(raw, ngulp=4, sync_strict=True)
+    assert counters.get('xfer.d2h_async') == 0
+
+
+def test_strict_env_disables_async(monkeypatch):
+    monkeypatch.setenv('BF_SYNC_STRICT', '1')
+    assert not xfer.async_enabled()
+    eng = xfer.TransferEngine()
+    fut = eng.to_host_async(eng.to_device(np.ones(4, np.float32)))
+    assert fut.done                     # completed synchronously
+
+
+def test_partial_commit_fill_completes_synchronously():
+    """A partially-committed span carrying a fill must complete it at
+    close (the truncated tail's bytes roll back and become
+    re-reservable — a deferred write there would corrupt the next
+    span)."""
+    from bifrost_tpu.ring import Ring
+    rng = np.random.RandomState(8)
+    data = rng.randn(8, 16).astype(np.float32)
+    fresh = rng.randn(8, 16).astype(np.float32)
+    hdr = simple_header([-1, 16], 'f32', gulp_nframe=8)
+    ring = Ring(space='system')
+    eng = xfer.TransferEngine(depth=16)
+    with ring.begin_writing() as w:
+        with w.begin_sequence(hdr, 8, 24) as seq:
+            dev = eng.to_device(data)
+            with seq.reserve(8) as sp:
+                fill = eng.host_fill(dev, 'f32', sp.data.as_numpy())
+                sp.set_fill(fill)
+                sp.commit(4)            # partial: tail rolls back
+            assert fill.done            # completed at close, not later
+            # the rolled-back frames are re-reserved by the next span;
+            # the old fill must not clobber them afterwards
+            with seq.reserve(8) as sp2:
+                sp2.data.as_numpy()[...] = fresh
+                sp2.commit(8)
+            eng.drain(block=True)
+            with ring.open_earliest_sequence(guarantee=False) as rs:
+                with rs.acquire(0, 12) as span:
+                    got = np.array(span.data.as_numpy(), copy=True)
+    np.testing.assert_allclose(got[:4], data[:4], rtol=1e-6)
+    np.testing.assert_allclose(got[4:12], fresh, rtol=1e-6)
+
+
+def test_host_fill_wraparound_ghost():
+    """A deferred fill landing in a wrapped span must still mirror the
+    ghost overflow so readers of the wrapped bytes see the data (the
+    commit-time mirror ran before the bytes existed)."""
+    # many small gulps through a deliberately tight ring forces wraps
+    rng = np.random.RandomState(11)
+    gulps = [rng.randn(8, 16).astype(np.float32) for _ in range(12)]
+    hdr = simple_header([-1, 16], 'f32')
+    with bf.Pipeline(buffer_nframe=20) as p:
+        src = NumpySourceBlock(gulps, hdr, gulp_nframe=8)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.copy(b, space='system')
+        sink = GatherSink(b)
+        p.run()
+    np.testing.assert_allclose(sink.result(),
+                               np.concatenate(gulps, axis=0),
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# buffer donation
+# ---------------------------------------------------------------------------
+
+def test_fused_chain_donation_bitexact_and_reported():
+    """Acceptance: the donating fused chain reports donated inputs in
+    its plan record and its output is bit-exact vs the non-donating
+    path."""
+    raw = _make_raw(seed=5)
+    out_plain, fb_plain = _run_chain(raw, donate=False)
+    assert 'donate_argnums' not in (fb_plain.impl_info or {})
+    counters.reset()
+    out_donate, fb_donate = _run_chain(raw, donate=True)
+    assert (fb_donate.impl_info or {}).get('donate_argnums') == [0]
+    assert counters.get('donation.hits') > 0
+    assert np.array_equal(out_plain, out_donate)
+
+
+def test_donation_roundtrip_ci8_planes():
+    """ci8 device-rep gulps (int8 re/im planes) survive a donating
+    identity-ish computation bit-exactly."""
+    import jax.numpy as jnp
+    from bifrost_tpu.devrep import to_device_rep, from_device_rep
+    from bifrost_tpu.ops.common import donating_jit
+    raw = _make_raw(nt=16, nf=32, seed=9)
+    dev = to_device_rep(raw, 'ci8')
+    ref = np.asarray(dev).copy()
+    fn = donating_jit(lambda x: (x + jnp.int8(1)) - jnp.int8(1),
+                      donate_argnums=(0,))
+    out = fn(dev)
+    assert dev.is_deleted()             # donated input is consumed
+    assert np.array_equal(np.asarray(out), ref)
+    back = np.zeros_like(raw)
+    from_device_rep(out, 'ci8', back)
+    assert np.array_equal(back, raw)
+
+
+def test_donation_roundtrip_cf16_planes():
+    """cf16 device-rep (complex64) round trip through a donating jit
+    stays bit-exact."""
+    from bifrost_tpu.devrep import to_device_rep, from_device_rep
+    from bifrost_tpu.ops.common import donating_jit
+    rng = np.random.RandomState(2)
+    raw = np.zeros((16, 8), dtype=np.dtype([('re', 'f2'), ('im', 'f2')]))
+    raw['re'] = rng.randn(16, 8).astype(np.float16)
+    raw['im'] = rng.randn(16, 8).astype(np.float16)
+    dev = to_device_rep(raw, 'cf16')
+    ref = np.asarray(dev).copy()
+    fn = donating_jit(lambda x: x * 1.0, donate_argnums=(0,))
+    out = fn(dev)
+    assert np.array_equal(np.asarray(out), ref)
+    back = np.zeros_like(raw)
+    from_device_rep(out, 'cf16', back)
+    assert np.array_equal(back['re'], raw['re'])
+    assert np.array_equal(back['im'], raw['im'])
+
+
+def test_donation_denied_for_shared_chunks():
+    """A ring chunk set WITHOUT owned=True (e.g. a source publishing a
+    reused array) must never be taken for donation."""
+    import jax.numpy as jnp
+    from bifrost_tpu.ring import Ring
+    ring = Ring(space='tpu')
+    hdr = simple_header([-1, 4], 'f32', gulp_nframe=8)
+    arr = jnp.ones((8, 4), jnp.float32)
+    with ring.begin_writing() as w:
+        with w.begin_sequence(hdr, 8, 24) as seq:
+            with seq.reserve(8) as sp:
+                sp.set(arr)             # owned defaults to False
+                sp.commit(8)
+            with ring.open_earliest_sequence(guarantee=True) as rs:
+                with rs.acquire(0, 8) as ispan:
+                    assert ispan.take_data() is None
+                    assert np.array_equal(np.asarray(ispan.data),
+                                          np.ones((8, 4), np.float32))
+
+
+def test_donation_denied_with_second_reader():
+    """Exclusivity: with two readers holding spans, take_data must
+    refuse even owned chunks."""
+    import jax.numpy as jnp
+    from bifrost_tpu.ring import Ring
+    ring = Ring(space='tpu')
+    hdr = simple_header([-1, 4], 'f32', gulp_nframe=8)
+    with ring.begin_writing() as w:
+        with w.begin_sequence(hdr, 8, 24) as seq:
+            with seq.reserve(8) as sp:
+                sp.set(jnp.ones((8, 4), jnp.float32), owned=True)
+                sp.commit(8)
+            with ring.open_earliest_sequence(guarantee=True) as r1, \
+                    ring.open_earliest_sequence(guarantee=True) as r2:
+                with r1.acquire(0, 8) as s1, r2.acquire(0, 8) as s2:
+                    assert s1.take_data() is None
+                    assert s2.take_data() is None
+
+
+def test_stage_block_donation_bitexact():
+    """Unfused _StageBlock chains donate too: outputs bit-exact vs the
+    non-donating run."""
+    from bifrost_tpu.stages import FftStage, DetectStage
+
+    def run(donate):
+        raw = _make_raw(seed=13)
+        hdr = simple_header([-1, 2, 256], 'ci8',
+                            labels=['time', 'pol', 'fine_time'])
+        with bf.Pipeline(donate=donate) as p:
+            src = NumpySourceBlock([raw.copy() for _ in range(4)], hdr,
+                                   gulp_nframe=64)
+            b = bf.blocks.copy(src, space='tpu')
+            b = bf.blocks.fft(b, 'fine_time', axis_labels='freq')
+            b = bf.blocks.detect(b, 'stokes', axis='pol')
+            b = bf.blocks.copy(b, space='system')
+            sink = GatherSink(b)
+            p.run()
+        return sink.result()
+
+    out0 = run(False)
+    counters.reset()
+    out1 = run(True)
+    assert counters.get('donation.hits') > 0
+    assert np.array_equal(out0, out1)

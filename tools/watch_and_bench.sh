@@ -6,31 +6,38 @@
 # artifacts immediately.  A probe that initializes but fails the matmul
 # gate does NOT trigger a capture (tools/tpu_probe.py rc gate).
 #
-# Artifacts on success:
-#   BENCH_r05.json        - the driver-format one-line JSON from bench.py
-#   BENCH_SUITE_r05.json  - per-config detail written by run_suite_into
-#   bench_watch.log       - probe/attempt history (committed for the judge)
+# The capture label comes from BF_BENCH_ROUND (default: rYYYYMMDD UTC of
+# the capture), so artifacts are stamped with when they were measured
+# instead of a hardcoded round number that silently goes stale.
+#
+# Artifacts on success (ROUND = $BF_BENCH_ROUND):
+#   BENCH_${ROUND}.json       - the driver-format one-line JSON from bench.py
+#   BENCH_SUITE_${ROUND}.json - per-config detail written by run_suite_into
+#   bench_watch.log           - probe/attempt history (gitignored)
 cd "$(dirname "$0")/.." || exit 1
+ROUND="${BF_BENCH_ROUND:-r$(date -u +%Y%m%d)}"
+export BF_BENCH_ROUND="$ROUND"
+OUT="BENCH_${ROUND}.json"
 LOG=bench_watch.log
-echo "$(date -u +%FT%TZ) watcher start pid=$$" >> "$LOG"
+echo "$(date -u +%FT%TZ) watcher start pid=$$ round=$ROUND" >> "$LOG"
 for i in $(seq 1 400); do
   out=$(BF_PROBE_DEADLINE=120 timeout 180 python tools/tpu_probe.py 2>/dev/null)
   rc=$?
   echo "$(date -u +%FT%TZ) probe[$i] rc=$rc $out" >> "$LOG"
   if [ "$rc" -eq 0 ]; then
     echo "$(date -u +%FT%TZ) healthy - starting full bench" >> "$LOG"
-    timeout 5400 python bench.py > BENCH_r05.json.tmp 2> bench_r05.stderr
+    timeout 5400 python bench.py > "$OUT.tmp" 2> "bench_${ROUND}.stderr"
     brc=$?
     echo "$(date -u +%FT%TZ) bench rc=$brc" >> "$LOG"
-    if [ "$brc" -eq 0 ] && grep -q '"vs_baseline"' BENCH_r05.json.tmp \
-        && ! grep -q '"error": "jax backend' BENCH_r05.json.tmp; then
-      mv BENCH_r05.json.tmp BENCH_r05.json
-      echo "$(date -u +%FT%TZ) capture OK" >> "$LOG"
+    if [ "$brc" -eq 0 ] && grep -q '"vs_baseline"' "$OUT.tmp" \
+        && ! grep -q '"error": "jax backend' "$OUT.tmp"; then
+      mv "$OUT.tmp" "$OUT"
+      echo "$(date -u +%FT%TZ) capture OK -> $OUT" >> "$LOG"
       exit 0
     fi
     # never leave a truncated artifact where round automation could
     # commit it as if it were real
-    rm -f BENCH_r05.json.tmp
+    rm -f "$OUT.tmp"
     echo "$(date -u +%FT%TZ) bench attempt failed; continuing watch" >> "$LOG"
   fi
   sleep 240
