@@ -43,11 +43,13 @@ from .dtype import DataType
 from .space import Space, SPACES
 from .ndarray import (ndarray, asarray, empty, zeros, empty_like, zeros_like,
                       copy_array, memset_array)
-from .ring import (Ring, EndOfDataStop, WouldBlock, split_shape, ring_view)
+from .ring import (Ring, EndOfDataStop, WouldBlock, RingPoisonedError,
+                   split_shape, ring_view)
 from .pipeline import (Pipeline, BlockScope, Block, SourceBlock,
                        MultiTransformBlock, TransformBlock, SinkBlock,
                        get_default_pipeline, get_current_block_scope,
                        block_scope, block_view, PipelineInitError)
+from .supervision import PipelineRuntimeError, PipelineStallError
 from .block_chainer import BlockChainer
 from . import device
 from . import memory
@@ -68,5 +70,7 @@ from . import parallel
 from . import io
 from . import trace
 from . import telemetry
+from . import supervision
+from . import testing
 from .utils import EnvVars, ObjectCache, enable_compilation_cache
 from .header_standard import enforce_header_standard

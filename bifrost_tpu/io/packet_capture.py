@@ -416,8 +416,18 @@ class UDPCapture(_PacketCapture):
         return self.sock.recv_mmsg_raw(self.batch, self._raw_stride)
 
     def _recv_plain(self):
+        from .udp_socket import UDPSocket, retry_transient
         try:
-            return self.sock.recv(self.payload_size + 1024)
+            # retry_transient handles EINTR/ECONNREFUSED with capped
+            # backoff (telemetry: io.socket_retries) — a briefly
+            # restarting peer must not kill a long-running capture.
+            # UDPSocket.recv already retries internally; wrapping it
+            # again would square the retry budget, so only plain
+            # socket objects handed to the capture get the wrapper.
+            if isinstance(self.sock, UDPSocket):
+                return self.sock.recv(self.payload_size + 1024)
+            return retry_transient(
+                lambda: self.sock.recv(self.payload_size + 1024))
         except (socket_mod.timeout, TimeoutError):
             return None
         except OSError as e:

@@ -11,11 +11,60 @@ bytes-object to an amortized slice of a preallocated buffer.
 from __future__ import annotations
 
 import ctypes
+import errno as errno_mod
 import os
 import select
 import socket
+import time as time_mod
 
-__all__ = ['Address', 'UDPSocket']
+__all__ = ['Address', 'UDPSocket', 'retry_transient']
+
+#: errnos worth retrying with backoff: interrupted syscalls and the
+#: ICMP port-unreachable a connected UDP socket reports as
+#: ECONNREFUSED when the peer briefly restarts
+_TRANSIENT_ERRNOS = frozenset({errno_mod.EINTR, errno_mod.ECONNREFUSED})
+
+
+def _retry_budget():
+    try:
+        return int(os.environ.get('BF_IO_RETRY_MAX', '') or 8)
+    except ValueError:
+        return 8
+
+
+def _retry_backoff():
+    try:
+        return float(os.environ.get('BF_IO_RETRY_BACKOFF', '') or 0.005)
+    except ValueError:
+        return 0.005
+
+
+def retry_transient(fn, budget=None, backoff=None):
+    """Run ``fn()`` retrying transient socket errnos (EINTR /
+    ECONNREFUSED) with exponential backoff, up to a capped budget
+    (``BF_IO_RETRY_MAX``, default 8; base ``BF_IO_RETRY_BACKOFF``
+    seconds, default 5ms).  Retries are counted on the
+    ``io.socket_retries`` telemetry counter; budget exhaustion
+    re-raises the last error.  EAGAIN/EWOULDBLOCK are NOT retried here
+    — on a nonblocking/timeout socket they mean "no data", which
+    callers handle as a normal condition."""
+    if budget is None:
+        budget = _retry_budget()
+    if backoff is None:
+        backoff = _retry_backoff()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            if e.errno not in _TRANSIENT_ERRNOS:
+                raise
+            attempt += 1
+            if attempt > budget:
+                raise        # budget exhausted: surface the real error
+            from ..telemetry import counters
+            counters.inc('io.socket_retries')
+        time_mod.sleep(min(backoff * (2 ** (attempt - 1)), 0.25))
 
 
 class _iovec(ctypes.Structure):
@@ -109,10 +158,10 @@ class UDPSocket(object):
         return self.sock.fileno()
 
     def recv_into(self, buf):
-        return self.sock.recv_into(buf)
+        return retry_transient(lambda: self.sock.recv_into(buf))
 
     def recv(self, nbyte=65536):
-        return self.sock.recv(nbyte)
+        return retry_transient(lambda: self.sock.recv(nbyte))
 
     # -- batched receive ---------------------------------------------------
     def _mmsg_setup(self, vlen, pkt_size):
@@ -139,9 +188,9 @@ class UDPSocket(object):
         nonblockingly.  Returns ``(buffer, lengths)`` — the whole reused
         receive buffer (fixed ``pkt_size`` stride) plus per-packet
         lengths, for zero-copy vectorized decoding — or (None, None) on
-        timeout.  Real errnos (anything but EAGAIN/EINTR) raise, like
-        the per-packet recv path."""
-        import errno as errno_mod
+        timeout.  Transient errnos (EINTR, ECONNREFUSED) are retried
+        with backoff and counted on ``io.socket_retries``; other real
+        errnos raise, like the per-packet recv path."""
         mm = getattr(self, '_mmsg', None)
         if mm is None or mm[0] != vlen or mm[1] != pkt_size:
             self._mmsg_setup(vlen, pkt_size)
@@ -150,14 +199,18 @@ class UDPSocket(object):
         ready, _, _ = select.select([self.sock], [], [], self._timeout)
         if not ready:
             return None, None
-        n = _get_libc().recvmmsg(self.sock.fileno(), hdrs, vlen,
-                                 _MSG_DONTWAIT, None)
-        if n < 0:
-            err = ctypes.get_errno()
-            if err in (errno_mod.EAGAIN, errno_mod.EWOULDBLOCK,
-                       errno_mod.EINTR):
-                return None, None
-            raise OSError(err, 'recvmmsg failed')
+
+        def _drain():
+            n = _get_libc().recvmmsg(self.sock.fileno(), hdrs, vlen,
+                                     _MSG_DONTWAIT, None)
+            if n < 0:
+                err = ctypes.get_errno()
+                if err in (errno_mod.EAGAIN, errno_mod.EWOULDBLOCK):
+                    return 0
+                raise OSError(err, 'recvmmsg failed')
+            return n
+
+        n = retry_transient(_drain)
         if n == 0:
             return None, None
         return memoryview(bufs), [hdrs[i].msg_len for i in range(n)]

@@ -35,16 +35,18 @@ from copy import copy
 
 from . import affinity, device, memory
 from .trace import ScopedTracer, tracing_enabled as _tracing
-from .ring import Ring, ring_view, EndOfDataStop
+from .ring import Ring, ring_view, EndOfDataStop, RingPoisonedError
 from .ndarray import memset_array
 from .proclog import ProcLog
 from .temp_storage import TempStorage
+from .testing import faults
 
 __all__ = ['Pipeline', 'BlockScope', 'Block', 'SourceBlock',
            'MultiTransformBlock', 'TransformBlock', 'SinkBlock',
            'get_default_pipeline', 'get_current_block_scope',
            'block_scope', 'block_view', 'get_ring', 'izip',
-           'PipelineInitError', 'EndOfDataStop', 'resolve_donate']
+           'PipelineInitError', 'EndOfDataStop', 'RingPoisonedError',
+           'resolve_donate']
 
 
 def izip(*iterables):
@@ -105,7 +107,12 @@ class BlockScope(object):
     DEFAULT_SYNC_DEPTH — peak device memory grows with it), donate
     (opt-in XLA buffer donation of exclusively-owned gulp inputs on
     device blocks; requires single-consumer topology — see
-    docs/transfer.md; default off, BF_DONATE=1 enables globally).
+    docs/transfer.md; default off, BF_DONATE=1 enables globally),
+    on_failure ('abort' default | 'restart' | 'skip_sequence' — the
+    supervision policy applied when a block's main loop raises, see
+    docs/robustness.md), max_restarts / restart_backoff (restart-policy
+    budget and exponential-backoff base; defaults BF_RESTART_MAX=3 and
+    BF_RESTART_BACKOFF=0.1s).
     """
 
     #: default device run-ahead (gulps) when sync_depth is unset;
@@ -116,12 +123,15 @@ class BlockScope(object):
 
     _TUNABLES = ('gulp_nframe', 'buffer_nframe', 'buffer_factor', 'core',
                  'device', 'mesh', 'share_temp_storage', 'sync_depth',
-                 'sync_strict', 'donate')
+                 'sync_strict', 'donate', 'on_failure', 'max_restarts',
+                 'restart_backoff')
 
     def __init__(self, name=None, gulp_nframe=None, buffer_nframe=None,
                  buffer_factor=None, core=None, gpu=None, device=None,
                  mesh=None, share_temp_storage=False, fuse=False,
-                 sync_depth=None, sync_strict=None, donate=None):
+                 sync_depth=None, sync_strict=None, donate=None,
+                 on_failure=None, max_restarts=None,
+                 restart_backoff=None):
         if name is None:
             name = 'BlockScope_%i' % BlockScope.instance_count
             BlockScope.instance_count += 1
@@ -136,6 +146,9 @@ class BlockScope(object):
         self._sync_depth = sync_depth
         self._sync_strict = sync_strict
         self._donate = donate
+        self._on_failure = on_failure
+        self._max_restarts = max_restarts
+        self._restart_backoff = restart_backoff
         self._fused = fuse
         self._temp_storage = {}
         self._parent_scope = get_current_block_scope() \
@@ -260,7 +273,8 @@ class Pipeline(BlockScope):
 
     instance_count = 0
 
-    def __init__(self, name=None, auto_fuse=None, **kwargs):
+    def __init__(self, name=None, auto_fuse=None, watchdog_secs=None,
+                 **kwargs):
         if name is None:
             name = 'Pipeline_%i' % Pipeline.instance_count
             Pipeline.instance_count += 1
@@ -269,9 +283,15 @@ class Pipeline(BlockScope):
             auto_fuse = os.environ.get('BF_AUTO_FUSE',
                                        '0').strip() == '1'
         self.auto_fuse = auto_fuse
+        #: stall-watchdog window in seconds (None: BF_WATCHDOG_SECS or
+        #: off) — see docs/robustness.md
+        self.watchdog_secs = watchdog_secs
         self.blocks = []
         self.threads = []
         self.shutdown_timeout = 5.
+        #: the failure-policy engine; created by run()
+        self.supervisor = None
+        self._shutting_down = False
         self.all_blocks_finished_initializing_event = threading.Event()
         self.block_init_queue = queue_mod.Queue()
 
@@ -289,9 +309,14 @@ class Pipeline(BlockScope):
             uninitialized.discard(block)
             if not ok:
                 self.shutdown()
+                detail = ''
+                if self.supervisor is not None:
+                    recorded = self.supervisor.failures_for(block.name)
+                    if recorded:
+                        detail = '\n' + recorded[-1].traceback.rstrip()
                 raise PipelineInitError(
-                    "The following block failed to initialize: %s"
-                    % block.name)
+                    "The following block failed to initialize: %s%s"
+                    % (block.name, detail))
         self.all_blocks_finished_initializing_event.set()
 
     def _auto_fuse(self):
@@ -411,6 +436,18 @@ class Pipeline(BlockScope):
                     parent._children.remove(blk)
 
     def run(self):
+        """Launch every block thread and supervise them to completion.
+
+        Failure semantics (docs/robustness.md): a block that raises is
+        handled per its ``on_failure`` policy; a fatal failure poisons
+        every ring (waking all blocked peers), winds the pipeline down
+        within ``shutdown_timeout``, and re-raises here as
+        :class:`~bifrost_tpu.supervision.PipelineRuntimeError` carrying
+        the original traceback.  KeyboardInterrupt triggers a clean
+        ``shutdown()``.  The stall watchdog is armed when
+        ``watchdog_secs`` / ``BF_WATCHDOG_SECS`` is set.
+        """
+        from .supervision import Supervisor
         if self.auto_fuse:
             self._auto_fuse()
         # device-space pipelines: create the jax backend client from
@@ -422,19 +459,61 @@ class Pipeline(BlockScope):
                         (getattr(b, 'orings', None) or [])):
             from .device import ensure_backend
             ensure_backend()
+        faults.arm_from_env()
+        self._shutting_down = False
+        self.supervisor = Supervisor(self)
         self.threads = [threading.Thread(target=block.run, name=block.name)
                         for block in self.blocks]
-        for thread in self.threads:
+        for block, thread in zip(self.blocks, self.threads):
+            block._thread = thread
             thread.daemon = True
             thread.start()
         self.synchronize_block_initializations()
-        for thread in self.threads:
-            while thread.is_alive():
-                thread.join(timeout=2**30)
+        self.supervisor.start_watchdog(self.watchdog_secs)
+        # Join in short slices (not one unbounded join): dead threads
+        # are detected promptly, KeyboardInterrupt is serviced between
+        # slices, and a fatal failure bounds the wind-down wait at
+        # shutdown_timeout instead of hanging forever.
+        abort_deadline = None
+        try:
+            alive = list(self.threads)
+            while alive:
+                alive[0].join(timeout=0.2)
+                alive = [t for t in alive if t.is_alive()]
+                if alive and self.supervisor.abort_event.is_set():
+                    if abort_deadline is None:
+                        abort_deadline = time.monotonic() + \
+                            self.shutdown_timeout
+                    elif time.monotonic() >= abort_deadline:
+                        for t in alive:
+                            warnings.warn(
+                                "Thread %s did not shut down in time "
+                                "after pipeline abort" % t.name,
+                                RuntimeWarning)
+                        break
+        except KeyboardInterrupt:
+            # leave no daemon threads behind: wake + wind down
+            self.shutdown()
+            raise
+        finally:
+            self.supervisor.stop_watchdog()
+        self.supervisor.raise_if_failed()
 
     def shutdown(self):
+        self._shutting_down = True
         for block in self.blocks:
             block.shutdown()
+        # wake threads blocked inside ring waits: a shutdown event
+        # alone cannot interrupt reserve/acquire, so poison the rings
+        # (block threads treat poison-during-shutdown as clean exit)
+        cause = RuntimeError("pipeline shutdown")
+        for block in self.blocks:
+            for ring in (list(getattr(block, 'orings', ())) +
+                         list(getattr(block, 'irings', ()))):
+                try:
+                    ring.poison(cause)
+                except Exception:
+                    pass
         self.all_blocks_finished_initializing_event.set()
         join_all(self.threads, timeout=self.shutdown_timeout)
         for thread in self.threads:
@@ -505,6 +584,11 @@ class Block(BlockScope):
                     "from one of: %s" % (self.name, i, iring.space, valid))
         self.orings = []   # set by subclasses
         self.shutdown_event = threading.Event()
+        #: supervision state: the thread running this block (set by
+        #: Pipeline.run) and the heartbeat the stall watchdog reads
+        self._thread = None
+        self._hb_time = None
+        self._hb_gulps = 0
         self.bind_proclog = ProcLog(self.name + '/bind')
         self.in_proclog = ProcLog(self.name + '/in')
         rnames = {'nring': len(self.irings)}
@@ -515,6 +599,12 @@ class Block(BlockScope):
 
     def shutdown(self):
         self.shutdown_event.set()
+
+    def heartbeat(self):
+        """Record forward progress for the stall watchdog (called once
+        per gulp via _sync_gulp and at sequence boundaries)."""
+        self._hb_time = time.monotonic()
+        self._hb_gulps += 1
 
     def create_ring(self, *args, **kwargs):
         return Ring(*args, owner=self, **kwargs)
@@ -539,15 +629,93 @@ class Block(BlockScope):
         if self.device is not None:
             device.set_device(self.device)
         self.cache_scope_hierarchy()
+        self._hb_time = time.monotonic()
         with ExitStack() as oring_stack:
+            # The writing session is held open across restart attempts:
+            # ending it between attempts would feed downstream a clean
+            # end-of-data and dissolve the stream mid-recovery.
             active_orings = self.begin_writing(oring_stack, self.orings)
+            self._supervised_main(active_orings)
+
+    def _supervised_main(self, active_orings):
+        """Run main() under the pipeline's failure policies.
+
+        - normal return / clean end-of-data: done
+        - RingPoisonedError: a peer died (or shutdown is winding us
+          down) — propagate poison downstream and exit
+        - anything else: apply the block's on_failure policy via the
+          supervisor (abort / restart-with-backoff; skip_sequence is
+          handled INSIDE main at sequence granularity)
+        """
+        supervisor = getattr(self.pipeline, 'supervisor', None)
+        restarts = 0
+        while True:
             try:
+                faults.fire('block.run', self.name)
                 self.main(active_orings)
-            except Exception:
+                # a block can finish without ever opening a sequence
+                # (empty input, every sequence skipped): release the
+                # init barrier anyway (duplicates are discarded)
+                self.pipeline.block_init_queue.put((self, True))
+                if supervisor is not None:
+                    supervisor.block_finished(self)
+                return
+            except RingPoisonedError as exc:
+                if supervisor is not None:
+                    supervisor.block_poisoned(self, exc)
+                self._poison_orings(exc)
+                # pre-barrier poison: unblock the init synchronization
+                # (unless a clean shutdown() is already doing so)
+                if (not self.pipeline.
+                        all_blocks_finished_initializing_event.is_set()
+                        and not getattr(self.pipeline,
+                                        '_shutting_down', False)):
+                    self.pipeline.block_init_queue.put((self, False))
+                return
+            except Exception as exc:
+                if supervisor is not None and \
+                        not self.shutdown_event.is_set():
+                    decision, delay = supervisor.block_failed(
+                        self, exc, restarts)
+                    if decision == 'restart':
+                        restarts += 1
+                        # interruptible backoff: shutdown cancels it
+                        if not self.shutdown_event.wait(delay):
+                            continue
+                        return
+                # terminal: unblock the init barrier (consumed only
+                # pre-barrier), wake downstream, and keep the
+                # historical stderr trace for debugging
                 self.pipeline.block_init_queue.put((self, False))
+                self._poison_orings(exc)
                 sys.stderr.write("From block instantiated here:\n")
                 sys.stderr.write(self.init_trace)
-                raise
+                if supervisor is None:
+                    raise
+                traceback.print_exc()
+                return
+
+    def _poison_orings(self, exc):
+        """Wake downstream consumers with RingPoisonedError instead of
+        leaving them blocked on a ring that will never be fed."""
+        for oring in self.orings:
+            try:
+                oring.poison(exc)
+            except Exception:
+                pass
+
+    def _failure_policy(self):
+        return getattr(self, 'on_failure', None) or 'abort'
+
+    def _may_skip(self):
+        """Whether a skip_sequence policy can absorb a failure HERE:
+        only once the init barrier has been released.  Skipping a
+        block's very first sequence would leave downstream blocks
+        without any sequence to open and deadlock the barrier, so
+        earlier failures escalate to the block's terminal path."""
+        return (self._failure_policy() == 'skip_sequence' and
+                self.pipeline.
+                all_blocks_finished_initializing_event.is_set())
 
     def num_outputs(self):
         return len(self.orings)
@@ -572,6 +740,7 @@ class Block(BlockScope):
         # Init barrier (reference: pipeline.py:401-403).
         self.pipeline.block_init_queue.put((self, True))
         self.pipeline.all_blocks_finished_initializing_event.wait()
+        self.heartbeat()     # sequence boundary counts as progress
         ogulp_overlaps = [g - s for g, s
                           in zip(ogulp_nframes, ostride_nframes)]
         return oseqs, ogulp_overlaps
@@ -642,6 +811,7 @@ class Block(BlockScope):
         if pend is None:
             pend = self._pending_outputs = deque()
         counters.inc('pipeline.gulps')
+        self.heartbeat()
         arrays = [s._device_array for s in ospans
                   if getattr(s, '_device_array', None) is not None]
         if arrays:
@@ -713,35 +883,59 @@ class SourceBlock(Block):
         self.out_proclog.update(rnames)
 
     def main(self, orings):
-        for sourcename in self.sourcenames:
+        # Restart-policy bookkeeping: a re-entered main resumes at the
+        # source that failed instead of re-reading completed sources.
+        sourcenames = list(self.sourcenames)
+        if not hasattr(self, '_source_index'):
+            self._source_index = 0
+        while self._source_index < len(sourcenames):
+            sourcename = sourcenames[self._source_index]
             if self.shutdown_event.is_set():
                 break
-            with self.create_reader(sourcename) as ireader:
-                oheaders = self.on_sequence(ireader, sourcename)
-                for ohdr in oheaders:
-                    ohdr.setdefault('time_tag', self._seq_count)
-                    ohdr.setdefault('name',
-                                    'unnamed-sequence-%i' % self._seq_count)
-                self._seq_count += 1
-                with ExitStack() as oseq_stack:
-                    oseqs, ogulp_overlaps = self.begin_sequences(
-                        oseq_stack, orings, oheaders,
-                        igulp_nframes=[], istride_nframes=[])
-                    while not self.shutdown_event.is_set():
-                        t0 = time.time()
-                        with ExitStack() as ospan_stack:
-                            ospans = self.reserve_spans(ospan_stack, oseqs)
-                            t1 = time.time()
-                            ostrides = self.on_data(ireader, ospans)
-                            self._sync_gulp(ospans)
-                            self.commit_spans(ospans, ostrides,
-                                              ogulp_overlaps)
-                            if any(o == 0 for o in ostrides):
-                                break
-                        t2 = time.time()
-                        self.perf_proclog.update({'acquire_time': -1,
-                                                  'reserve_time': t1 - t0,
-                                                  'process_time': t2 - t1})
+            try:
+                self._read_source(orings, sourcename)
+            except (EndOfDataStop, RingPoisonedError):
+                raise
+            except Exception as exc:
+                if not self._may_skip():
+                    raise
+                # graceful degradation: the failed source's output
+                # sequence has ended (ExitStack unwound); record and
+                # move on to the next source
+                supervisor = getattr(self.pipeline, 'supervisor', None)
+                if supervisor is not None:
+                    supervisor.block_skipped(self, exc)
+            self._source_index += 1
+
+    def _read_source(self, orings, sourcename):
+        with self.create_reader(sourcename) as ireader:
+            faults.fire('block.on_sequence', self.name)
+            oheaders = self.on_sequence(ireader, sourcename)
+            for ohdr in oheaders:
+                ohdr.setdefault('time_tag', self._seq_count)
+                ohdr.setdefault('name',
+                                'unnamed-sequence-%i' % self._seq_count)
+            self._seq_count += 1
+            with ExitStack() as oseq_stack:
+                oseqs, ogulp_overlaps = self.begin_sequences(
+                    oseq_stack, orings, oheaders,
+                    igulp_nframes=[], istride_nframes=[])
+                while not self.shutdown_event.is_set():
+                    t0 = time.time()
+                    with ExitStack() as ospan_stack:
+                        ospans = self.reserve_spans(ospan_stack, oseqs)
+                        t1 = time.time()
+                        faults.fire('block.on_data', self.name)
+                        ostrides = self.on_data(ireader, ospans)
+                        self._sync_gulp(ospans)
+                        self.commit_spans(ospans, ostrides,
+                                          ogulp_overlaps)
+                        if any(o == 0 for o in ostrides):
+                            break
+                    t2 = time.time()
+                    self.perf_proclog.update({'acquire_time': -1,
+                                              'reserve_time': t1 - t0,
+                                              'process_time': t2 - t1})
 
     def define_output_nframes(self, _):
         return [self.gulp_nframe] * self.num_outputs()
@@ -786,120 +980,155 @@ class MultiTransformBlock(Block):
                             for iring in self.irings]):
             if self.shutdown_event.is_set():
                 break
-            for i, iseq in enumerate(iseqs):
-                self.sequence_proclogs[i].update(iseq.header,
-                                                 force=True)
-            oheaders = self._on_sequence(iseqs)
-            for ohdr in oheaders:
-                ohdr.setdefault('time_tag', self._seq_count)
-            self._seq_count += 1
+            try:
+                if not self._process_sequence(orings, iseqs):
+                    break               # shutdown requested mid-sequence
+            except (EndOfDataStop, RingPoisonedError):
+                raise
+            except Exception as exc:
+                if not self._may_skip():
+                    raise
+                # skip_sequence: the output sequence for the failed
+                # input has ended (ExitStack unwound, 0 frames
+                # committed past the failure); discard the rest of the
+                # input and continue with the next sequence
+                supervisor = getattr(self.pipeline, 'supervisor', None)
+                if supervisor is not None:
+                    supervisor.block_skipped(self, exc)
+                self._drain_sequences(iseqs)
 
-            igulp_nframes = [self.gulp_nframe or iseq.header['gulp_nframe']
-                             for iseq in iseqs]
-            igulp_overlaps = self._define_input_overlap_nframe(iseqs)
-            istride_nframes = igulp_nframes[:]
-            igulp_nframes = [g + o for g, o
-                             in zip(igulp_nframes, igulp_overlaps)]
-
-            for iseq, igulp_nframe in zip(iseqs, igulp_nframes):
-                if self.buffer_factor is None:
-                    src_block = iseq.ring.owner
-                    # Fused scopes share one gulp of buffering so that
-                    # producer and consumer alternate (reference:
-                    # pipeline.py:558-568).
-                    if src_block is not None and \
-                            self.is_fused_with(src_block):
-                        buffer_factor = 1
-                    else:
-                        buffer_factor = None
-                else:
-                    buffer_factor = self.buffer_factor
-                iseq.resize(gulp_nframe=igulp_nframe,
-                            buf_nframe=self.buffer_nframe,
-                            buffer_factor=buffer_factor)
-
-            iframe0s = [0 for _ in igulp_nframes]
-            force_skip = False
-
-            with ExitStack() as oseq_stack:
-                oseqs, ogulp_overlaps = self.begin_sequences(
-                    oseq_stack, orings, oheaders,
-                    igulp_nframes, istride_nframes)
+    def _drain_sequences(self, iseqs):
+        """Consume and discard the remainder of the current input
+        sequences (skip_sequence): a reader that merely stops reading
+        would hold its guarantee at the abandoned offset and block the
+        producer forever — reading through to the sequence end keeps
+        data flowing while the failed sequence's output stays empty."""
+        for iseq in iseqs:
+            gulp = self.gulp_nframe or \
+                iseq.header.get('gulp_nframe', 1) or 1
+            for _span in iseq.read(gulp):
+                self.heartbeat()
                 if self.shutdown_event.is_set():
-                    break
-                prev_time = time.time()
-                for ispans in izip(*[iseq.read(igulp, istride, iframe0)
-                                     for iseq, igulp, istride, iframe0
-                                     in zip(iseqs, igulp_nframes,
-                                            istride_nframes, iframe0s)]):
-                    if self.shutdown_event.is_set():
-                        return
+                    return
 
-                    if any(ispan.nframe_skipped for ispan in ispans):
-                        # Zero-fill frames lost to overwriting
-                        # (reference: pipeline.py:590-606).
-                        with ExitStack() as ospan_stack:
-                            iskip_slices = [
-                                slice(f0, f0 + ispan.nframe_skipped, istride)
-                                for f0, istride, ispan
-                                in zip(iframe0s, istride_nframes, ispans)]
-                            iskip_nframes = [ispan.nframe_skipped
-                                             for ispan in ispans]
-                            ospans = self.reserve_spans(
-                                ospan_stack, oseqs, iskip_nframes)
-                            ostrides = self._on_skip(iskip_slices, ospans)
-                            self._sync_gulp(ospans)
-                            self.commit_spans(ospans, ostrides,
-                                              ogulp_overlaps)
+    def _process_sequence(self, orings, iseqs):
+        for i, iseq in enumerate(iseqs):
+            self.sequence_proclogs[i].update(iseq.header,
+                                             force=True)
+        faults.fire('block.on_sequence', self.name)
+        oheaders = self._on_sequence(iseqs)
+        for ohdr in oheaders:
+            ohdr.setdefault('time_tag', self._seq_count)
+        self._seq_count += 1
 
-                    if all(ispan.nframe == 0 for ispan in ispans):
-                        continue
+        igulp_nframes = [self.gulp_nframe or iseq.header['gulp_nframe']
+                         for iseq in iseqs]
+        igulp_overlaps = self._define_input_overlap_nframe(iseqs)
+        istride_nframes = igulp_nframes[:]
+        igulp_nframes = [g + o for g, o
+                         in zip(igulp_nframes, igulp_overlaps)]
 
-                    cur_time = time.time()
-                    acquire_time = cur_time - prev_time
-                    prev_time = cur_time
+        for iseq, igulp_nframe in zip(iseqs, igulp_nframes):
+            if self.buffer_factor is None:
+                src_block = iseq.ring.owner
+                # Fused scopes share one gulp of buffering so that
+                # producer and consumer alternate (reference:
+                # pipeline.py:558-568).
+                if src_block is not None and \
+                        self.is_fused_with(src_block):
+                    buffer_factor = 1
+                else:
+                    buffer_factor = None
+            else:
+                buffer_factor = self.buffer_factor
+            iseq.resize(gulp_nframe=igulp_nframe,
+                        buf_nframe=self.buffer_nframe,
+                        buffer_factor=buffer_factor)
 
+        iframe0s = [0 for _ in igulp_nframes]
+        force_skip = False
+
+        with ExitStack() as oseq_stack:
+            oseqs, ogulp_overlaps = self.begin_sequences(
+                oseq_stack, orings, oheaders,
+                igulp_nframes, istride_nframes)
+            if self.shutdown_event.is_set():
+                return False
+            prev_time = time.time()
+            for ispans in izip(*[iseq.read(igulp, istride, iframe0)
+                                 for iseq, igulp, istride, iframe0
+                                 in zip(iseqs, igulp_nframes,
+                                        istride_nframes, iframe0s)]):
+                if self.shutdown_event.is_set():
+                    return False
+
+                if any(ispan.nframe_skipped for ispan in ispans):
+                    # Zero-fill frames lost to overwriting
+                    # (reference: pipeline.py:590-606).
                     with ExitStack() as ospan_stack:
-                        cur_igulps = [ispan.nframe for ispan in ispans]
-                        ospans = self.reserve_spans(ospan_stack, oseqs,
-                                                    cur_igulps)
-                        cur_time = time.time()
-                        reserve_time = cur_time - prev_time
-                        prev_time = cur_time
+                        iskip_slices = [
+                            slice(f0, f0 + ispan.nframe_skipped, istride)
+                            for f0, istride, ispan
+                            in zip(iframe0s, istride_nframes, ispans)]
+                        iskip_nframes = [ispan.nframe_skipped
+                                         for ispan in ispans]
+                        ospans = self.reserve_spans(
+                            ospan_stack, oseqs, iskip_nframes)
+                        ostrides = self._on_skip(iskip_slices, ospans)
+                        self._sync_gulp(ospans)
+                        self.commit_spans(ospans, ostrides,
+                                          ogulp_overlaps)
 
-                        if not force_skip:
-                            if _tracing():
-                                with ScopedTracer(self.name + '/on_data'):
-                                    ostrides = self._on_data(ispans,
-                                                             ospans)
-                            else:
-                                ostrides = self._on_data(ispans, ospans)
-                            self._sync_gulp(ospans)
+                if all(ispan.nframe == 0 for ispan in ispans):
+                    continue
 
-                        any_overwritten = any(ispan.nframe_overwritten
-                                              for ispan in ispans)
-                        if force_skip or any_overwritten:
-                            # Force-skip a gulp to let interrupted pipelines
-                            # catch up (reference: pipeline.py:630-644).
-                            force_skip = any_overwritten
-                            iskip_slices = [
-                                slice(ispan.frame_offset,
-                                      ispan.frame_offset +
-                                      ispan.nframe_overwritten,
-                                      istride)
-                                for ispan, istride
-                                in zip(ispans, istride_nframes)]
-                            ostrides = self._on_skip(iskip_slices, ospans)
-                            self._sync_gulp(ospans)
+                cur_time = time.time()
+                acquire_time = cur_time - prev_time
+                prev_time = cur_time
 
-                        self.commit_spans(ospans, ostrides, ogulp_overlaps)
+                with ExitStack() as ospan_stack:
+                    cur_igulps = [ispan.nframe for ispan in ispans]
+                    ospans = self.reserve_spans(ospan_stack, oseqs,
+                                                cur_igulps)
                     cur_time = time.time()
-                    process_time = cur_time - prev_time
+                    reserve_time = cur_time - prev_time
                     prev_time = cur_time
-                    self.perf_proclog.update({'acquire_time': acquire_time,
-                                              'reserve_time': reserve_time,
-                                              'process_time': process_time})
-            self._on_sequence_end(iseqs)
+
+                    if not force_skip:
+                        faults.fire('block.on_data', self.name)
+                        if _tracing():
+                            with ScopedTracer(self.name + '/on_data'):
+                                ostrides = self._on_data(ispans,
+                                                         ospans)
+                        else:
+                            ostrides = self._on_data(ispans, ospans)
+                        self._sync_gulp(ospans)
+
+                    any_overwritten = any(ispan.nframe_overwritten
+                                          for ispan in ispans)
+                    if force_skip or any_overwritten:
+                        # Force-skip a gulp to let interrupted pipelines
+                        # catch up (reference: pipeline.py:630-644).
+                        force_skip = any_overwritten
+                        iskip_slices = [
+                            slice(ispan.frame_offset,
+                                  ispan.frame_offset +
+                                  ispan.nframe_overwritten,
+                                  istride)
+                            for ispan, istride
+                            in zip(ispans, istride_nframes)]
+                        ostrides = self._on_skip(iskip_slices, ospans)
+                        self._sync_gulp(ospans)
+
+                    self.commit_spans(ospans, ostrides, ogulp_overlaps)
+                cur_time = time.time()
+                process_time = cur_time - prev_time
+                prev_time = cur_time
+                self.perf_proclog.update({'acquire_time': acquire_time,
+                                          'reserve_time': reserve_time,
+                                          'process_time': process_time})
+        self._on_sequence_end(iseqs)
+        return True
 
     # -- dispatch shims ----------------------------------------------------
     def _on_sequence(self, iseqs):

@@ -50,10 +50,11 @@ import numpy as np
 from .dtype import DataType
 from .space import canonical
 from .ndarray import ndarray
+from .testing import faults
 
 __all__ = ['Ring', 'RingWriter', 'WriteSequence', 'ReadSequence',
            'WriteSpan', 'ReadSpan', 'EndOfDataStop', 'WouldBlock',
-           'split_shape', 'ring_view']
+           'RingPoisonedError', 'split_shape', 'ring_view']
 
 _INF = float('inf')
 
@@ -66,6 +67,23 @@ class EndOfDataStop(Exception):
 class WouldBlock(Exception):
     """Raised by nonblocking reserve when space is unavailable
     (reference: BF_STATUS_WOULD_BLOCK)."""
+
+
+class RingPoisonedError(RuntimeError):
+    """Raised by blocking ring operations (reserve/acquire/sequence
+    waits) after :meth:`Ring.poison` marked the ring dead — a producer
+    or consumer failed and the data stream can never complete.  Unlike
+    :class:`EndOfDataStop` this is an ERROR path: consumers must not
+    treat the committed prefix as a complete stream.  ``cause`` carries
+    the original failure when known."""
+
+    def __init__(self, ring_name, cause=None):
+        msg = "ring %r poisoned" % (ring_name,)
+        if cause is not None:
+            msg += " (cause: %s: %s)" % (type(cause).__name__, cause)
+        super(RingPoisonedError, self).__init__(msg)
+        self.ring_name = ring_name
+        self.cause = cause
 
 
 def split_shape(shape):
@@ -416,6 +434,9 @@ class Ring(object):
         #: committed-but-in-flight D2H fills (xfer.HostFill): readers
         #: gate on overlapping fills before touching span data
         self._pending_fills = []
+        #: set by poison(): the exception that killed the producing /
+        #: consuming side; blocking ops then raise RingPoisonedError
+        self._poisoned = None
 
     # -- views ------------------------------------------------------------
     def view(self):
@@ -492,6 +513,58 @@ class Ring(object):
     def nringlet(self):
         return self._nringlet
 
+    def occupancy(self):
+        """Point-in-time flow-control state (tail/head/reserve head in
+        absolute bytes, buffer size, open span counts) — the watchdog's
+        stall dump reads this to show where data stopped moving."""
+        with self._lock:
+            return {'tail': self._tail, 'head': self._head,
+                    'reserve_head': self._reserve_head,
+                    'size': self._size,
+                    'nwrite_open': self._nwrite_open,
+                    'nread_open': self._nread_open,
+                    'eod': self._eod,
+                    'poisoned': self._poisoned is not None}
+
+    # -- poisoning --------------------------------------------------------
+    @property
+    def poisoned(self):
+        return self._poisoned is not None
+
+    def _check_poison(self):
+        # must hold self._lock (python core) or be called where a
+        # stale read is acceptable (native wrappers)
+        if self._poisoned is not None:
+            raise RingPoisonedError(self.name, self._poisoned)
+
+    def poison(self, exc=None):
+        """Mark the ring dead: a producer or consumer failed and the
+        stream can never complete.  Every blocked ``reserve`` /
+        ``acquire`` / sequence wait wakes immediately with
+        :class:`RingPoisonedError`, as does any later blocking call.
+        Idempotent; releasing already-held spans still works so block
+        threads can unwind cleanly.  ``exc`` is the original failure
+        (carried on the raised errors for diagnosis)."""
+        with self._lock:
+            if self._poisoned is not None:
+                return
+            self._poisoned = exc if exc is not None else \
+                RuntimeError("ring poisoned")
+            # also mark end-of-data so state-inspection paths (and the
+            # native core's blocked readers) observe a terminal ring
+            self._eod = True
+            self._writing = False
+            for cond in (self._read_cond, self._write_cond,
+                         self._seq_cond, self._span_cond):
+                cond.notify_all()
+        from .telemetry import counters
+        counters.inc('ring_poisoned')
+        self._wake_external()
+
+    def _wake_external(self):
+        """Hook for cores that block outside the Python locks
+        (NativeRing wakes its C-side condition variables here)."""
+
     # -- writer side ------------------------------------------------------
     def begin_writing(self):
         return RingWriter(self)
@@ -514,6 +587,7 @@ class Ring(object):
 
     def _begin_sequence(self, name, time_tag, header, nringlet):
         with self._lock:
+            self._check_poison()
             seq = _Sequence(name, time_tag, header, self._head, nringlet)
             if self._sequences:
                 prev = self._sequences[-1]
@@ -550,6 +624,7 @@ class Ring(object):
 
     def _reserve_span(self, nbyte, nonblocking=False, span=None):
         with self._lock:
+            self._check_poison()
             # A queued partial commit truncates reserve_head when it
             # lands; reserving past it would hand out offsets the
             # truncation then invalidates.
@@ -576,6 +651,7 @@ class Ring(object):
                 if nonblocking:
                     raise WouldBlock()
                 self._write_cond.wait()
+                self._check_poison()
             self._reserve_head = new_reserve
             if new_reserve - self._size > self._tail:
                 self._advance_tail(new_reserve - self._size)
@@ -671,6 +747,7 @@ class Ring(object):
                         return self._sequences[-1]
                 else:
                     raise ValueError("Invalid 'which': %r" % which)
+                self._check_poison()
                 if self._eod:
                     raise EndOfDataStop("No sequence available")
                 self._seq_cond.wait()
@@ -678,6 +755,7 @@ class Ring(object):
     def _next_seq(self, seq):
         with self._lock:
             while seq.next is None:
+                self._check_poison()
                 if self._eod and seq.finished:
                     raise EndOfDataStop("No next sequence")
                 self._seq_cond.wait()
@@ -689,12 +767,14 @@ class Ring(object):
         (reference: ring_impl.cpp:633-704)."""
         seq = rseq._seq
         with self._lock:
+            self._check_poison()
             want_begin = seq.begin + offset
             if rseq.guarantee:
                 self._guarantees[id(rseq)] = max(
                     self._guarantees.get(id(rseq), want_begin),
                     min(want_begin, self._head))
             while True:
+                self._check_poison()
                 seq_end = seq.end if seq.finished else None
                 if seq_end is not None and want_begin >= seq_end:
                     raise EndOfDataStop("Sequence consumed")
@@ -1070,6 +1150,7 @@ class WriteSpan(_SpanAPI):
     """
 
     def __init__(self, ring, sequence, nframe, nonblocking=False):
+        faults.fire('ring.reserve', ring.name)
         self._ring = ring
         self._sequence = sequence
         self._nbyte = nframe * sequence.tensor['frame_nbyte']
@@ -1189,6 +1270,7 @@ class ReadSpan(_SpanAPI):
     """Acquired input region (reference: ring2.py:478-503)."""
 
     def __init__(self, sequence, frame_offset, nframe):
+        faults.fire('ring.acquire', sequence.ring.name)
         self._ring = sequence.ring
         self._sequence = sequence
         t = sequence.tensor
@@ -1201,10 +1283,17 @@ class ReadSpan(_SpanAPI):
         if self._ring.space != 'tpu' and nbyte:
             # materialize any in-flight D2H fill overlapping this span
             # before exposing its bytes (outside the ring lock; by now
-            # the transfer has usually finished — residual wait only)
-            for f in self._ring._fills_overlapping(begin, nbyte):
-                f.wait()
-            self._ring._storage.refresh_ghost(begin, nbyte)
+            # the transfer has usually finished — residual wait only).
+            # A FAILED fill raises here: release the just-acquired span
+            # first so the ring's open-span accounting stays balanced
+            # while the error propagates to the block's failure policy.
+            try:
+                for f in self._ring._fills_overlapping(begin, nbyte):
+                    f.wait()
+                self._ring._storage.refresh_ghost(begin, nbyte)
+            except BaseException:
+                self._ring._release_span(sequence, begin)
+                raise
         self._data = None
 
     @property

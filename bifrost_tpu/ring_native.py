@@ -17,7 +17,7 @@ import threading
 import numpy as np
 
 from . import native
-from .ring import Ring, EndOfDataStop, WouldBlock
+from .ring import Ring, EndOfDataStop, WouldBlock, RingPoisonedError
 
 __all__ = ['NativeRing']
 
@@ -145,6 +145,9 @@ class NativeRing(Ring):
         self._storage = _NativeStorage(self)
         self._seq_cache = {}    # native ptr -> _NativeSeq
         self._cache_lock = threading.Lock()
+        #: live native reader ids — poison() releases their guarantees
+        #: so writers blocked inside bft_ring_reserve wake up
+        self._native_reader_ids = set()
 
     def __del__(self):
         try:
@@ -228,6 +231,44 @@ class NativeRing(Ring):
             self._handle, None, None, None, ctypes.byref(nrl)))
         return nrl.value
 
+    def occupancy(self):
+        """Flow-control snapshot read from the native core (the Python
+        attributes are unused by this core)."""
+        tail = ctypes.c_longlong()
+        head = ctypes.c_longlong()
+        size = ctypes.c_longlong()
+        try:
+            native.check(self._lib.bft_ring_tail_head(
+                self._handle, ctypes.byref(tail), ctypes.byref(head)))
+            native.check(self._lib.bft_ring_geometry(
+                self._handle, None, ctypes.byref(size), None, None))
+        except native.NativeError as exc:
+            return {'error': repr(exc)}
+        return {'tail': tail.value, 'head': head.value,
+                'size': size.value,
+                'poisoned': self._poisoned is not None}
+
+    # -- poisoning --------------------------------------------------------
+    def _wake_external(self):
+        """Wake threads blocked inside the C core: end_writing releases
+        blocked readers / sequence waiters (they observe EOD, and the
+        Python wrappers convert that to RingPoisonedError), and moving
+        every live reader guarantee up to the head releases the space
+        blocked writers are waiting for (the data no longer matters —
+        the ring is dead)."""
+        try:
+            self._lib.bft_ring_end_writing(self._handle)
+            head = ctypes.c_longlong()
+            native.check(self._lib.bft_ring_tail_head(
+                self._handle, None, ctypes.byref(head)))
+            with self._lock:
+                rids = list(self._native_reader_ids)
+            for rid in rids:
+                self._lib.bft_reader_set_guarantee(
+                    self._handle, rid, head.value, 1)
+        except Exception:
+            pass
+
     # -- writer side ------------------------------------------------------
     def _begin_writing(self):
         with self._lock:
@@ -242,6 +283,7 @@ class NativeRing(Ring):
         native.check(self._lib.bft_ring_end_writing(self._handle))
 
     def _begin_sequence(self, name, time_tag, header, nringlet):
+        self._check_poison()
         hdr = json.dumps(header).encode()
         out = ctypes.c_void_p()
         rc = self._lib.bft_ring_begin_sequence(
@@ -261,11 +303,15 @@ class NativeRing(Ring):
     def _reserve_span(self, nbyte, nonblocking=False, span=None):
         if span is None:
             raise RuntimeError("NativeRing reserve requires a span object")
+        self._check_poison()
         begin = ctypes.c_longlong()
         sid = ctypes.c_longlong()
         rc = self._lib.bft_ring_reserve(
             self._handle, nbyte, 1 if nonblocking else 0,
             ctypes.byref(begin), ctypes.byref(sid))
+        # poison may have landed while blocked inside the C core (its
+        # wakeup hands back a now-meaningless reservation)
+        self._check_poison()
         if rc == native.BFT_WOULD_BLOCK:
             raise WouldBlock()
         native.check(rc, 'reserve')
@@ -287,6 +333,8 @@ class NativeRing(Ring):
             self._handle, 1 if rseq.guarantee else 0, ctypes.byref(rid)),
             'reader_create')
         rseq._native_reader_id = rid.value
+        with self._lock:
+            self._native_reader_ids.add(rid.value)
         if rseq.guarantee:
             # clamp-forward-only: bft_reader_create seeded the guarantee
             # at the current tail; never move it backward below the tail
@@ -300,31 +348,39 @@ class NativeRing(Ring):
                 self._handle, rseq._native_reader_id, new_seq.begin, 1))
 
     def _open_seq(self, which, name=None, time_tag=None):
+        self._check_poison()
         out = ctypes.c_void_p()
         rc = self._lib.bft_ring_open_sequence(
             self._handle, _WHICH[which], (name or '').encode(),
             int(time_tag or 0), ctypes.byref(out))
+        self._check_poison()
         if rc == native.BFT_END_OF_DATA:
             raise EndOfDataStop("No sequence available")
         native.check(rc, 'open_sequence')
         return self._wrap_seq(out.value)
 
     def _next_seq(self, seq):
+        self._check_poison()
         out = ctypes.c_void_p()
         rc = self._lib.bft_seq_next(self._handle, seq._handle,
                                     ctypes.byref(out))
+        self._check_poison()
         if rc == native.BFT_END_OF_DATA:
             raise EndOfDataStop("No next sequence")
         native.check(rc, 'seq_next')
         return self._wrap_seq(out.value)
 
     def _acquire_span(self, rseq, offset, nbyte, frame_nbyte):
+        self._check_poison()
         begin = ctypes.c_longlong()
         got = ctypes.c_longlong()
         rc = self._lib.bft_reader_acquire(
             self._handle, rseq._native_reader_id, rseq._seq._handle,
             offset, nbyte, frame_nbyte, ctypes.byref(begin),
             ctypes.byref(got))
+        # the poison wakeup surfaces as END_OF_DATA (or a partial span)
+        # from the C core; report the true cause instead
+        self._check_poison()
         if rc == native.BFT_END_OF_DATA:
             raise EndOfDataStop("Sequence consumed")
         native.check(rc, 'acquire')
@@ -337,6 +393,8 @@ class NativeRing(Ring):
     def _close_read_seq(self, rseq):
         rid = getattr(rseq, '_native_reader_id', None)
         if rid is not None:
+            with self._lock:
+                self._native_reader_ids.discard(rid)
             native.check(self._lib.bft_reader_destroy(self._handle, rid))
             rseq._native_reader_id = None
 

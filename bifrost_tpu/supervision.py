@@ -1,0 +1,404 @@
+"""Pipeline supervision: failure propagation, restart policies, and the
+stall watchdog.
+
+Bifrost-style pipelines are long-running stream services; before this
+layer existed a block thread that raised simply died after printing its
+init trace while ``Pipeline.run`` joined threads forever — one
+exception became a silent whole-pipeline hang.  The supervisor turns
+that into explicit policy:
+
+- **abort** (default): the failure is recorded, every block's shutdown
+  event is set, every ring is poisoned (``ring.Ring.poison``) so
+  blocked ``acquire``/``reserve`` calls wake immediately with
+  :class:`~bifrost_tpu.ring.RingPoisonedError`, and ``Pipeline.run``
+  re-raises the aggregate as :class:`PipelineRuntimeError` carrying the
+  original traceback.
+
+- **restart**: the block's main loop is re-entered with exponential
+  backoff, up to ``max_restarts`` attempts (source/IO blocks facing
+  transient input failures).  Budget exhaustion escalates to abort.
+
+- **skip_sequence**: the block abandons the current sequence (its
+  output sequence ends cleanly) and continues with the next one —
+  graceful degradation for per-observation corruption.
+
+Policies are scope tunables (``BlockScope(on_failure='restart',
+max_restarts=5, restart_backoff=0.25)``), inherited like every other
+tunable, so a whole subtree of IO blocks can be made restartable with
+one scope.
+
+The **watchdog** (armed via ``BF_WATCHDOG_SECS`` or
+``Pipeline(watchdog_secs=...)``) monitors per-block heartbeats (gulps
+through ``Block._sync_gulp`` plus sequence boundaries); when NO live
+block has made progress for the configured window it dumps every
+thread's stack and every ring's occupancy to stderr and the
+``pipeline/watchdog`` proclog, increments the ``watchdog_stalls``
+counter, and — with ``BF_WATCHDOG_ESCALATE=1`` — aborts the pipeline
+with :class:`PipelineStallError`.
+
+All of it is testable on CPU through the deterministic fault harness in
+:mod:`bifrost_tpu.testing.faults` (see tests/test_supervision.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+from .telemetry import counters
+
+__all__ = ['PipelineRuntimeError', 'PipelineStallError', 'BlockFailure',
+           'Supervisor', 'POLICIES', 'dump_thread_stacks',
+           'ring_occupancies']
+
+#: recognized on_failure policies
+POLICIES = ('abort', 'restart', 'skip_sequence')
+
+_BACKOFF_CAP = 5.0
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+class BlockFailure(object):
+    """One recorded failure: which block, what was raised, the formatted
+    traceback, and whether it was fatal to the pipeline (``kind`` is
+    'error', 'restarted', 'skipped', 'poisoned', or 'stall')."""
+
+    __slots__ = ('block_name', 'exc', 'traceback', 'when', 'kind',
+                 'fatal', 'restarts')
+
+    def __init__(self, block_name, exc, kind='error', fatal=True,
+                 restarts=0, tb=None):
+        self.block_name = block_name
+        self.exc = exc
+        self.traceback = tb if tb is not None else ''.join(
+            traceback.format_exception(type(exc), exc,
+                                       exc.__traceback__))
+        self.when = time.time()
+        self.kind = kind
+        self.fatal = fatal
+        self.restarts = restarts
+
+    def summary(self):
+        return '%s [%s]: %s: %s' % (self.block_name, self.kind,
+                                    type(self.exc).__name__, self.exc)
+
+    def __repr__(self):
+        return 'BlockFailure(%s)' % self.summary()
+
+
+class PipelineRuntimeError(RuntimeError):
+    """Aggregate raised by ``Pipeline.run`` when any block failed
+    fatally.  ``failures`` holds every :class:`BlockFailure` recorded
+    (fatal and not); the message embeds the original tracebacks so the
+    root cause survives the thread boundary."""
+
+    def __init__(self, failures):
+        if isinstance(failures, str):
+            super(PipelineRuntimeError, self).__init__(failures)
+            self.failures = []
+            return
+        self.failures = list(failures)
+        fatal = [f for f in self.failures if f.fatal]
+        lines = ['pipeline failed: %d fatal / %d total block failure(s)'
+                 % (len(fatal), len(self.failures))]
+        for f in self.failures:
+            lines.append('  - ' + f.summary())
+        for f in fatal:
+            lines.append('--- %s ---' % f.block_name)
+            lines.append(f.traceback.rstrip())
+        super(PipelineRuntimeError, self).__init__('\n'.join(lines))
+
+    @property
+    def primary(self):
+        """The first fatal failure (the root cause), or None."""
+        for f in self.failures:
+            if f.fatal:
+                return f
+        return None
+
+
+class PipelineStallError(PipelineRuntimeError):
+    """Watchdog escalation: no block made progress within the window."""
+
+
+# ---------------------------------------------------------------------------
+# Diagnostics
+# ---------------------------------------------------------------------------
+
+def dump_thread_stacks():
+    """Formatted stacks of every live thread (the watchdog's stall
+    dump; also useful from a debugger)."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        out.append('Thread %s (%s):' % (names.get(ident, '?'), ident))
+        out.append(''.join(traceback.format_stack(frame)).rstrip())
+    return '\n'.join(out)
+
+
+def ring_occupancies(pipeline):
+    """{ring_name: occupancy dict} for every ring in the pipeline."""
+    seen = {}
+    for block in pipeline.blocks:
+        for ring in (list(getattr(block, 'orings', ())) +
+                     list(getattr(block, 'irings', ()))):
+            base = getattr(ring, '_base_ring', ring)
+            if id(base) in seen:
+                continue
+            try:
+                seen[id(base)] = (base.name, base.occupancy())
+            except Exception as exc:
+                seen[id(base)] = (getattr(base, 'name', '?'),
+                                  {'error': repr(exc)})
+    return dict(seen.values())
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+class Supervisor(object):
+    """Per-pipeline failure collector + policy engine + watchdog owner.
+
+    Created by ``Pipeline.run``; block threads report through
+    :meth:`block_failed` / :meth:`block_poisoned` / :meth:`block_skipped`
+    and the pipeline thread raises the aggregate via
+    :meth:`raise_if_failed`.
+    """
+
+    def __init__(self, pipeline):
+        self.pipeline = pipeline
+        self.failures = []
+        self.abort_event = threading.Event()
+        self._lock = threading.Lock()
+        self._watchdog = None
+        self.default_max_restarts = _env_int('BF_RESTART_MAX', 3)
+        self.default_backoff = _env_float('BF_RESTART_BACKOFF', 0.1)
+        # fail fast, in the launching thread, on a misspelled policy —
+        # not at the moment the policy is first needed
+        for block in pipeline.blocks:
+            self.policy_of(block)
+
+    # -- policy resolution -------------------------------------------------
+    @staticmethod
+    def policy_of(block):
+        policy = getattr(block, 'on_failure', None) or 'abort'
+        if policy not in POLICIES:
+            raise ValueError("Unknown on_failure policy %r on block %s "
+                             "(expected one of %s)"
+                             % (policy, block.name, ', '.join(POLICIES)))
+        return policy
+
+    def _restart_budget(self, block):
+        budget = getattr(block, 'max_restarts', None)
+        return self.default_max_restarts if budget is None else int(budget)
+
+    def _backoff(self, block, restarts):
+        base = getattr(block, 'restart_backoff', None)
+        base = self.default_backoff if base is None else float(base)
+        return min(base * (2 ** restarts), _BACKOFF_CAP)
+
+    # -- failure reporting (called from block threads) ---------------------
+    def record(self, failure):
+        with self._lock:
+            self.failures.append(failure)
+        return failure
+
+    def block_failed(self, block, exc, restarts):
+        """Apply ``block``'s policy to a failure that escaped its main
+        loop.  Returns ``('restart', delay_seconds)`` or
+        ``('abort', 0.0)``; the abort side effects (poison + shutdown)
+        have already run when this returns."""
+        counters.inc('block_failures')
+        policy = self.policy_of(block)
+        if (policy == 'restart'
+                and restarts < self._restart_budget(block)
+                and not self.abort_event.is_set()
+                and not block.shutdown_event.is_set()):
+            counters.inc('block_restarts')
+            delay = self._backoff(block, restarts)
+            self.record(BlockFailure(block.name, exc, kind='restarted',
+                                     fatal=False, restarts=restarts + 1))
+            return 'restart', delay
+        failure = self.record(BlockFailure(block.name, exc,
+                                           restarts=restarts))
+        self.abort(failure)
+        return 'abort', 0.0
+
+    def block_skipped(self, block, exc):
+        """Record a skip_sequence degradation (non-fatal)."""
+        counters.inc('block_failures')
+        self.record(BlockFailure(block.name, exc, kind='skipped',
+                                 fatal=False))
+
+    def block_poisoned(self, block, exc):
+        """A block died on a poisoned ring: a cascade, not a root cause.
+        Recorded for diagnostics unless the pipeline is simply shutting
+        down (then it is the intended wakeup)."""
+        if getattr(self.pipeline, '_shutting_down', False) \
+                and not self.abort_event.is_set():
+            return
+        self.record(BlockFailure(block.name, exc, kind='poisoned',
+                                 fatal=False))
+
+    def block_finished(self, block):
+        pass     # hook for symmetry / future per-block accounting
+
+    # -- abort -------------------------------------------------------------
+    def abort(self, failure=None):
+        """Poison every ring and set every shutdown event so all block
+        threads wake promptly; idempotent."""
+        if self.abort_event.is_set():
+            return
+        self.abort_event.set()
+        cause = failure.exc if failure is not None else \
+            RuntimeError('pipeline aborted')
+        # release anyone parked at the init barrier
+        self.pipeline.all_blocks_finished_initializing_event.set()
+        for block in self.pipeline.blocks:
+            block.shutdown_event.set()
+        for block in self.pipeline.blocks:
+            for ring in (list(getattr(block, 'orings', ())) +
+                         list(getattr(block, 'irings', ()))):
+                try:
+                    ring.poison(cause)
+                except Exception:
+                    pass
+
+    def raise_if_failed(self):
+        with self._lock:
+            failures = list(self.failures)
+        fatal = [f for f in failures if f.fatal]
+        if not fatal:
+            return
+        cls = PipelineStallError if isinstance(fatal[0].exc,
+                                               PipelineStallError) \
+            else PipelineRuntimeError
+        raise cls(failures) from fatal[0].exc
+
+    def failures_for(self, block_name):
+        with self._lock:
+            return [f for f in self.failures
+                    if f.block_name == block_name]
+
+    # -- watchdog ----------------------------------------------------------
+    def start_watchdog(self, secs=None):
+        """Start the stall watchdog (no-op when no window configured).
+        ``secs`` falls back to ``BF_WATCHDOG_SECS``; escalation to
+        abort is opt-in via ``BF_WATCHDOG_ESCALATE=1``."""
+        if secs is None:
+            secs = _env_float('BF_WATCHDOG_SECS', 0.0)
+        if not secs or secs <= 0:
+            return None
+        escalate = os.environ.get('BF_WATCHDOG_ESCALATE', '0') == '1'
+        self._watchdog = _Watchdog(self, float(secs), escalate)
+        self._watchdog.start()
+        return self._watchdog
+
+    def stop_watchdog(self):
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
+
+class _Watchdog(threading.Thread):
+    """Daemon thread watching block heartbeats for whole-pipeline
+    stalls.  A stall is declared when EVERY live block has been idle
+    for at least ``timeout`` seconds — a single block waiting on input
+    is normal backpressure, but nobody moving means the pipeline is
+    wedged (deadlock, hung device call, dead upstream)."""
+
+    def __init__(self, supervisor, timeout, escalate):
+        super(_Watchdog, self).__init__(name='bf-watchdog', daemon=True)
+        self.supervisor = supervisor
+        self.timeout = timeout
+        self.escalate = escalate
+        self._stop_event = threading.Event()
+        self._fired_epoch = -1.0
+        self._proclog = None
+
+    def stop(self):
+        self._stop_event.set()
+
+    def _live_blocks(self):
+        out = []
+        for block in self.supervisor.pipeline.blocks:
+            thread = getattr(block, '_thread', None)
+            if thread is not None and thread.is_alive():
+                out.append(block)
+        return out
+
+    def run(self):
+        poll = max(min(self.timeout / 4.0, 1.0), 0.05)
+        while not self._stop_event.wait(poll):
+            if self.supervisor.abort_event.is_set():
+                return
+            blocks = self._live_blocks()
+            if not blocks:
+                return
+            now = time.monotonic()
+            beats = [getattr(b, '_hb_time', None) or now for b in blocks]
+            newest = max(beats)
+            if now - newest < self.timeout:
+                continue
+            if newest <= self._fired_epoch:
+                continue            # already reported this stall
+            self._fired_epoch = newest
+            self._report(blocks, now - newest)
+            if self.escalate:
+                stall = PipelineStallError(
+                    'pipeline stalled: no block progressed for %.1fs '
+                    '(BF_WATCHDOG_SECS=%g); stalled blocks: %s'
+                    % (now - newest, self.timeout,
+                       ', '.join(b.name for b in blocks)))
+                failure = self.supervisor.record(BlockFailure(
+                    '<watchdog>', stall, kind='stall', fatal=True,
+                    tb=stall.args[0]))
+                self.supervisor.abort(failure)
+                return
+
+    def _report(self, blocks, idle):
+        counters.inc('watchdog_stalls')
+        stacks = dump_thread_stacks()
+        rings = ring_occupancies(self.supervisor.pipeline)
+        lines = ['=== bifrost_tpu watchdog: pipeline stall '
+                 '(no progress for %.1fs) ===' % idle]
+        for b in blocks:
+            lines.append('  block %-40s gulps=%d idle=%.1fs'
+                         % (b.name, getattr(b, '_hb_gulps', 0),
+                            time.monotonic() -
+                            (getattr(b, '_hb_time', None) or 0)))
+        for name, occ in sorted(rings.items()):
+            lines.append('  ring  %-40s %r' % (name, occ))
+        lines.append(stacks)
+        lines.append('=== end watchdog dump ===')
+        sys.stderr.write('\n'.join(lines) + '\n')
+        try:
+            from .proclog import ProcLog
+            if self._proclog is None:
+                self._proclog = ProcLog('pipeline/watchdog')
+            self._proclog.update({
+                'stalls': counters.get('watchdog_stalls'),
+                'last_stall_unix': time.time(),
+                'idle_secs': round(idle, 3),
+                'stalled_blocks': ','.join(b.name for b in blocks),
+            }, force=True)
+        except Exception:
+            pass

@@ -31,7 +31,7 @@ from threading import RLock
 
 from . import counters  # noqa: F401  (always-on perf counters)
 
-__all__ = ['is_active', 'enable', 'disable', 'track_script',
+__all__ = ['is_active', 'enable', 'disable', 'flush', 'track_script',
            'track_module', 'track_function', 'track_function_timed',
            'track_method', 'track_method_timed', 'usage_path',
            'counters']
@@ -266,3 +266,42 @@ def track_method_timed(method):
                       time.perf_counter() - t0)
         return result
     return wrapper
+
+
+#: robustness counters mirrored into the usage aggregates by flush()
+#: (supervision layer — see telemetry/counters.py docstring)
+_SURFACED_COUNTERS = ('block_failures', 'block_restarts',
+                      'ring_poisoned', 'watchdog_stalls')
+_surfaced_totals = {}
+
+
+def flush():
+    """Flush pending usage aggregates and surface the always-on perf
+    counters.
+
+    Returns the full :func:`counters.snapshot` dict (so callers —
+    operators, benchmarks, the supervision tests — can read the
+    robustness counters without touching internals).  When local usage
+    aggregation is enabled, the deltas of the robustness counters since
+    the previous flush are merged into the usage file under
+    ``bifrost_tpu.counters.<name>`` entries, making chronic failure /
+    restart / stall churn visible in
+    ``python -m bifrost_tpu.telemetry --status`` history.
+    """
+    snap = counters.snapshot()
+    if _client.active:
+        with _client._lock:
+            for name in _SURFACED_COUNTERS:
+                total = snap.get(name, 0)
+                delta = total - _surfaced_totals.get(name, 0)
+                if delta > 0:
+                    entry = _client._cache.setdefault(
+                        'bifrost_tpu.counters.' + name, [0, 0, 0.0])
+                    entry[0] += delta
+                    _surfaced_totals[name] = total
+                elif delta < 0:
+                    # counters.reset() ran: re-anchor the watermark so
+                    # post-reset increments are not silently dropped
+                    _surfaced_totals[name] = total
+    _client.flush()
+    return snap

@@ -25,6 +25,23 @@ Counter names used by the framework:
                                            Block._sync_gulp
 - ``donation.hits`` / ``donation.misses``   gulp inputs donated to XLA /
                                            eligible but not exclusive
+
+Robustness counters (supervision layer — docs/robustness.md; surfaced
+by :func:`bifrost_tpu.telemetry.flush`):
+
+- ``block_failures``                       exceptions that escaped a
+                                           block's main loop (any policy)
+- ``block_restarts``                       restart-policy re-entries
+- ``ring_poisoned``                        rings marked dead by
+                                           Ring.poison (failure
+                                           propagation / shutdown wakeup)
+- ``watchdog_stalls``                      whole-pipeline stalls the
+                                           watchdog detected
+- ``xfer.errors`` / ``xfer.fill_errors``    failed D2H transfers /
+                                           deferred ring fills
+- ``io.socket_retries``                    transient socket errors
+                                           (EINTR/ECONNREFUSED) retried
+                                           with backoff
 """
 
 from __future__ import annotations

@@ -1,0 +1,12 @@
+"""Test-support utilities shipped with the package (importable from
+production code paths, but inert unless explicitly armed).
+
+- :mod:`bifrost_tpu.testing.faults` — deterministic fault injection at
+  the block/ring/transfer seams, used by the supervision tests to
+  exercise failure propagation, ring poisoning, restart policies, and
+  the stall watchdog on the CPU backend.
+"""
+
+from . import faults  # noqa: F401
+
+__all__ = ['faults']

@@ -62,6 +62,8 @@ from collections import deque
 
 import numpy as np
 
+from .testing import faults
+
 __all__ = ['to_device', 'to_host', 'to_host_async', 'prefetch',
            'engine', 'reset_engine', 'async_enabled', 'strict_mode',
            'TransferEngine', 'TransferFuture', 'HostFill']
@@ -291,15 +293,24 @@ class TransferFuture(object):
     happened) and caches the converted numpy value.  Futures complete
     correctly in any order — the queue in :class:`TransferEngine` only
     bounds how many are outstanding.
+
+    A transfer that FAILS (deleted source array, backend error,
+    injected fault) completes the future with that error: every
+    ``result()`` call re-raises it, ``done`` becomes True so the
+    engine's drain retires it instead of retrying forever, and
+    deferred ring fills propagate it into ring poisoning (see
+    :class:`HostFill`).
     """
 
-    __slots__ = ('_arrays', '_convert', '_done', '_result', '_lock')
+    __slots__ = ('_arrays', '_convert', '_done', '_result', '_error',
+                 '_lock')
 
     def __init__(self, arrays, convert, result=None, done=False):
         self._arrays = list(arrays)
         self._convert = convert
         self._done = done
         self._result = result
+        self._error = None
         self._lock = threading.Lock()
 
     def ready(self):
@@ -316,15 +327,29 @@ class TransferFuture(object):
     def result(self):
         with self._lock:
             if self._done:
+                if self._error is not None:
+                    raise self._error
                 return self._result
-            if not all(a.is_deleted() or a.is_ready()
-                       for a in self._arrays):
-                _counters().inc('xfer.sync_waits')
-            host = [np.asarray(a) for a in self._arrays]
-            self._result = self._convert(host)
+            try:
+                faults.fire('xfer.result')
+                if not all(a.is_deleted() or a.is_ready()
+                           for a in self._arrays):
+                    _counters().inc('xfer.sync_waits')
+                host = [np.asarray(a) for a in self._arrays]
+                self._result = self._convert(host)
+            except Exception as exc:
+                self._error = exc
+                self._done = True
+                self._arrays = []
+                _counters().inc('xfer.errors')
+                raise
             self._done = True
             self._arrays = []      # drop device refs promptly
             return self._result
+
+    @property
+    def error(self):
+        return self._error
 
     @property
     def done(self):
@@ -340,10 +365,15 @@ class HostFill(object):
     :meth:`wait` first (ring.py), so data is materialized exactly when
     first needed — by which time the DMA has usually finished.
     ``wait`` is idempotent and thread-safe (multiple readers may race
-    to complete the same fill)."""
+    to complete the same fill).
+
+    A FAILED transfer is not swallowed: the first ``wait`` records the
+    error, POISONS the target ring (waking every reader/writer with
+    ``RingPoisonedError`` instead of handing them a span of garbage
+    bytes), and re-raises; later waits re-raise the same error."""
 
     __slots__ = ('future', 'dtype', 'out', 'begin', 'nbyte',
-                 '_storage', 'done', '_lock')
+                 '_storage', '_ring', 'done', 'error', '_lock')
 
     def __init__(self, future, dtype, out_view):
         self.future = future
@@ -352,7 +382,9 @@ class HostFill(object):
         self.begin = None
         self.nbyte = 0
         self._storage = None
+        self._ring = None
         self.done = False
+        self.error = None
         self._lock = threading.Lock()
 
     def attach(self, ring, begin, nbyte):
@@ -364,10 +396,11 @@ class HostFill(object):
         deferred ghost mirror runs here instead; no reader can have
         acquired the span yet (commit happens after attach)."""
         self._storage = ring._storage
+        self._ring = ring
         self.begin = begin
         self.nbyte = nbyte
         with self._lock:
-            if self.done and nbyte:
+            if self.done and self.error is None and nbyte:
                 self._storage.fill_ghost_mirror(begin, nbyte)
 
     def cancel(self):
@@ -383,12 +416,26 @@ class HostFill(object):
         spans (the commit-time mirror ran before the bytes landed)."""
         with self._lock:
             if self.done:
+                if self.error is not None:
+                    raise self.error
                 return
-            host = self.future.result()
-            from .devrep import from_device_rep
-            from_device_rep(host, self.dtype, self.out)
-            if self._storage is not None and self.nbyte:
-                self._storage.fill_ghost_mirror(self.begin, self.nbyte)
+            try:
+                host = self.future.result()
+                from .devrep import from_device_rep
+                from_device_rep(host, self.dtype, self.out)
+                if self._storage is not None and self.nbyte:
+                    self._storage.fill_ghost_mirror(self.begin,
+                                                    self.nbyte)
+            except Exception as exc:
+                self.done = True
+                self.error = exc
+                _counters().inc('xfer.fill_errors')
+                if self._ring is not None:
+                    try:
+                        self._ring.poison(exc)
+                    except Exception:
+                        pass
+                raise
             self.done = True
 
 
@@ -442,6 +489,7 @@ class TransferEngine(object):
         reuse, a fresh aligned buffer is used instead — never the
         caller's own memory, whose recycling would race the async
         DMA."""
+        faults.fire('xfer.h2d')
         c = _counters()
         slot = None
         if not self._is_zero_copy() and arr.nbytes >= self.stage_min \
@@ -508,6 +556,7 @@ class TransferEngine(object):
 
     def _future_for(self, arr):
         """TransferFuture for a jax array (complex split on device)."""
+        faults.fire('xfer.d2h')
         import jax
         import jax.numpy as jnp
         if hasattr(arr, 'as_numpy'):       # bifrost_tpu.ndarray
@@ -584,22 +633,36 @@ class TransferEngine(object):
         """Retire completed async transfers (non-blocking scan); with
         ``block=True``, force every outstanding transfer to complete.
         Returns the number retired.  The pipeline's dispatch-ahead
-        drain calls this once per gulp."""
+        drain calls this once per gulp.
+
+        A failed transfer raises out of the draining thread (after the
+        failure has been recorded on the future/fill, so the queues
+        still retire it) — the block whose gulp loop drained it then
+        applies its failure policy instead of the error vanishing."""
         n = 0
+        error = None
         with self._lock:
             pending = list(self._pending)
             fills = list(self._fills)
         for fut in pending:
             if block or fut.ready():
-                fut.result()
+                try:
+                    fut.result()
+                except Exception as exc:
+                    error = error if error is not None else exc
         for fill in fills:
             if block or fill.done or fill.future.ready():
-                fill.wait()
+                try:
+                    fill.wait()
+                except Exception as exc:
+                    error = error if error is not None else exc
         with self._lock:
             for q in (self._pending, self._fills):
                 while q and q[0].done:
                     q.popleft()
                     n += 1
+        if error is not None:
+            raise error
         return n
 
     @property
@@ -628,7 +691,10 @@ def reset_engine():
     global _engine
     with _engine_lock:
         if _engine is not None:
-            _engine.drain(block=True)
+            try:
+                _engine.drain(block=True)
+            except Exception:
+                pass       # failed transfers die with the engine
         _engine = None
 
 

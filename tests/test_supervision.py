@@ -1,0 +1,519 @@
+"""Supervision-layer tests: failure propagation policies
+(abort/restart/skip_sequence), ring poisoning in both ring cores,
+deferred-fill error surfacing, and the stall watchdog — all driven by
+the deterministic fault harness (bifrost_tpu.testing.faults) on the
+CPU backend."""
+
+import contextlib
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+import bifrost_tpu.native as native_mod
+from bifrost_tpu.ring import Ring, RingPoisonedError
+from bifrost_tpu.supervision import (PipelineRuntimeError,
+                                     PipelineStallError)
+from bifrost_tpu.telemetry import counters
+from bifrost_tpu.testing import faults
+from tests.util import (NumpySourceBlock, GatherSink, simple_header,
+                        _NumpyReader)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def clean_faults_and_counters():
+    faults.clear()
+    counters.reset()
+    yield
+    faults.clear()
+
+
+def _hdr():
+    return simple_header([-1, 3], 'f32')
+
+
+def _gulps(n=5):
+    return [np.full((4, 3), float(k), dtype=np.float32)
+            for k in range(n)]
+
+
+class Ident(bf.TransformBlock):
+    """Pass-through host transform with a distinctive name for fault
+    matching."""
+
+    def on_sequence(self, iseq):
+        return dict(iseq.header)
+
+    def on_data(self, ispan, ospan):
+        ospan.data.as_numpy()[...] = ispan.data.as_numpy()
+
+
+class TwoSeqSource(NumpySourceBlock):
+    """Emits the same gulp list as two separate sequences."""
+
+    def __init__(self, *args, **kwargs):
+        super(TwoSeqSource, self).__init__(*args, **kwargs)
+        self.sourcenames = ['seq-a', 'seq-b']
+
+    def create_reader(self, sourcename):
+        return _NumpyReader(self._gulps)
+
+
+def _run_with_timeout(pipeline, timeout=30.0):
+    """Run the pipeline in a worker thread so a regression back to the
+    silent-hang behavior fails the test instead of wedging the suite.
+    Returns the exception ``run()`` raised (or None)."""
+    box = []
+
+    def target():
+        try:
+            with contextlib.redirect_stderr(io.StringIO()):
+                pipeline.run()
+            box.append(None)
+        except BaseException as exc:
+            box.append(exc)
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), \
+        "Pipeline.run did not terminate within %gs" % timeout
+    return box[0]
+
+
+# ---------------------------------------------------------------------------
+# failure propagation
+# ---------------------------------------------------------------------------
+
+def test_abort_midstream_no_hang():
+    """A mid-stream block exception terminates the pipeline promptly
+    and surfaces as PipelineRuntimeError carrying the original
+    traceback (the ISSUE acceptance scenario)."""
+    with faults.injected('block.on_data', match='Ident', after=1):
+        with bf.Pipeline() as p:
+            p.shutdown_timeout = 2.0
+            src = NumpySourceBlock(_gulps(50), _hdr(), gulp_nframe=4)
+            blk = Ident(src)
+            GatherSink(blk)
+            t0 = time.monotonic()
+            exc = _run_with_timeout(p, timeout=20.0)
+            elapsed = time.monotonic() - t0
+    assert isinstance(exc, PipelineRuntimeError), repr(exc)
+    # wind-down bounded by shutdown_timeout (+ slack for the join loop)
+    assert elapsed < 2.0 + 8.0
+    # original exception type, message, and traceback text survive
+    msg = str(exc)
+    assert 'FaultInjected' in msg and 'injected fault' in msg
+    assert 'Traceback' in msg
+    assert exc.primary is not None
+    assert 'Ident' in exc.primary.block_name
+    assert counters.get('block_failures') == 1
+    assert counters.get('ring_poisoned') > 0
+
+
+def test_abort_poisons_upstream_source():
+    """The failed block's UPSTREAM source must stop too (the classic
+    silent-hang case: a capture source happily feeding a ring whose
+    only consumer died)."""
+    with faults.injected('block.on_data', match='Ident', after=1):
+        with bf.Pipeline() as p:
+            p.shutdown_timeout = 2.0
+            # many gulps: without poisoning, the source would keep
+            # writing long after the consumer died
+            src = NumpySourceBlock(_gulps(500), _hdr(), gulp_nframe=4)
+            blk = Ident(src)
+            GatherSink(blk)
+            exc = _run_with_timeout(p, timeout=20.0)
+    assert isinstance(exc, PipelineRuntimeError)
+    for thread in p.threads:
+        assert not thread.is_alive()
+
+
+def test_restart_source_survives_transient_failures():
+    """A restart-policy source survives 3 injected transient failures
+    with backoff and the pipeline completes (ISSUE acceptance)."""
+    with faults.injected('block.run', match='NumpySourceBlock',
+                         count=3):
+        with bf.Pipeline() as p:
+            src = NumpySourceBlock(_gulps(3), _hdr(), gulp_nframe=4,
+                                   on_failure='restart',
+                                   max_restarts=5,
+                                   restart_backoff=0.01)
+            sink = GatherSink(src)
+            exc = _run_with_timeout(p)
+    assert exc is None, repr(exc)
+    assert sink.result().shape == (12, 3)
+    assert counters.get('block_restarts') == 3
+    assert counters.get('block_failures') == 3
+
+
+def test_restart_budget_exhaustion_escalates_to_abort():
+    with faults.injected('block.run', match='NumpySourceBlock',
+                         count=10):
+        with bf.Pipeline() as p:
+            p.shutdown_timeout = 2.0
+            src = NumpySourceBlock(_gulps(3), _hdr(), gulp_nframe=4,
+                                   on_failure='restart',
+                                   max_restarts=2,
+                                   restart_backoff=0.01)
+            GatherSink(src)
+            exc = _run_with_timeout(p)
+    assert isinstance(exc, (PipelineRuntimeError,
+                            bf.PipelineInitError)), repr(exc)
+    assert counters.get('block_restarts') == 2
+
+
+def test_skip_sequence_policy_degrades_gracefully():
+    """A skip_sequence transform abandons the failing sequence (its
+    output for it stays empty) and delivers the next one intact."""
+    with faults.injected('block.on_sequence', match='Ident', count=1,
+                         after=1):
+        with bf.Pipeline() as p:
+            src = TwoSeqSource(_gulps(3), _hdr(), gulp_nframe=4)
+            blk = Ident(src, on_failure='skip_sequence')
+            sink = GatherSink(blk)
+            exc = _run_with_timeout(p)
+    assert exc is None, repr(exc)
+    # one of the two sequences was dropped, the other arrived whole
+    assert len(sink.headers) == 1
+    assert sink.result().shape == (12, 3)
+    assert counters.get('block_failures') == 1
+    assert counters.get('block_restarts') == 0
+
+
+def test_unknown_policy_is_rejected():
+    """A misspelled policy fails fast in the launching thread, before
+    any block thread starts."""
+    with bf.Pipeline() as p:
+        NumpySourceBlock(_gulps(2), _hdr(), gulp_nframe=4,
+                         on_failure='retry-plz')
+        with pytest.raises(ValueError, match='retry-plz'):
+            p.run()
+    assert not p.threads
+
+
+def test_init_failure_still_raises_pipeline_init_error():
+    """Pre-barrier failures keep the historical PipelineInitError
+    contract (now enriched with the traceback)."""
+
+    class BadBlock(bf.TransformBlock):
+        def on_sequence(self, iseq):
+            raise RuntimeError("boom-at-init")
+
+        def on_data(self, ispan, ospan):
+            pass
+
+    with bf.Pipeline() as p:
+        p.shutdown_timeout = 2.0
+        src = NumpySourceBlock(_gulps(1), _hdr(), gulp_nframe=4)
+        BadBlock(src)
+        exc = _run_with_timeout(p)
+    assert isinstance(exc, bf.PipelineInitError)
+    assert 'boom-at-init' in str(exc)
+
+
+# ---------------------------------------------------------------------------
+# ring poisoning (both cores)
+# ---------------------------------------------------------------------------
+
+CORES = ['python'] + (['native'] if native_mod.available() else [])
+
+
+@pytest.fixture(params=CORES)
+def ring_core(request, monkeypatch):
+    if request.param == 'python':
+        monkeypatch.setattr(native_mod, '_lib', None)
+        monkeypatch.setattr(native_mod, '_tried', True)
+    return request.param
+
+
+def test_poison_wakes_blocked_reader(ring_core):
+    ring = Ring(space='system')
+    if ring_core == 'python':
+        from bifrost_tpu.ring_native import NativeRing
+        assert not isinstance(ring, NativeRing)
+    hdr = _hdr()
+    caught = []
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(dict(hdr), gulp_nframe=4,
+                                   buf_nframe=12) as seq:
+                with seq.reserve(4) as span:
+                    span.data.as_numpy()[...] = 1.0
+                    span.commit(4)
+                # hold the sequence open: the reader will block on
+                # gulp 2, which never arrives
+                time.sleep(30)
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+
+    def reader():
+        try:
+            for seq in ring.read(guarantee=True):
+                for _span in seq.read(4):
+                    pass
+        except RingPoisonedError as exc:
+            caught.append(exc)
+
+    rt = threading.Thread(target=reader, daemon=True)
+    rt.start()
+    time.sleep(0.3)
+    assert rt.is_alive()
+    ring.poison(RuntimeError("producer died"))
+    rt.join(5)
+    assert not rt.is_alive(), "poison did not wake the blocked reader"
+    assert caught and 'producer died' in str(caught[0])
+    assert isinstance(caught[0].cause, RuntimeError)
+    assert ring.poisoned
+    assert counters.get('ring_poisoned') == 1
+
+
+def test_poison_wakes_blocked_writer(ring_core):
+    ring = Ring(space='system')
+    hdr = _hdr()
+    caught = []
+    reader_ready = threading.Event()
+
+    def writer():
+        try:
+            with ring.begin_writing() as wr:
+                with wr.begin_sequence(dict(hdr), gulp_nframe=4,
+                                       buf_nframe=8) as seq:
+                    with seq.reserve(4) as span:
+                        span.data.as_numpy()[...] = 0.0
+                        span.commit(4)
+                    assert reader_ready.wait(10)
+                    for k in range(1, 100):
+                        with seq.reserve(4) as span:
+                            span.data.as_numpy()[...] = float(k)
+                            span.commit(4)
+        except RingPoisonedError as exc:
+            caught.append(exc)
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    with ring.open_earliest_sequence(guarantee=True) as rseq:
+        span = rseq.acquire(0, 4)     # pins the guarantee at frame 0
+        reader_ready.set()
+        time.sleep(0.3)
+        assert wt.is_alive(), "writer should be blocked on the full ring"
+        ring.poison(RuntimeError("consumer died"))
+        wt.join(5)
+        alive = wt.is_alive()
+        span.release()
+    assert not alive, "poison did not wake the blocked writer"
+    assert caught and 'consumer died' in str(caught[0])
+
+
+def test_poisoned_ring_fails_fast_on_new_operations(ring_core):
+    ring = Ring(space='system')
+    ring.poison(RuntimeError("dead"))
+    hdr = _hdr()
+    with ring.begin_writing() as wr:
+        with pytest.raises(RingPoisonedError):
+            wr.begin_sequence(dict(hdr), gulp_nframe=4, buf_nframe=12)
+    occ = ring.occupancy()
+    assert occ['poisoned'] is True
+
+
+def test_poison_is_idempotent(ring_core):
+    ring = Ring(space='system')
+    ring.poison(RuntimeError("first"))
+    ring.poison(RuntimeError("second"))
+    assert counters.get('ring_poisoned') == 1
+    try:
+        ring._check_poison()
+        assert False, "expected RingPoisonedError"
+    except RingPoisonedError as exc:
+        assert 'first' in str(exc)
+
+
+# ---------------------------------------------------------------------------
+# transfer-engine failure surfacing
+# ---------------------------------------------------------------------------
+
+def test_failed_hostfill_poisons_ring_and_wakes_reader():
+    """A deferred D2H fill whose transfer fails must poison its ring:
+    the waiting reader gets the error, later readers RingPoisonedError
+    — not a silent span of stale bytes."""
+    from bifrost_tpu.xfer import HostFill, TransferFuture
+
+    ring = Ring(space='system')
+    hdr = _hdr()
+
+    def exploding_convert(_host):
+        raise RuntimeError("DMA exploded")
+
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(dict(hdr), gulp_nframe=4,
+                               buf_nframe=12) as seq:
+            with seq.reserve(4) as span:
+                fill = HostFill(TransferFuture([], exploding_convert),
+                                'f32', span.data)
+                span.set_fill(fill)
+                span.commit(4)
+        with ring.open_earliest_sequence(guarantee=True) as rseq:
+            with pytest.raises(RuntimeError, match="DMA exploded"):
+                rseq.acquire(0, 4)
+    assert ring.poisoned
+    assert counters.get('xfer.fill_errors') == 1
+    # the same fill re-raises instead of pretending success
+    with pytest.raises(RuntimeError, match="DMA exploded"):
+        fill.wait()
+
+
+def test_transfer_future_caches_error():
+    from bifrost_tpu.xfer import TransferFuture
+
+    calls = []
+
+    def bad_convert(_host):
+        calls.append(1)
+        raise ValueError("bad transfer")
+
+    fut = TransferFuture([], bad_convert)
+    with pytest.raises(ValueError):
+        fut.result()
+    with pytest.raises(ValueError):
+        fut.result()
+    assert fut.done and len(calls) == 1
+    assert counters.get('xfer.errors') == 1
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_stall_drill(monkeypatch):
+    """A block wedged mid-gulp (delay-only fault) trips the watchdog:
+    counter + stack/ring dump, and with escalation enabled the run
+    raises PipelineStallError."""
+    monkeypatch.setenv('BF_WATCHDOG_ESCALATE', '1')
+    stderr = io.StringIO()
+    with faults.injected('block.on_data', match='Ident', count=1,
+                         after=1, delay=15, exc=None):
+        with bf.Pipeline(watchdog_secs=0.5) as p:
+            p.shutdown_timeout = 1.0
+            src = NumpySourceBlock(_gulps(50), _hdr(), gulp_nframe=4)
+            blk = Ident(src)
+            GatherSink(blk)
+
+            box = []
+
+            def target():
+                try:
+                    with contextlib.redirect_stderr(stderr):
+                        p.run()
+                    box.append(None)
+                except BaseException as exc:
+                    box.append(exc)
+
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            t.join(20)
+            assert not t.is_alive()
+    exc = box[0]
+    assert isinstance(exc, PipelineStallError), repr(exc)
+    assert isinstance(exc, PipelineRuntimeError)   # subclass contract
+    assert 'no block progressed' in str(exc)
+    assert counters.get('watchdog_stalls') == 1
+    dump = stderr.getvalue()
+    assert 'watchdog' in dump
+    assert 'Thread' in dump          # stack dump present
+    assert 'ring' in dump            # ring occupancy present
+
+
+def test_watchdog_quiet_on_healthy_pipeline(monkeypatch):
+    monkeypatch.setenv('BF_WATCHDOG_ESCALATE', '1')
+    with bf.Pipeline(watchdog_secs=5.0) as p:
+        src = NumpySourceBlock(_gulps(5), _hdr(), gulp_nframe=4)
+        sink = GatherSink(src)
+        exc = _run_with_timeout(p)
+    assert exc is None
+    assert sink.result().shape == (20, 3)
+    assert counters.get('watchdog_stalls') == 0
+
+
+# ---------------------------------------------------------------------------
+# fault harness + telemetry surfacing
+# ---------------------------------------------------------------------------
+
+def test_fault_counts_and_after_are_deterministic():
+    f = faults.inject('unit.test', count=2, after=1)
+    faults.fire('unit.test')                        # skipped (after)
+    with pytest.raises(faults.FaultInjected):
+        faults.fire('unit.test')
+    with pytest.raises(faults.FaultInjected):
+        faults.fire('unit.test')
+    faults.fire('unit.test')                        # count exhausted
+    assert f.fired == 2
+    assert faults.fired('unit.test') == 2
+
+
+def test_fault_match_filters_by_name():
+    faults.inject('unit.site', match='target')
+    faults.fire('unit.site', 'other-block')         # no match
+    with pytest.raises(faults.FaultInjected):
+        faults.fire('unit.site', 'my-target-block')
+
+
+def test_arm_from_env(monkeypatch):
+    faults.clear()
+    monkeypatch.setenv('BF_FAULTS', 'unit.env:blk:2:1:0')
+    faults.arm_from_env()
+    faults.fire('unit.env', 'blk-0')                # after=1 skip
+    with pytest.raises(faults.FaultInjected):
+        faults.fire('unit.env', 'blk-0')
+
+
+def test_telemetry_flush_surfaces_robustness_counters():
+    import bifrost_tpu.telemetry as telemetry
+    counters.inc('block_failures', 2)
+    counters.inc('ring_poisoned')
+    snap = telemetry.flush()
+    assert snap['block_failures'] == 2
+    assert snap['ring_poisoned'] == 1
+    assert 'watchdog_stalls' not in snap or \
+        snap['watchdog_stalls'] == 0
+
+
+def test_socket_retry_transient_with_budget(monkeypatch):
+    import errno
+    from bifrost_tpu.io.udp_socket import retry_transient
+
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) <= 2:
+            raise OSError(errno.ECONNREFUSED, 'refused')
+        return 'ok'
+
+    assert retry_transient(flaky, budget=5, backoff=0.001) == 'ok'
+    assert len(attempts) == 3
+    assert counters.get('io.socket_retries') == 2
+
+    # budget exhaustion surfaces the real error
+    attempts[:] = []
+
+    def always_refused():
+        attempts.append(1)
+        raise OSError(errno.ECONNREFUSED, 'refused')
+
+    with pytest.raises(OSError):
+        retry_transient(always_refused, budget=3, backoff=0.001)
+    assert len(attempts) == 4       # initial try + 3 retries
+
+    # non-transient errnos pass straight through
+    def hard_fail():
+        raise OSError(errno.EBADF, 'bad fd')
+
+    with pytest.raises(OSError):
+        retry_transient(hard_fail, budget=5, backoff=0.001)

@@ -20,6 +20,29 @@ export BF_BENCH_ROUND="$ROUND"
 OUT="BENCH_${ROUND}.json"
 LOG=bench_watch.log
 echo "$(date -u +%FT%TZ) watcher start pid=$$ round=$ROUND" >> "$LOG"
+
+# Tier-1 gate: run the CPU suite under a hard timeout with the stall
+# watchdog armed.  A HUNG run (a regression back to the silent
+# pipeline-hang failure mode — timeout rc 124/137) fails the watcher
+# fast with a non-zero exit instead of wedging it for the whole round;
+# ordinary test failures are logged but do not block the bench capture
+# (the driver's own tier-1 gate judges those).  BF_SKIP_T1_GATE=1 opts
+# out.
+if [ "${BF_SKIP_T1_GATE:-0}" != "1" ]; then
+  T1_TIMEOUT="${BF_T1_TIMEOUT:-870}"
+  echo "$(date -u +%FT%TZ) tier-1 gate (timeout ${T1_TIMEOUT}s)" >> "$LOG"
+  timeout -k 10 "$T1_TIMEOUT" env JAX_PLATFORMS=cpu \
+    BF_WATCHDOG_SECS="${BF_WATCHDOG_SECS:-120}" BF_WATCHDOG_ESCALATE=1 \
+    python -m pytest tests/ -q -m 'not slow' \
+      --continue-on-collection-errors -p no:cacheprovider \
+      > "t1_gate_${ROUND}.log" 2>&1
+  t1rc=$?
+  echo "$(date -u +%FT%TZ) tier-1 gate rc=$t1rc" >> "$LOG"
+  if [ "$t1rc" -eq 124 ] || [ "$t1rc" -eq 137 ]; then
+    echo "$(date -u +%FT%TZ) tier-1 HUNG past the watchdog timeout - failing fast" >> "$LOG"
+    exit "$t1rc"
+  fi
+fi
 for i in $(seq 1 400); do
   out=$(BF_PROBE_DEADLINE=120 timeout 180 python tools/tpu_probe.py 2>/dev/null)
   rc=$?
