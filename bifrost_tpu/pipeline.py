@@ -30,10 +30,13 @@ import traceback
 import warnings
 import queue as queue_mod
 from collections import defaultdict, deque
-from contextlib import ExitStack
+from contextlib import ExitStack, nullcontext
 from copy import copy
 
 from . import affinity, device, memory
+from .telemetry import exporter as _metrics_exporter
+from .telemetry import histograms as _histograms
+from .telemetry import spans as _spans
 from .trace import ScopedTracer, tracing_enabled as _tracing
 from .ring import Ring, ring_view, EndOfDataStop, RingPoisonedError
 from .ndarray import memset_array
@@ -460,6 +463,12 @@ class Pipeline(BlockScope):
             from .device import ensure_backend
             ensure_backend()
         faults.arm_from_env()
+        # honor BF_TRACE_FILE / BF_SPAN_BUFFER changes made since the
+        # last run (tests, long-lived operator processes), and drop
+        # dead threads' span buffers so this run's trace export /
+        # flight record is not contaminated by earlier runs
+        _spans.reconfigure()
+        _spans.prune_dead_buffers()
         self._shutting_down = False
         self.supervisor = Supervisor(self)
         self.threads = [threading.Thread(target=block.run, name=block.name)
@@ -470,6 +479,11 @@ class Pipeline(BlockScope):
             thread.start()
         self.synchronize_block_initializations()
         self.supervisor.start_watchdog(self.watchdog_secs)
+        # periodic metrics publisher: telemetry/metrics +
+        # rings_flow/<name> proclogs, BF_METRICS_FILE Prometheus
+        # textfile (docs/observability.md)
+        metrics = _metrics_exporter.MetricsPublisher(self)
+        metrics.start()
         # Join in short slices (not one unbounded join): dead threads
         # are detected promptly, KeyboardInterrupt is serviced between
         # slices, and a fatal failure bounds the wind-down wait at
@@ -497,6 +511,8 @@ class Pipeline(BlockScope):
             raise
         finally:
             self.supervisor.stop_watchdog()
+            metrics.stop()               # publishes one final snapshot
+            _spans.export_if_configured()
         self.supervisor.raise_if_failed()
 
     def shutdown(self):
@@ -589,6 +605,9 @@ class Block(BlockScope):
         self._thread = None
         self._hb_time = None
         self._hb_gulps = 0
+        #: per-block latency histograms, created on first gulp
+        self._h_gulp = None
+        self._h_wait = None
         self.bind_proclog = ProcLog(self.name + '/bind')
         self.in_proclog = ProcLog(self.name + '/in')
         rnames = {'nring': len(self.irings)}
@@ -605,6 +624,37 @@ class Block(BlockScope):
         per gulp via _sync_gulp and at sequence boundaries)."""
         self._hb_time = time.monotonic()
         self._hb_gulps += 1
+
+    # -- observability (docs/observability.md) ----------------------------
+    def _compute_span(self, seq, gulp):
+        """Gulp-identity compute span: every gulp is traceable across
+        blocks by its (sequence, gulp_index) args in the Chrome trace /
+        flight recorder.  Free when span recording is off."""
+        if _spans.enabled():
+            return _spans.span(self.name + '.on_data', 'compute',
+                               seq=seq, gulp=gulp)
+        return nullcontext()
+
+    def _observe_gulp(self, acquire, reserve, process):
+        """Record this gulp into the block's latency histograms
+        (``block.<name>.gulp_s`` wall time, ``block.<name>.ring_wait_s``
+        flow-control time)."""
+        if self._h_gulp is None:
+            self._h_gulp = _histograms.get_or_create(
+                'block.%s.gulp_s' % self.name, unit='s')
+            self._h_wait = _histograms.get_or_create(
+                'block.%s.ring_wait_s' % self.name, unit='s')
+        self._h_gulp.record(acquire + reserve + process)
+        self._h_wait.record(acquire + reserve)
+
+    def _perf_stats(self):
+        """Percentile columns for the perf proclog (rendered by
+        tools/like_top.py)."""
+        if self._h_gulp is None:
+            return {}
+        return {'gulp_p50': round(self._h_gulp.percentile(50), 6),
+                'gulp_p99': round(self._h_gulp.percentile(99), 6),
+                'ring_wait_p99': round(self._h_wait.percentile(99), 6)}
 
     def create_ring(self, *args, **kwargs):
         return Ring(*args, owner=self, **kwargs)
@@ -916,6 +966,8 @@ class SourceBlock(Block):
                 ohdr.setdefault('name',
                                 'unnamed-sequence-%i' % self._seq_count)
             self._seq_count += 1
+            seq_id = self._seq_count - 1
+            gulp_index = 0
             with ExitStack() as oseq_stack:
                 oseqs, ogulp_overlaps = self.begin_sequences(
                     oseq_stack, orings, oheaders,
@@ -926,16 +978,25 @@ class SourceBlock(Block):
                         ospans = self.reserve_spans(ospan_stack, oseqs)
                         t1 = time.time()
                         faults.fire('block.on_data', self.name)
-                        ostrides = self.on_data(ireader, ospans)
+                        with self._compute_span(seq_id, gulp_index):
+                            ostrides = self.on_data(ireader, ospans)
                         self._sync_gulp(ospans)
                         self.commit_spans(ospans, ostrides,
                                           ogulp_overlaps)
                         if any(o == 0 for o in ostrides):
                             break
                     t2 = time.time()
-                    self.perf_proclog.update({'acquire_time': -1,
-                                              'reserve_time': t1 - t0,
-                                              'process_time': t2 - t1})
+                    gulp_index += 1
+                    self._observe_gulp(0.0, t1 - t0, t2 - t1)
+                    perf = {'acquire_time': -1,
+                            'reserve_time': t1 - t0,
+                            'process_time': t2 - t1}
+                    # percentiles only when the rate limiter will
+                    # actually write them (3 bucket walks per gulp
+                    # would otherwise be discarded work)
+                    if self.perf_proclog.ready():
+                        perf.update(self._perf_stats())
+                    self.perf_proclog.update(perf)
 
     def define_output_nframes(self, _):
         return [self.gulp_nframe] * self.num_outputs()
@@ -1020,6 +1081,8 @@ class MultiTransformBlock(Block):
         for ohdr in oheaders:
             ohdr.setdefault('time_tag', self._seq_count)
         self._seq_count += 1
+        seq_id = self._seq_count - 1
+        gulp_index = 0
 
         igulp_nframes = [self.gulp_nframe or iseq.header['gulp_nframe']
                          for iseq in iseqs]
@@ -1096,12 +1159,15 @@ class MultiTransformBlock(Block):
 
                     if not force_skip:
                         faults.fire('block.on_data', self.name)
-                        if _tracing():
-                            with ScopedTracer(self.name + '/on_data'):
+                        with self._compute_span(seq_id, gulp_index):
+                            if _tracing():
+                                with ScopedTracer(self.name +
+                                                  '/on_data'):
+                                    ostrides = self._on_data(ispans,
+                                                             ospans)
+                            else:
                                 ostrides = self._on_data(ispans,
                                                          ospans)
-                        else:
-                            ostrides = self._on_data(ispans, ospans)
                         self._sync_gulp(ospans)
 
                     any_overwritten = any(ispan.nframe_overwritten
@@ -1124,9 +1190,17 @@ class MultiTransformBlock(Block):
                 cur_time = time.time()
                 process_time = cur_time - prev_time
                 prev_time = cur_time
-                self.perf_proclog.update({'acquire_time': acquire_time,
-                                          'reserve_time': reserve_time,
-                                          'process_time': process_time})
+                gulp_index += 1
+                self._observe_gulp(acquire_time, reserve_time,
+                                   process_time)
+                perf = {'acquire_time': acquire_time,
+                        'reserve_time': reserve_time,
+                        'process_time': process_time}
+                # percentiles only when the rate limiter will actually
+                # write them (see SourceBlock._read_source)
+                if self.perf_proclog.ready():
+                    perf.update(self._perf_stats())
+                self.perf_proclog.update(perf)
         self._on_sequence_end(iseqs)
         return True
 

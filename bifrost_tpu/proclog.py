@@ -81,6 +81,17 @@ class ProcLog(object):
         except OSError:
             pass
 
+    def ready(self):
+        """Whether the next (non-forced) :meth:`update` would pass the
+        rate limiter — lets hot loops skip computing expensive
+        contents that update() would drop anyway (e.g. the per-gulp
+        latency percentiles in pipeline.py)."""
+        import time as time_mod
+        if not ProcLog.MIN_INTERVAL:
+            return True
+        return (time_mod.monotonic() - self._last_write >=
+                ProcLog.MIN_INTERVAL)
+
     def update(self, contents, force=False):
         """Write ``key : value`` lines (dict) or a raw string.  Writes
         are rate-limited to MIN_INTERVAL per log unless ``force``."""

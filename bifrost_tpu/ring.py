@@ -42,6 +42,8 @@ from __future__ import annotations
 import json
 import string
 import threading
+import time
+import weakref
 from copy import copy, deepcopy
 from functools import reduce
 
@@ -54,7 +56,31 @@ from .testing import faults
 
 __all__ = ['Ring', 'RingWriter', 'WriteSequence', 'ReadSequence',
            'WriteSpan', 'ReadSpan', 'EndOfDataStop', 'WouldBlock',
-           'RingPoisonedError', 'split_shape', 'ring_view']
+           'RingPoisonedError', 'split_shape', 'ring_view',
+           'live_rings']
+
+#: every constructed Ring (both cores), weakly held — the telemetry
+#: exporter reads point-in-time occupancy from here so
+#: ``telemetry.snapshot()`` works without a pipeline handle
+_live_rings = weakref.WeakSet()
+
+
+def live_rings():
+    """Live Ring objects in this process (weak registry snapshot)."""
+    return list(_live_rings)
+
+
+# observability hooks (telemetry.histograms / telemetry.spans), cached
+# after first use to keep the per-gulp cost to attribute lookups
+_obs = None
+
+
+def _observability():
+    global _obs
+    if _obs is None:
+        from .telemetry import counters, histograms, spans
+        _obs = (counters, histograms, spans)
+    return _obs
 
 _INF = float('inf')
 
@@ -437,6 +463,11 @@ class Ring(object):
         #: set by poison(): the exception that killed the producing /
         #: consuming side; blocking ops then raise RingPoisonedError
         self._poisoned = None
+        #: per-ring wait histograms (telemetry.histograms), created on
+        #: first span so idle rings cost nothing
+        self._h_reserve = None
+        self._h_acquire = None
+        _live_rings.add(self)
 
     # -- views ------------------------------------------------------------
     def view(self):
@@ -697,6 +728,8 @@ class Ring(object):
                 self._nwrite_open -= 1
             self._read_cond.notify_all()
             self._span_cond.notify_all()
+        if commit_nbyte:
+            _observability()[0].inc('ring.%s.gulps' % self.name)
 
     # -- reader side ------------------------------------------------------
     def open_sequence(self, name, guarantee=True):
@@ -1160,8 +1193,19 @@ class WriteSpan(_SpanAPI):
         self._native_id = None
         self._owned = False
         self._fill = None
+        # ring-wait observability: how long the writer was blocked in
+        # flow control (covers BOTH cores — the native reserve happens
+        # inside this call)
+        _, hist, spans_ = _observability()
+        t0 = time.perf_counter()
         self._begin = ring._reserve_span(self._nbyte, nonblocking,
                                          span=self)
+        dt = time.perf_counter() - t0
+        if ring._h_reserve is None:
+            ring._h_reserve = hist.get_or_create(
+                'ring.%s.reserve_s' % ring.name, unit='s')
+        ring._h_reserve.record(dt)
+        spans_.record_elapsed('%s.reserve' % ring.name, 'ring', dt)
         with ring._lock:
             ring._open_wspans.append(self)
             ring._nwrite_open += 1
@@ -1275,8 +1319,19 @@ class ReadSpan(_SpanAPI):
         self._sequence = sequence
         t = sequence.tensor
         fb = t['frame_nbyte']
+        # ring-wait observability: reader blocked-time in flow control
+        # (both cores — the native acquire happens inside this call)
+        _, hist, spans_ = _observability()
+        t0 = time.perf_counter()
         begin, nbyte = self._ring._acquire_span(
             sequence, frame_offset * fb, nframe * fb, fb)
+        dt = time.perf_counter() - t0
+        ring = self._ring
+        if ring._h_acquire is None:
+            ring._h_acquire = hist.get_or_create(
+                'ring.%s.acquire_s' % ring.name, unit='s')
+        ring._h_acquire.record(dt)
+        spans_.record_elapsed('%s.acquire' % ring.name, 'ring', dt)
         self._begin, self._nbyte = begin, nbyte
         self.requested_frame_offset = frame_offset
         self.nframe_skipped = min(self.frame_offset - frame_offset, nframe)

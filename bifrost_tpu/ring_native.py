@@ -17,7 +17,8 @@ import threading
 import numpy as np
 
 from . import native
-from .ring import Ring, EndOfDataStop, WouldBlock, RingPoisonedError
+from .ring import (Ring, EndOfDataStop, WouldBlock, RingPoisonedError,
+                   _observability)
 
 __all__ = ['NativeRing']
 
@@ -325,6 +326,10 @@ class NativeRing(Ring):
             if wspan in self._open_wspans:
                 self._open_wspans.remove(wspan)
                 self._nwrite_open -= 1
+        if commit_nbyte:
+            # same per-ring throughput counter the Python core keeps
+            # (telemetry.exporter derives gulps/s from its deltas)
+            _observability()[0].inc('ring.%s.gulps' % self.name)
 
     # -- reader side ------------------------------------------------------
     def _register_reader(self, rseq):

@@ -31,10 +31,12 @@ The **watchdog** (armed via ``BF_WATCHDOG_SECS`` or
 ``Pipeline(watchdog_secs=...)``) monitors per-block heartbeats (gulps
 through ``Block._sync_gulp`` plus sequence boundaries); when NO live
 block has made progress for the configured window it dumps every
-thread's stack and every ring's occupancy to stderr and the
-``pipeline/watchdog`` proclog, increments the ``watchdog_stalls``
-counter, and — with ``BF_WATCHDOG_ESCALATE=1`` — aborts the pipeline
-with :class:`PipelineStallError`.
+thread's stack, every ring's occupancy, and the span flight recorder's
+recent-event timeline (``telemetry.spans`` — arming the watchdog turns
+the recorder on) to stderr and the ``pipeline/watchdog`` proclog,
+increments the ``watchdog_stalls`` counter, and — with
+``BF_WATCHDOG_ESCALATE=1`` — aborts the pipeline with
+:class:`PipelineStallError`.
 
 All of it is testable on CPU through the deterministic fault harness in
 :mod:`bifrost_tpu.testing.faults` (see tests/test_supervision.py).
@@ -308,6 +310,12 @@ class Supervisor(object):
         if not secs or secs <= 0:
             return None
         escalate = os.environ.get('BF_WATCHDOG_ESCALATE', '0') == '1'
+        # an armed watchdog turns on the span flight recorder (even
+        # without BF_TRACE_FILE): a stall report then carries the
+        # timeline of what was happening BEFORE everything stopped,
+        # not just where each thread is parked now
+        from .telemetry import spans
+        spans.enable_flight_recorder()
         self._watchdog = _Watchdog(self, float(secs), escalate)
         self._watchdog.start()
         return self._watchdog
@@ -316,6 +324,10 @@ class Supervisor(object):
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+            # release this run's flight-recorder hold (refcounted, so
+            # a concurrently armed pipeline keeps recording)
+            from .telemetry import spans
+            spans.disable_flight_recorder()
 
 
 class _Watchdog(threading.Thread):
@@ -388,6 +400,11 @@ class _Watchdog(threading.Thread):
         for name, occ in sorted(rings.items()):
             lines.append('  ring  %-40s %r' % (name, occ))
         lines.append(stacks)
+        try:
+            from .telemetry import spans
+            lines.append(spans.flight_record())
+        except Exception as exc:
+            lines.append('(flight recorder unavailable: %r)' % exc)
         lines.append('=== end watchdog dump ===')
         sys.stderr.write('\n'.join(lines) + '\n')
         try:

@@ -30,11 +30,14 @@ from functools import wraps
 from threading import RLock
 
 from . import counters  # noqa: F401  (always-on perf counters)
+from . import histograms  # noqa: F401  (log2 latency/size histograms)
+from . import spans  # noqa: F401  (gulp-span tracing / flight recorder)
 
-__all__ = ['is_active', 'enable', 'disable', 'flush', 'track_script',
-           'track_module', 'track_function', 'track_function_timed',
-           'track_method', 'track_method_timed', 'usage_path',
-           'counters']
+__all__ = ['is_active', 'enable', 'disable', 'flush', 'snapshot',
+           'track_script', 'track_module', 'track_function',
+           'track_function_timed', 'track_method',
+           'track_method_timed', 'usage_path', 'counters',
+           'histograms', 'spans']
 
 MAX_ENTRIES = 100     # flush the in-memory cache after this many names
 
@@ -266,6 +269,15 @@ def track_method_timed(method):
                       time.perf_counter() - t0)
         return result
     return wrapper
+
+
+def snapshot(pipeline=None):
+    """Unified metrics snapshot: flat counters + histograms + live
+    ring occupancy, merged into one plain dict (see
+    :func:`bifrost_tpu.telemetry.exporter.snapshot`).  ``pipeline``
+    narrows the ring section to one pipeline's rings."""
+    from . import exporter
+    return exporter.snapshot(pipeline)
 
 
 #: robustness counters mirrored into the usage aggregates by flush()

@@ -1,11 +1,17 @@
 """CLI: toggle/inspect LOCAL usage aggregation (reference:
 python/bifrost/telemetry/__main__.py — minus the install key, which
-this build never generates; nothing is ever transmitted)."""
+this build never generates; nothing is ever transmitted).
+
+``--status`` also prints the LIVE in-process metrics snapshot (flat
+counters + histogram percentiles, :func:`bifrost_tpu.telemetry
+.snapshot`) — mostly useful when this module is invoked from inside a
+pipeline process (scripts, notebooks); a fresh CLI process shows the
+section empty."""
 
 import argparse
 import json
 
-from . import disable, enable, is_active, usage_path
+from . import disable, enable, is_active, snapshot, usage_path
 
 parser = argparse.ArgumentParser(
     description='update the bifrost_tpu LOCAL telemetry setting '
@@ -43,3 +49,17 @@ if args.status:
         if nt:
             line += "  %.3fs total" % total
         print(line)
+
+    snap = snapshot()
+    print("\nlive process counters:")
+    if not snap['counters']:
+        print("  (none this process)")
+    for name in sorted(snap['counters']):
+        print("  %-60s %12d" % (name, snap['counters'][name]))
+    print("live process histograms (count / p50 / p99):")
+    if not snap['histograms']:
+        print("  (none this process)")
+    for name in sorted(snap['histograms']):
+        h = snap['histograms'][name]
+        print("  %-60s %8d  %g / %g" % (name, h['count'],
+                                        h['p50'], h['p99']))

@@ -42,6 +42,14 @@ by :func:`bifrost_tpu.telemetry.flush`):
 - ``io.socket_retries``                    transient socket errors
                                            (EINTR/ECONNREFUSED) retried
                                            with backoff
+
+Observability counters (docs/observability.md; complemented by
+:mod:`bifrost_tpu.telemetry.histograms` for distributions):
+
+- ``ring.<name>.gulps``                    spans committed through ring
+                                           ``<name>`` (both cores) —
+                                           the exporter derives per-ring
+                                           gulps/s from its deltas
 """
 
 from __future__ import annotations

@@ -1,0 +1,239 @@
+"""Unified metrics snapshot + export surface.
+
+One call — :func:`snapshot` — merges the three live metric sources
+(the flat :mod:`~bifrost_tpu.telemetry.counters`, the log2
+:mod:`~bifrost_tpu.telemetry.histograms`, and point-in-time ring
+occupancy) into a plain dict, and two exporters publish it:
+
+- **ProcLog** — :class:`MetricsPublisher` (started by
+  ``Pipeline.run``) periodically writes ``telemetry/metrics`` (flat
+  counters + histogram percentiles) and per-ring ``rings_flow/<name>``
+  entries (occupancy %, cumulative gulps, gulps/s, wait percentiles),
+  which ``tools/pipeline2dot.py`` uses to label ring edges as a
+  bottleneck map and ``tools/like_top.py`` complements with the
+  per-block p50/p99 columns the blocks publish themselves.
+
+- **Prometheus textfile** — ``BF_METRICS_FILE=/path/metrics.prom``
+  makes the publisher (and the final flush at pipeline exit) write the
+  snapshot in Prometheus text exposition format for a node-exporter
+  textfile collector or any scraper that reads files.  Counters become
+  ``bifrost_tpu_counter_total{name=...}``, histograms become real
+  Prometheus histograms (cumulative ``_bucket{le=...}`` / ``_sum`` /
+  ``_count``), ring occupancy becomes a gauge.
+
+``BF_METRICS_INTERVAL`` sets the publish period (seconds, default 5).
+Everything here is read-only over the live metric state; a publisher
+failure never propagates into the pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import counters, histograms
+
+__all__ = ['snapshot', 'write_prometheus', 'prometheus_text',
+           'MetricsPublisher']
+
+DEFAULT_INTERVAL = 5.0
+
+
+def _ring_occupancy(pipeline=None):
+    """{ring_name: occupancy dict (+ 'fill' fraction)} — from the
+    pipeline's rings when given, else from the process-wide live-ring
+    registry (ring.live_rings)."""
+    if pipeline is not None:
+        from ..supervision import ring_occupancies
+        occ = ring_occupancies(pipeline)
+    else:
+        from ..ring import live_rings
+        occ = {}
+        for r in live_rings():
+            try:
+                occ[r.name] = r.occupancy()
+            except Exception:
+                pass
+    out = {}
+    for name, d in occ.items():
+        d = dict(d)
+        size = d.get('size') or 0
+        if size and 'head' in d and 'tail' in d:
+            frac = (d['head'] - d['tail']) / float(size)
+            d['fill'] = max(0.0, min(1.0, frac))
+        out[name] = d
+    return out
+
+
+def snapshot(pipeline=None):
+    """The unified metrics snapshot::
+
+        {'counters':   {name: int},
+         'histograms': {name: {count,sum,min,max,p50,p90,p99,buckets}},
+         'rings':      {name: {tail,head,size,...,fill}}}
+
+    ``pipeline`` narrows the ring section to one pipeline's rings;
+    without it every live ring in the process is reported.
+    """
+    return {
+        'counters': counters.snapshot(),
+        'histograms': histograms.snapshot(),
+        'rings': _ring_occupancy(pipeline),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Prometheus textfile export
+# ---------------------------------------------------------------------------
+
+def _esc(value):
+    return str(value).replace('\\', r'\\').replace('"', r'\"') \
+                     .replace('\n', r'\n')
+
+
+def prometheus_text(snap=None):
+    """Render a snapshot in Prometheus text exposition format."""
+    if snap is None:
+        snap = snapshot()
+    lines = ['# bifrost_tpu metrics (telemetry.exporter)']
+    lines.append('# TYPE bifrost_tpu_counter_total counter')
+    for name in sorted(snap.get('counters', {})):
+        lines.append('bifrost_tpu_counter_total{name="%s"} %d'
+                     % (_esc(name), snap['counters'][name]))
+    hists = snap.get('histograms', {})
+    if hists:
+        lines.append('# TYPE bifrost_tpu_hist histogram')
+    for name in sorted(hists):
+        h = hists[name]
+        label = _esc(name)
+        cum = 0
+        for exp in sorted(h.get('buckets', {})):
+            cum += h['buckets'][exp]
+            lines.append('bifrost_tpu_hist_bucket{name="%s",le="%g"} %d'
+                         % (label, 2.0 ** exp, cum))
+        lines.append('bifrost_tpu_hist_bucket{name="%s",le="+Inf"} %d'
+                     % (label, h['count']))
+        lines.append('bifrost_tpu_hist_sum{name="%s"} %g'
+                     % (label, h['sum']))
+        lines.append('bifrost_tpu_hist_count{name="%s"} %d'
+                     % (label, h['count']))
+    rings = snap.get('rings', {})
+    if rings:
+        lines.append('# TYPE bifrost_tpu_ring_fill_ratio gauge')
+        lines.append('# TYPE bifrost_tpu_ring_bytes gauge')
+    for name in sorted(rings):
+        d = rings[name]
+        label = _esc(name)
+        if 'fill' in d:
+            lines.append('bifrost_tpu_ring_fill_ratio{ring="%s"} %g'
+                         % (label, d['fill']))
+        for key in ('tail', 'head', 'size'):
+            if key in d:
+                lines.append('bifrost_tpu_ring_bytes{ring="%s",'
+                             'kind="%s"} %d' % (label, key, d[key]))
+    return '\n'.join(lines) + '\n'
+
+
+def write_prometheus(path, snap=None):
+    """Atomically write the snapshot as a Prometheus textfile."""
+    text = prometheus_text(snap)
+    # pid AND thread ident: concurrent pipelines each run their own
+    # publisher thread against the same BF_METRICS_FILE
+    tmp = '%s.tmp%d.%d' % (path, os.getpid(),
+                           threading.get_ident())
+    with open(tmp, 'w') as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# periodic publisher (ProcLog + Prometheus)
+# ---------------------------------------------------------------------------
+
+class MetricsPublisher(threading.Thread):
+    """Daemon thread publishing the unified snapshot periodically:
+    ``telemetry/metrics`` + ``rings_flow/<name>`` ProcLogs always, the
+    ``BF_METRICS_FILE`` Prometheus textfile when configured.  A final
+    publish runs on :meth:`stop` so short pipelines still leave a
+    complete last snapshot behind."""
+
+    def __init__(self, pipeline=None, interval=None):
+        super(MetricsPublisher, self).__init__(
+            name='bf-metrics', daemon=True)
+        if interval is None:
+            try:
+                interval = float(os.environ.get('BF_METRICS_INTERVAL',
+                                                '') or DEFAULT_INTERVAL)
+            except ValueError:
+                interval = DEFAULT_INTERVAL
+        self.interval = max(float(interval), 0.1)
+        self.pipeline = pipeline
+        self._stop_event = threading.Event()
+        self._proclogs = {}
+        self._last_gulps = {}
+        self._last_time = None
+
+    def stop(self, wait=True):
+        """Stop the loop; publishes one final snapshot first."""
+        self._stop_event.set()
+        if wait and self.is_alive():
+            self.join(self.interval + 2.0)
+
+    def run(self):
+        while not self._stop_event.wait(self.interval):
+            self.publish()
+        self.publish()               # final snapshot at shutdown
+
+    # -- publishing --------------------------------------------------------
+    def _proclog(self, name):
+        log = self._proclogs.get(name)
+        if log is None:
+            from ..proclog import ProcLog
+            log = self._proclogs[name] = ProcLog(name)
+        return log
+
+    def publish(self):
+        try:
+            snap = snapshot(self.pipeline)
+            self._publish_proclog(snap)
+            path = os.environ.get('BF_METRICS_FILE')
+            if path:
+                write_prometheus(path, snap)
+        except Exception:
+            pass                     # never take the pipeline down
+
+    def _publish_proclog(self, snap):
+        flat = {}
+        for name, value in sorted(snap['counters'].items()):
+            flat['c.' + name] = value
+        for name, h in sorted(snap['histograms'].items()):
+            flat['h.%s.count' % name] = h['count']
+            flat['h.%s.p50' % name] = '%g' % h['p50']
+            flat['h.%s.p99' % name] = '%g' % h['p99']
+        self._proclog('telemetry/metrics').update(flat, force=True)
+
+        now = time.monotonic()
+        dt = (now - self._last_time) if self._last_time else None
+        self._last_time = now
+        hists = snap['histograms']
+        for name, d in sorted(snap['rings'].items()):
+            gulps = snap['counters'].get('ring.%s.gulps' % name, 0)
+            rate = 0.0
+            if dt and dt > 0:
+                rate = max(gulps - self._last_gulps.get(name, 0), 0) / dt
+            self._last_gulps[name] = gulps
+            entry = {
+                'occupancy_pct': round(100.0 * d.get('fill', 0.0), 1),
+                'gulps': gulps,
+                'gulps_per_s': round(rate, 3),
+                'poisoned': int(bool(d.get('poisoned'))),
+            }
+            for kind in ('reserve', 'acquire'):
+                h = hists.get('ring.%s.%s_s' % (name, kind))
+                if h and h['count']:
+                    entry['%s_wait_p99_ms' % kind] = \
+                        round(h['p99'] * 1e3, 3)
+            self._proclog('rings_flow/%s' % name).update(entry,
+                                                         force=True)

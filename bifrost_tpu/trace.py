@@ -14,7 +14,7 @@ import os
 import time
 from contextlib import contextmanager
 
-__all__ = ['tracing_enabled', 'ScopedTracer', 'trace_scope',
+__all__ = ['tracing_enabled', 'reset', 'ScopedTracer', 'trace_scope',
            'start_profile', 'stop_profile']
 
 _enabled = None
@@ -25,6 +25,22 @@ def tracing_enabled():
     if _enabled is None:
         _enabled = bool(int(os.environ.get('BF_TRACE', '0') or 0))
     return _enabled
+
+
+def reset():
+    """Forget the cached ``BF_TRACE`` state so the next
+    :func:`tracing_enabled` re-reads the environment, and re-read the
+    gulp-span configuration (``BF_TRACE_FILE`` / ``BF_SPAN_BUFFER`` —
+    :mod:`bifrost_tpu.telemetry.spans`).  Lets tests and long-lived
+    operator processes toggle tracing without a restart; ``Pipeline.run``
+    re-reads the span config on every run anyway."""
+    global _enabled
+    _enabled = None
+    try:
+        from .telemetry import spans
+        spans.reconfigure()
+    except Exception:
+        pass
 
 
 class ScopedTracer(object):

@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import weakref
 from collections import deque
 
@@ -94,6 +95,19 @@ def _split(arr):
 def _counters():
     from .telemetry import counters
     return counters
+
+
+_obs_mods = None
+
+
+def _obs():
+    """(histograms, spans) — transfer-time/size observability, cached
+    after first import (docs/observability.md)."""
+    global _obs_mods
+    if _obs_mods is None:
+        from .telemetry import histograms, spans
+        _obs_mods = (histograms, spans)
+    return _obs_mods
 
 
 def _env_int(name, default):
@@ -303,7 +317,7 @@ class TransferFuture(object):
     """
 
     __slots__ = ('_arrays', '_convert', '_done', '_result', '_error',
-                 '_lock')
+                 '_lock', '_nbytes')
 
     def __init__(self, arrays, convert, result=None, done=False):
         self._arrays = list(arrays)
@@ -312,6 +326,8 @@ class TransferFuture(object):
         self._result = result
         self._error = None
         self._lock = threading.Lock()
+        self._nbytes = sum(int(getattr(a, 'nbytes', 0) or 0)
+                           for a in self._arrays)
 
     def ready(self):
         if self._done:
@@ -330,6 +346,8 @@ class TransferFuture(object):
                 if self._error is not None:
                     raise self._error
                 return self._result
+            hist, spans = _obs()
+            t0 = time.perf_counter()
             try:
                 faults.fire('xfer.result')
                 if not all(a.is_deleted() or a.is_ready()
@@ -343,6 +361,12 @@ class TransferFuture(object):
                 self._arrays = []
                 _counters().inc('xfer.errors')
                 raise
+            # D2H completion time as seen by the host (residual wait on
+            # the in-flight remainder + conversion)
+            dt = time.perf_counter() - t0
+            hist.observe('xfer.d2h_wait_s', dt)
+            spans.record_elapsed('d2h', 'xfer', dt,
+                                 bytes=self._nbytes)
             self._done = True
             self._arrays = []      # drop device refs promptly
             return self._result
@@ -526,6 +550,8 @@ class TransferEngine(object):
             from .device import get_bound_device
             device = get_bound_device()
         arr = np.asarray(arr)
+        hist, spans = _obs()
+        t0 = time.perf_counter()
         if np.iscomplexobj(arr):
             ft = np.float64 if arr.dtype == np.complex128 else np.float32
             # plane extraction copies into fresh buffers the caller
@@ -535,8 +561,16 @@ class TransferEngine(object):
             c = _counters()
             c.inc('xfer.h2d_issued')
             c.inc('xfer.h2d_bytes', int(arr.nbytes))
-            return _combine(self._put(re, device), self._put(im, device))
-        return self._stage_real(arr, device)
+            out = _combine(self._put(re, device), self._put(im, device))
+        else:
+            out = self._stage_real(arr, device)
+        # host-side transfer time (staging copy + async device_put
+        # issue) and transfer-size distribution
+        dt = time.perf_counter() - t0
+        hist.observe('xfer.h2d_s', dt)
+        hist.observe('xfer.h2d_nbytes', int(arr.nbytes))
+        spans.record_elapsed('h2d', 'xfer', dt, bytes=int(arr.nbytes))
+        return out
 
     def prefetch(self, arr, device=None):
         """Issue the H2D transfer for ``arr`` now and return the device
@@ -568,6 +602,8 @@ class TransferEngine(object):
         c = _counters()
         c.inc('xfer.d2h_issued')
         c.inc('xfer.d2h_bytes', int(getattr(arr, 'nbytes', 0) or 0))
+        _obs()[0].observe('xfer.d2h_nbytes',
+                          int(getattr(arr, 'nbytes', 0) or 0))
         if isinstance(arr, jax.Array) and \
                 jnp.issubdtype(arr.dtype, jnp.complexfloating):
             re, im = _split(arr)

@@ -1,0 +1,419 @@
+"""Observability-layer tests (docs/observability.md): gulp-span
+tracing with Chrome trace export, log2 latency histograms, the unified
+snapshot / Prometheus export surface, and the watchdog flight
+recorder — all on the CPU backend, driven where useful by the
+deterministic fault harness (bifrost_tpu.testing.faults)."""
+
+import contextlib
+import io
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu import proclog, trace
+from bifrost_tpu.supervision import PipelineStallError
+from bifrost_tpu.telemetry import (counters, exporter, histograms,
+                                   spans)
+from bifrost_tpu.testing import faults
+from tests.util import NumpySourceBlock, GatherSink, simple_header
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, 'tools')
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    faults.clear()
+    counters.reset()
+    histograms.reset()
+    spans.reset()
+    yield
+    faults.clear()
+    counters.reset()
+    histograms.reset()
+    spans.reset()
+
+
+def _hdr():
+    return simple_header([-1, 3], 'f32')
+
+
+def _gulps(n=5):
+    return [np.full((4, 3), float(k), dtype=np.float32)
+            for k in range(n)]
+
+
+class Ident(bf.TransformBlock):
+    """Pass-through host transform with a distinctive name."""
+
+    def on_sequence(self, iseq):
+        return dict(iseq.header)
+
+    def on_data(self, ispan, ospan):
+        ospan.data.as_numpy()[...] = ispan.data.as_numpy()
+
+
+def _run_simple_pipeline(ngulp=5, device_hop=False, **pipe_kwargs):
+    with bf.Pipeline(**pipe_kwargs) as p:
+        src = NumpySourceBlock(_gulps(ngulp), _hdr(), gulp_nframe=4)
+        if device_hop:
+            up = bf.blocks.copy(src, space='tpu')
+            down = bf.blocks.copy(up, space='system')
+            sink = GatherSink(down)
+        else:
+            blk = Ident(src)
+            sink = GatherSink(blk)
+        p.run()
+    return p, sink
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+def test_histogram_basic_stats():
+    h = histograms.Histogram('t.basic')
+    for v in (0.001, 0.002, 0.004, 0.008, 0.016):
+        h.record(v)
+    snap = h.snapshot()
+    assert snap['count'] == 5
+    assert snap['sum'] == pytest.approx(0.031)
+    assert snap['min'] == pytest.approx(0.001)
+    assert snap['max'] == pytest.approx(0.016)
+    # five distinct powers of two -> five distinct buckets
+    assert len(snap['buckets']) == 5
+    assert sum(snap['buckets'].values()) == 5
+
+
+def test_histogram_percentiles_monotonic():
+    rng = np.random.RandomState(7)
+    h = histograms.get_or_create('t.mono')
+    for v in np.exp(rng.randn(500) * 2.0 - 6.0):
+        h.record(float(v))
+    last = 0.0
+    for p in range(1, 101):
+        cur = h.percentile(p)
+        assert cur >= last, 'p%d < p%d' % (p, p - 1)
+        last = cur
+    snap = h.snapshot()
+    assert snap['p50'] <= snap['p90'] <= snap['p99']
+    # estimates stay inside the observed range
+    assert snap['min'] <= snap['p50'] <= snap['max']
+    assert snap['min'] <= snap['p99'] <= snap['max']
+
+
+def test_histogram_edge_values():
+    h = histograms.Histogram('t.edge')
+    assert h.percentile(99) == 0.0        # empty
+    h.record(0.0)
+    h.record(-1.0)                        # clamps to 0
+    h.record(float('nan'))                # clamps to 0
+    h.record(1e30)                        # clamps to top bucket
+    snap = h.snapshot()
+    assert snap['count'] == 4
+    assert snap['min'] == 0.0 and snap['max'] == 1e30
+
+
+def test_histogram_registry_observe_and_reset():
+    histograms.observe('t.reg', 0.5)
+    histograms.observe('t.reg', 0.5)
+    assert histograms.get('t.reg').count == 2
+    assert 't.reg' in histograms.snapshot()
+    histograms.reset()
+    assert histograms.get('t.reg') is None
+
+
+# ---------------------------------------------------------------------------
+# gulp-span tracing / Chrome trace export
+# ---------------------------------------------------------------------------
+
+def test_trace_file_has_complete_spans_per_gulp(monkeypatch, tmp_path):
+    """The acceptance-criterion run: BF_TRACE_FILE set, a CPU pipeline
+    with a device hop produces a valid Chrome trace with block-compute,
+    ring-wait, and transfer spans, one complete compute span per
+    gulp with (sequence, gulp) identity."""
+    path = tmp_path / 'trace.json'
+    monkeypatch.setenv('BF_TRACE_FILE', str(path))
+    trace.reset()                      # satellite: re-read env
+    ngulp = 5
+    _run_simple_pipeline(ngulp=ngulp, device_hop=True)
+
+    data = json.loads(path.read_text())
+    evs = [e for e in data['traceEvents'] if e.get('ph') == 'X']
+    assert evs, 'no complete events exported'
+    for e in evs:
+        assert 'ts' in e and 'dur' in e and e['dur'] >= 0
+
+    # block-compute spans carry per-gulp identity
+    copies = [e for e in evs
+              if 'CopyBlock' in e['name'] and e['cat'] == 'compute']
+    by_block = {}
+    for e in copies:
+        by_block.setdefault(e['name'], []).append(e)
+    assert len(by_block) == 2          # both copy blocks traced
+    for name, block_evs in by_block.items():
+        idents = sorted((e['args']['seq'], e['args']['gulp'])
+                        for e in block_evs)
+        assert idents == [(0, g) for g in range(ngulp)], \
+            '%s: %r' % (name, idents)
+
+    # ring-wait spans from the flow-control seam
+    ring_evs = [e for e in evs if e['cat'] == 'ring']
+    assert any(e['name'].endswith('.reserve') for e in ring_evs)
+    assert any(e['name'].endswith('.acquire') for e in ring_evs)
+    # transfer spans from the device hop
+    xfer_names = {e['name'] for e in evs if e['cat'] == 'xfer'}
+    assert 'h2d' in xfer_names and 'd2h' in xfer_names
+    # thread tracks are labeled with block names
+    meta = [e for e in data['traceEvents']
+            if e.get('ph') == 'M' and e.get('name') == 'thread_name']
+    tnames = {e['args']['name'] for e in meta}
+    assert any('CopyBlock' in t for t in tnames)
+
+
+def test_spans_nest_and_close_under_faults(monkeypatch, tmp_path):
+    monkeypatch.setenv('BF_TRACE_FILE', str(tmp_path / 't.json'))
+    spans.reconfigure()
+    with faults.injected('xfer.h2d', count=1):
+        with pytest.raises(faults.FaultInjected):
+            with spans.span('outer', 'test', k=1):
+                with spans.span('inner', 'test'):
+                    faults.fire('xfer.h2d')
+    evs = [ev for _t, ev in spans.events() if ev[1] == 'test']
+    assert [ev[0] for ev in evs] == ['inner', 'outer']  # close order
+    (iname, _c, its, idur, _a), (oname, _c2, ots, odur, oargs) = evs
+    # inner nests inside outer despite the exception exit
+    assert ots <= its
+    assert its + idur <= ots + odur + 1.0   # 1us slack
+    assert oargs == {'k': 1}
+
+
+def test_trace_exported_even_when_pipeline_aborts(monkeypatch,
+                                                  tmp_path):
+    path = tmp_path / 'abort.json'
+    monkeypatch.setenv('BF_TRACE_FILE', str(path))
+    with faults.injected('block.on_data', match='Ident', after=2,
+                         count=1):
+        with pytest.raises(Exception):
+            _run_simple_pipeline(ngulp=5)
+    data = json.loads(path.read_text())
+    idents = [e for e in data['traceEvents']
+              if e.get('ph') == 'X' and 'Ident' in e['name']
+              and e.get('cat') == 'compute']
+    # gulps 0 and 1 completed; the faulted gulp raised BEFORE its
+    # compute span opened (the fault seam precedes dispatch), so
+    # exactly the completed gulps are traced
+    assert len(idents) == 2
+
+
+def test_span_buffer_env_bounds_events(monkeypatch, tmp_path):
+    monkeypatch.setenv('BF_TRACE_FILE', str(tmp_path / 'b.json'))
+    monkeypatch.setenv('BF_SPAN_BUFFER', '16')
+    spans.reconfigure()
+    for i in range(100):
+        spans.record('ev%d' % i, 'test', float(i), 1.0)
+    mine = [ev for _t, ev in spans.events() if ev[1] == 'test']
+    assert len(mine) == 16
+    assert mine[0][0] == 'ev84'        # ring kept the newest
+    monkeypatch.delenv('BF_SPAN_BUFFER')
+    spans.reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# unified snapshot + exporters
+# ---------------------------------------------------------------------------
+
+def test_snapshot_merges_counters_histograms_rings():
+    p, sink = _run_simple_pipeline(ngulp=5)
+    snap = bf.telemetry.snapshot()
+    assert set(snap) == {'counters', 'histograms', 'rings'}
+    assert snap['counters'].get('pipeline.gulps', 0) > 0
+    assert any(k.startswith('block.') and k.endswith('.gulp_s')
+               for k in snap['histograms'])
+    assert any(k.startswith('ring.') and k.endswith('.reserve_s')
+               for k in snap['histograms'])
+    assert snap['rings'], 'live ring occupancy missing'
+    for occ in snap['rings'].values():
+        if 'fill' in occ:
+            assert 0.0 <= occ['fill'] <= 1.0
+    # per-ring throughput counters feed the gulps/s rate
+    assert any(k.startswith('ring.') and k.endswith('.gulps')
+               for k in snap['counters'])
+
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+0-9.eE]+$|'
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \+Inf$')
+
+
+def test_prometheus_file_written_and_parses(monkeypatch, tmp_path):
+    prom = tmp_path / 'metrics.prom'
+    monkeypatch.setenv('BF_METRICS_FILE', str(prom))
+    _run_simple_pipeline(ngulp=5)
+    text = prom.read_text()
+    assert text.endswith('\n')
+    for line in text.splitlines():
+        if not line or line.startswith('#'):
+            continue
+        assert _PROM_LINE.match(line), 'unparseable line: %r' % line
+    # histogram buckets are cumulative and capped by _count
+    counts = {}
+    buckets = {}
+    for line in text.splitlines():
+        m = re.match(r'bifrost_tpu_hist_count\{name="([^"]+)"\} (\d+)',
+                     line)
+        if m:
+            counts[m.group(1)] = int(m.group(2))
+        m = re.match(r'bifrost_tpu_hist_bucket\{name="([^"]+)",'
+                     r'le="([^"]+)"\} (\d+)', line)
+        if m:
+            buckets.setdefault(m.group(1), []).append(
+                (m.group(2), int(m.group(3))))
+    assert counts and buckets
+    for name, bs in buckets.items():
+        cum = [n for _le, n in bs]
+        assert cum == sorted(cum), '%s buckets not cumulative' % name
+        assert bs[-1][0] == '+Inf'
+        assert bs[-1][1] == counts[name]
+    assert 'bifrost_tpu_counter_total{name="pipeline.gulps"}' in text
+    assert 'bifrost_tpu_ring_fill_ratio' in text
+
+
+def test_proclog_metrics_and_rings_flow_published():
+    p, _sink = _run_simple_pipeline(ngulp=5)
+    contents = proclog.load_by_pid(os.getpid())
+    metrics = contents.get('telemetry', {}).get('metrics', {})
+    assert any(k.startswith('c.pipeline.gulps') for k in metrics)
+    assert any(k.startswith('h.block.') and k.endswith('.p99')
+               for k in metrics)
+    flow = {}
+    for block, logs in contents.items():
+        if block.replace(os.sep, '/').startswith('rings_flow'):
+            flow.update(logs)
+    assert flow, 'no rings_flow/<name> proclogs published'
+    entry = next(iter(flow.values()))
+    assert 'occupancy_pct' in entry
+    assert 'gulps' in entry and 'gulps_per_s' in entry
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + watchdog integration
+# ---------------------------------------------------------------------------
+
+def test_watchdog_dump_includes_flight_recorder(monkeypatch):
+    """A forced stall dumps the span timeline alongside the thread
+    stacks (the PR's acceptance criterion)."""
+    monkeypatch.setenv('BF_WATCHDOG_ESCALATE', '1')
+    stderr = io.StringIO()
+    with faults.injected('block.on_data', match='Ident', count=1,
+                         after=1, delay=10, exc=None):
+        with bf.Pipeline(watchdog_secs=0.5) as p:
+            p.shutdown_timeout = 1.0
+            src = NumpySourceBlock(_gulps(50), _hdr(), gulp_nframe=4)
+            blk = Ident(src)
+            GatherSink(blk)
+            box = []
+
+            def target():
+                try:
+                    with contextlib.redirect_stderr(stderr):
+                        p.run()
+                    box.append(None)
+                except BaseException as exc:
+                    box.append(exc)
+
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            t.join(20)
+            assert not t.is_alive()
+    assert isinstance(box[0], PipelineStallError)
+    dump = stderr.getvalue()
+    assert 'Thread' in dump                  # stacks, as before
+    assert 'flight recorder' in dump         # plus the timeline
+    # the recorder shows spans leading up to the stall (gulp 0 made it
+    # through before the delay fault wedged gulp 1)
+    assert '.on_data' in dump or '.reserve' in dump
+
+
+def test_flight_record_formats_empty_state():
+    spans.reset()
+    text = spans.flight_record()
+    assert 'no spans recorded' in text
+
+
+# ---------------------------------------------------------------------------
+# satellites: trace.reset, CLI status, tool columns/labels
+# ---------------------------------------------------------------------------
+
+def test_trace_reset_rereads_env(monkeypatch):
+    monkeypatch.delenv('BF_TRACE', raising=False)
+    trace.reset()
+    assert not trace.tracing_enabled()
+    monkeypatch.setenv('BF_TRACE', '1')
+    assert not trace.tracing_enabled()       # cached until reset
+    trace.reset()
+    assert trace.tracing_enabled()
+    monkeypatch.delenv('BF_TRACE')
+    trace.reset()
+    assert not trace.tracing_enabled()
+
+
+def test_trace_reset_rereads_span_config(monkeypatch, tmp_path):
+    path = str(tmp_path / 'via_reset.json')
+    monkeypatch.setenv('BF_TRACE_FILE', path)
+    trace.reset()
+    assert spans.trace_file() == path
+    assert spans.enabled()
+    monkeypatch.delenv('BF_TRACE_FILE')
+    trace.reset()
+    assert spans.trace_file() is None
+
+
+def _tool(name, *args):
+    # explicit cwd: tests elsewhere in the suite may chdir away from
+    # the repo root, and the subprocess must still import bifrost_tpu
+    return subprocess.run([sys.executable,
+                           os.path.join(TOOLS, name)] + list(args),
+                          capture_output=True, text=True, cwd=ROOT,
+                          env=dict(os.environ), timeout=120)
+
+
+def test_telemetry_cli_status_prints_live_snapshot(tmp_path):
+    env = dict(os.environ)
+    env['BF_CACHE_DIR'] = str(tmp_path)
+    res = subprocess.run(
+        [sys.executable, '-m', 'bifrost_tpu.telemetry', '--status'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert 'live process counters' in res.stdout
+    assert 'live process histograms' in res.stdout
+
+
+def test_like_top_shows_percentile_columns():
+    _run_simple_pipeline(ngulp=5)
+    res = _tool('like_top.py', '--once')
+    assert res.returncode == 0, res.stderr
+    assert 'p50(ms)' in res.stdout and 'p99(ms)' in res.stdout
+    assert 'Wait99' in res.stdout
+
+
+def test_pipeline2dot_labels_ring_edges_with_flow():
+    _run_simple_pipeline(ngulp=5)
+    res = _tool('pipeline2dot.py', str(os.getpid()))
+    assert res.returncode == 0, res.stderr
+    assert '% full' in res.stdout
+    assert 'gulps' in res.stdout
+
+
+def test_obs_overhead_tool_importable():
+    res = _tool('obs_overhead.py', '--help')
+    assert res.returncode == 0, res.stderr
+    assert '--threshold' in res.stdout

@@ -9,12 +9,16 @@ Panes (matching the reference's information set):
   * optional accelerator memory line (--devices; off by default so a
     dead accelerator tunnel cannot hang the monitor)
   * per-block rows across ALL pipeline PIDs: PID, block, core, %CPU of
-    that core, total/acquire/process/reserve perf times, command line
+    that core, total/acquire/process/reserve perf times, gulp-latency
+    p50/p99 and ring-wait p99 (ms, from the telemetry histograms each
+    block publishes into its perf ProcLog — docs/observability.md),
+    command line
 
 Interactive curses UI with the reference's sort keys (i=pid, b=name,
-c=core, t=total, a=acquire, p=process, r=reserve; pressing the active
-key again reverses; q quits).  ``--once`` prints one plain-text
-snapshot instead (usable in pipes/tests).
+c=core, t=total, a=acquire, p=process, r=reserve, plus l=p99 gulp
+latency and w=p99 ring wait; pressing the active key again reverses;
+q quits).  ``--once`` prints one plain-text snapshot instead (usable
+in pipes/tests).
 """
 
 import argparse
@@ -175,7 +179,11 @@ def collect_blocks(pids=None):
             rows['%d-%s' % (pid, block)] = {
                 'pid': pid, 'name': block, 'cmd': cmd, 'core': core,
                 'acquire': ac, 'process': pr, 'reserve': re,
-                'total': ac + pr + re}
+                'total': ac + pr + re,
+                # latency-histogram columns (seconds; rendered as ms)
+                'p50': max(0.0, _num(perf.get('gulp_p50'))),
+                'p99': max(0.0, _num(perf.get('gulp_p99'))),
+                'wait99': max(0.0, _num(perf.get('ring_wait_p99')))}
     return rows
 
 
@@ -187,7 +195,7 @@ def _num(v):
 
 
 def render_text(load, cpu, mem, dev, rows, sort_key='process',
-                sort_rev=True, width=110):
+                sort_rev=True, width=140):
     """Render the full display as text lines (shared by --once and the
     curses loop)."""
     host = socket.gethostname()
@@ -214,9 +222,9 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
                    % (dev['memTotal'], dev['memUsed'], dev['memFree'],
                       dev['devCount']))
     out.append('')
-    hdr = '%6s  %-24s  %4s  %5s  %8s  %8s  %8s  %8s  Cmd' \
+    hdr = '%6s  %-24s  %4s  %5s  %8s  %8s  %8s  %8s  %8s  %8s  %8s  Cmd' \
         % ('PID', 'Block', 'Core', '%CPU', 'Total', 'Acquire',
-           'Process', 'Reserve')
+           'Process', 'Reserve', 'p50(ms)', 'p99(ms)', 'Wait99')
     out.append(hdr)
     order = sorted(rows, key=lambda k: rows[k][sort_key],
                    reverse=sort_rev)
@@ -227,15 +235,19 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
         except (KeyError, TypeError):
             pct = '%5s' % ' '
         name = d['name'].split('/')[-1][:24]
-        out.append('%6i  %-24s  %4s  %5s  %8.3f  %8.3f  %8.3f  %8.3f  %s'
+        out.append('%6i  %-24s  %4s  %5s  %8.3f  %8.3f  %8.3f  %8.3f'
+                   '  %8.2f  %8.2f  %8.2f  %s'
                    % (d['pid'], name, d['core'], pct, d['total'],
                       d['acquire'], d['process'], d['reserve'],
-                      d['cmd'][:max(width - 96, 0)]))
+                      d['p50'] * 1e3, d['p99'] * 1e3,
+                      d['wait99'] * 1e3,
+                      d['cmd'][:max(width - 126, 0)]))
     return out
 
 
 _SORT_KEYS = {'i': 'pid', 'b': 'name', 'c': 'core', 't': 'total',
-              'a': 'acquire', 'p': 'process', 'r': 'reserve'}
+              'a': 'acquire', 'p': 'process', 'r': 'reserve',
+              'l': 'p99', 'w': 'wait99'}
 
 
 def run_curses(args):

@@ -10,6 +10,11 @@ Annotations matching the reference's information set:
     rings/<name> geometry ProcLogs
   * edge labels with the stream dtype where a sequence ProcLog
     records one
+  * producer->ring edges labeled with occupancy % and gulps/s from the
+    rings_flow/<name> ProcLogs the telemetry exporter publishes
+    (docs/observability.md), so the graph doubles as a bottleneck map
+    (a full ring ahead of a slow block shows up immediately); ring
+    wait p99 is appended when the exporter recorded one
   * dotted bidirectional association edges between blocks bound to the
     same core (reference: pipeline2dot.py:188-219)
 """
@@ -98,9 +103,42 @@ def core_associations(contents):
     return pairs
 
 
+def ring_flow(contents):
+    """rings_flow/<name> ProcLogs -> {ring_name: fields} (published by
+    telemetry.exporter.MetricsPublisher)."""
+    out = {}
+    for block, logs in contents.items():
+        norm = block.replace(os.sep, '/')
+        if norm == 'rings_flow':
+            out.update({k: dict(v) for k, v in logs.items()})
+        elif norm.startswith('rings_flow/'):
+            name = norm.split('/', 1)[1]
+            for fields in logs.values():
+                out[name] = dict(fields)
+    return out
+
+
+def flow_label(flow):
+    """Edge-label text for one ring's flow entry ('' when idle)."""
+    if not flow:
+        return ''
+    parts = []
+    if 'occupancy_pct' in flow:
+        parts.append('%.0f%% full' % float(flow['occupancy_pct']))
+    if flow.get('gulps_per_s'):
+        parts.append('%.1f gulps/s' % float(flow['gulps_per_s']))
+    elif 'gulps' in flow:
+        parts.append('%d gulps' % int(flow['gulps']))
+    wait = flow.get('reserve_wait_p99_ms')
+    if wait:
+        parts.append('p99 wait %.1fms' % float(wait))
+    return '\\n'.join(parts)
+
+
 def to_dot(pid, contents, associations=True):
     flows, sources, sinks = get_data_flows(contents)
     geometry = ring_geometry(contents)
+    ring_flows = ring_flow(contents)
     cmd = get_command_line(pid)
     if cmd.startswith('python'):
         cmd = cmd.split(None, 1)[-1]
@@ -130,7 +168,9 @@ def to_dot(pid, contents, associations=True):
             lines.append('  "ring:%s" -> "%s"%s;' % (r, block, label))
         for r in outs:
             rings.add(r)
-            lines.append('  "%s" -> "ring:%s";' % (block, r))
+            fl = flow_label(ring_flows.get(str(r), {}))
+            flabel = ' [label="%s"]' % fl if fl else ''
+            lines.append('  "%s" -> "ring:%s"%s;' % (block, r, flabel))
     for r in sorted(rings):
         dtl = geometry.get(str(r), {})
         if 'stride' in dtl:

@@ -13,6 +13,8 @@
 # Artifacts on success (ROUND = $BF_BENCH_ROUND):
 #   BENCH_${ROUND}.json       - the driver-format one-line JSON from bench.py
 #   BENCH_SUITE_${ROUND}.json - per-config detail written by run_suite_into
+#   BENCH_OBS_${ROUND}.json   - observability overhead gate (config 8 with
+#                               spans on vs off; tools/obs_overhead.py)
 #   bench_watch.log           - probe/attempt history (gitignored)
 cd "$(dirname "$0")/.." || exit 1
 ROUND="${BF_BENCH_ROUND:-r$(date -u +%Y%m%d)}"
@@ -56,6 +58,20 @@ for i in $(seq 1 400); do
         && ! grep -q '"error": "jax backend' "$OUT.tmp"; then
       mv "$OUT.tmp" "$OUT"
       echo "$(date -u +%FT%TZ) capture OK -> $OUT" >> "$LOG"
+      # Observability overhead gate: rerun bench_suite config 8 with
+      # span recording on vs off and assert <5% per-gulp regression;
+      # both runs land in BENCH_OBS_${ROUND}.json.  A failure exits
+      # nonzero (the capture artifacts above are already in place).
+      if [ "${BF_SKIP_OBS_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) observability overhead gate (config 8)" >> "$LOG"
+        python tools/obs_overhead.py --out "BENCH_OBS_${ROUND}.json" >> "$LOG" 2>&1
+        orc=$?
+        echo "$(date -u +%FT%TZ) overhead gate rc=$orc" >> "$LOG"
+        if [ "$orc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) observability overhead gate FAILED" >> "$LOG"
+          exit "$orc"
+        fi
+      fi
       exit 0
     fi
     # never leave a truncated artifact where round automation could
