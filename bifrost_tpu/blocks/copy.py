@@ -45,6 +45,15 @@ class CopyBlock(TransformBlock):
     def define_valid_input_spaces(self):
         return 'any'
 
+    def macro_gulp_safe(self):
+        """Macro-gulp eligible on the device paths: an H2D copy over a
+        K-gulp span stages K gulps with ONE engine call (one aligned
+        staging copy + one device_put instead of K), a D2H copy drains
+        ONE deferred fill per K gulps, and a device-device copy
+        republishes one chunk.  Host-only copies gain nothing from
+        batching and keep per-gulp granularity."""
+        return 'tpu' in (self.irings[0].space, self.orings[0].space)
+
     def on_sequence(self, iseq):
         return deepcopy(iseq.header)
 

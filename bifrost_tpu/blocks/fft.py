@@ -33,6 +33,14 @@ class _StageBlock(TransformBlock):
     def define_valid_input_spaces(self):
         return ('tpu',)
 
+    def macro_gulp_safe(self):
+        """Macro-gulp eligible when the stage is time-concat
+        equivariant: the per-shape plan cache then compiles ONE
+        program at the K-gulp shape and on_data needs no batch
+        special-casing (the stacked span IS a valid gulp to the
+        stage).  Non-equivariant stages fall back to K=1."""
+        return bool(getattr(self._stage, 'batch_safe', False))
+
     def on_sequence(self, iseq):
         self._ihdr = iseq.header
         self._plans = {}

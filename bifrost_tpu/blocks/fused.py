@@ -20,9 +20,11 @@ class FusedBlock(TransformBlock):
     def __init__(self, iring, stages, *args, **kwargs):
         super(FusedBlock, self).__init__(iring, *args, **kwargs)
         self.stages = list(stages)
-        #: compiled plans keyed by (shape, dtype, donate) — the
-        #: donating and non-donating variants are distinct XLA
-        #: programs (input aliasing differs), cached side by side
+        #: compiled plans keyed by (shape, dtype, donate) for the
+        #: per-gulp path and by ('macro', part_shapes, dtype, donate,
+        #: G, mode) for macro-gulp batches — the donating and
+        #: non-donating variants are distinct XLA programs (input
+        #: aliasing differs), cached side by side
         self._plans = {}
         self._plan_impls = {}   # same key -> impl info recorded at build
         self._donate_on = None
@@ -31,12 +33,19 @@ class FusedBlock(TransformBlock):
         #: monitors read what ran instead of re-deriving it)
         self.impl_info = None
         self._published_impl = None
+        self._published_key = None
         self._last_built_impl = None
         from ..proclog import ProcLog
         self._impl_proclog = ProcLog(self.name + '/impl')
 
     def define_valid_input_spaces(self):
         return ('tpu',)
+
+    def macro_gulp_safe(self):
+        """Macro-gulp eligible: the jitted chain batches K gulps into
+        one program (mesh plans excluded — sharded macro aliasing is
+        not threaded through)."""
+        return self.mesh is None
 
     def on_sequence(self, iseq):
         hdr = iseq.header
@@ -47,6 +56,7 @@ class FusedBlock(TransformBlock):
         self._plans = {}
         self._plan_impls = {}
         self._published_impl = None
+        self._published_key = None
         self._donate_on = None
         self._prewarm(iseq.header)
         return hdr
@@ -60,8 +70,10 @@ class FusedBlock(TransformBlock):
         the cached plan key cannot drift from the hot path.  With
         donation active, the donating plan is the hot path — prewarm
         that variant too (the zeros gulp is exclusively ours to
-        donate).  Any failure falls back to the lazy build in
-        on_data."""
+        donate).  With a macro-gulp batch configured, the K-gulp macro
+        plan is prewarmed as well (a full batch is the steady-state
+        shape; the tail still compiles lazily).  Any failure falls
+        back to the lazy build in on_data."""
         t = ihdr.get('_tensor', {})
         gulp = self.gulp_nframe or ihdr.get('gulp_nframe')
         if not gulp or -1 not in t.get('shape', []):
@@ -78,6 +90,33 @@ class FusedBlock(TransformBlock):
                     device_rep_zeros(shape, t['dtype']), donate=True))
         except Exception:
             self._plans = {}
+            return
+        try:
+            from ..macro import resolve_gulp_batch
+            k = resolve_gulp_batch(self)
+            # skip the K-gulp compile when a static fallback (host
+            # topology, multi-reader ring, ...) would discard it —
+            # only the sequence-dependent conditions (overlap /
+            # dynamic gulp) can still fall back after this
+            if k > 1 and self.mesh is None and \
+                    self._macro_static_reason() is None:
+                import jax
+                from ..devrep import device_rep_zeros
+                taxis = t['shape'].index(-1)
+                mshape = list(shape)
+                mshape[taxis] = int(gulp) * k
+                jax.block_until_ready(self._execute_macro(
+                    [device_rep_zeros(tuple(mshape), t['dtype'])],
+                    donate=False, gulp_nframe=int(gulp)))
+                if self._donation_on():
+                    jax.block_until_ready(self._execute_macro(
+                        [device_rep_zeros(tuple(mshape), t['dtype'])],
+                        donate=True, gulp_nframe=int(gulp)))
+        except Exception:
+            # keep the per-gulp plans warmed above; the macro plan
+            # builds lazily on the first batch instead
+            self._plans = {key: p for key, p in self._plans.items()
+                           if key and key[0] != 'macro'}
 
     def define_output_nframes(self, input_nframe):
         n = input_nframe
@@ -162,11 +201,18 @@ class FusedBlock(TransformBlock):
         executed one may claim the ProcLog record."""
         self._last_built_impl = dict(info)
 
-    def _publish_impl(self, info):
+    def _publish_impl(self, info, key=None):
+        """Publish the EXECUTED plan's configuration.  Republishes
+        whenever the executed PATH differs from the last published one
+        — plan-key change (donate toggling mid-sequence, a macro batch
+        engaging, a new shape) or info change — so monitors never read
+        a stale impl while a different program is running."""
         self.impl_info = dict(info)
-        if info == self._published_impl:
+        if info == self._published_impl and \
+                (key is None or key == self._published_key):
             return
         self._published_impl = dict(info)
+        self._published_key = key
         try:
             # force: plan switches are rare, event-driven records — the
             # per-gulp rate limit must not drop one (the published
@@ -193,14 +239,80 @@ class FusedBlock(TransformBlock):
             self._plan_impls[key] = self._last_built_impl
         info = self._plan_impls.get(key)
         if info is not None:
-            self._publish_impl(info)
+            self._publish_impl(info, key)
         fn, taxis = plan
         if taxis is not None:
             from ..parallel.scope import shard_gulp
             x = shard_gulp(x, self.mesh, taxis)
         return fn(x)
 
+    def _execute_macro(self, parts, donate, gulp_nframe):
+        """Macro-gulp execution: run ONE compiled program over a
+        K-gulp span (bifrost_tpu.macro; docs/perf.md).  ``parts`` is
+        the span's input as one array or several exclusively-owned
+        chunks exactly tiling it (multi-chunk donation); the plan
+        concatenates parts inside the (donating) jit.  Plans are
+        cached by (part shapes, dtype, donate, G, mode): the stacked
+        'block' mode feeds the whole span through the composed chain
+        (every built-in stage is time-concat equivariant, so the
+        spectrometer substitution still matches at the macro shape);
+        'sliced' mode maps the per-gulp body over G-frame slices
+        inside one program when a stage is not provably batch-safe."""
+        import jax
+        from ..macro import build_batched_fn, chain_batch_mode
+        from ..ops.common import donating_jit
+        from ..stages import compose_stages
+        mode = chain_batch_mode(self.stages)
+        part_shapes = tuple(tuple(p.shape) for p in parts)
+        dtype = parts[0].dtype
+        key = ('macro', part_shapes, str(dtype), bool(donate),
+               int(gulp_nframe), mode)
+        plan = self._plans.get(key)
+        if plan is None:
+            taxis_in = self._headers[0]['_tensor']['shape'].index(-1)
+            taxis_out = self._headers[-1]['_tensor']['shape'].index(-1)
+            info_box = {}
+
+            def per_shape(shape):
+                fn, info = compose_stages(self.stages, self._headers,
+                                          shape, dtype)
+                info_box.update(info)
+                return fn
+
+            fn = build_batched_fn(per_shape, taxis_in, taxis_out,
+                                  int(gulp_nframe), part_shapes, mode)
+            nframe = sum(s[taxis_in] for s in part_shapes)
+            info = dict(info_box,
+                        batch=-(-nframe // int(gulp_nframe)),
+                        batch_mode=mode)
+            if donate:
+                info['donate_argnums'] = list(range(len(parts)))
+                fn = donating_jit(
+                    fn, donate_argnums=tuple(range(len(parts))))
+            else:
+                fn = jax.jit(fn)
+            plan = (fn, None)
+            self._plans[key] = plan
+            self._plan_impls[key] = info
+        info = self._plan_impls.get(key)
+        if info is not None:
+            self._publish_impl(info, key)
+        return plan[0](*parts)
+
     def on_data(self, ispan, ospan):
+        if self._gulp_batch_active > 1 and self.mesh is None \
+                and self._macro_gulp_in:
+            x = self._take_donatable(ispan, allow_parts=True)
+            if x is None:
+                parts, donate = [ispan.data], False
+            elif isinstance(x, list):
+                parts, donate = x, True
+            else:
+                parts, donate = [x], True
+            ospan.set(self._execute_macro(parts, donate,
+                                          self._macro_gulp_in),
+                      owned=True)
+            return
         x = self._take_donatable(ispan) if self.mesh is None else None
         if x is not None:
             ospan.set(self._execute_plan(x, donate=True), owned=True)

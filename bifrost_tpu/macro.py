@@ -1,0 +1,164 @@
+"""Macro-gulp execution: K-gulp batched dispatch on the hot path.
+
+The ceilings methodology (docs/perf.md) proves this chip delivers ~6x
+more when dispatch is amortized: one-kernel-per-dispatch measures the
+tunnel round-trip (~15 TFLOPS f32 / ~57 GB/s), while K=32 chained
+passes inside ONE jitted program measure ~88 TFLOPS / ~171 GB/s.  The
+pipeline runtime historically never benefited — ``Block.main``
+dispatched one XLA program per block per gulp, exactly the
+dispatch-bound regime the bench harness was built to avoid.
+
+Macro-gulp mode closes that gap at the gulp-loop layer: an eligible
+device block acquires/reserves K gulps of ring span in ONE operation,
+runs ONE compiled XLA program over the K-gulp batch, and commits all K
+gulps at once — turning K dispatch round-trips plus K ring lock cycles
+into one.  The reference framework amortizes per-launch cost the same
+way one layer down (bifrost batches packet-capture and kernel work per
+gulp span); the TPU-DFT work gets its throughput by keeping many
+transform steps inside a single XLA program.  This module brings that
+discipline to the gulp loop itself.
+
+Two batch-execution shapes, chosen per stage chain
+(:func:`chain_batch_mode`):
+
+- **block** — every stage is concat-equivariant along the time axis
+  (all built-in stages are), so the composed chain runs directly on
+  the stacked K-gulp span.  XLA sees one big program; per-gulp results
+  are bit-identical to K=1 because each frame's math is unchanged.
+- **sliced** — a stage couples frames across the time axis in a way
+  that is not provably concat-safe; the K-gulp span is split into
+  per-gulp slices inside one jitted program (``lax.map`` over the
+  per-gulp body — one compile, one dispatch, per-gulp semantics
+  preserved exactly).
+
+Eligibility (:meth:`bifrost_tpu.pipeline.MultiTransformBlock.
+_resolve_macro_batch`) falls back to K=1 — never an error — for host
+blocks, multi-reader rings, overlapped (FIR-history) reads,
+unguaranteed readers, dynamic gulp geometry, and nframe-nonlinear
+blocks.  K=1 is the default and is byte-identical in behavior to the
+pre-macro runtime.
+
+Controlled by ``BF_GULP_BATCH`` or the ``gulp_batch`` scope tunable
+(``Pipeline(gulp_batch=K)``).  See docs/perf.md ("Macro-gulp
+execution") and docs/envvars.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ['resolve_gulp_batch', 'chain_batch_mode',
+           'build_batched_fn', 'fallback_reason']
+
+
+def resolve_gulp_batch(scope):
+    """Effective macro-gulp batch K for ``scope``: the ``gulp_batch``
+    tunable when set anywhere in the scope chain, else the
+    BF_GULP_BATCH environment default (1 = off)."""
+    k = scope.gulp_batch
+    if k is None:
+        try:
+            k = int(os.environ.get('BF_GULP_BATCH', '1') or 1)
+        except ValueError:
+            k = 1
+    try:
+        k = int(k)
+    except (TypeError, ValueError):
+        return 1
+    return max(k, 1)
+
+
+def chain_batch_mode(stages):
+    """'block' when every stage declares time-concat equivariance
+    (``Stage.batch_safe``), else 'sliced'."""
+    if all(getattr(s, 'batch_safe', False) for s in stages):
+        return 'block'
+    return 'sliced'
+
+
+def fallback_reason(reason):
+    """Record a macro-gulp K=1 fallback on the telemetry counters so an
+    operator can see WHY batching did not engage
+    (``macro.fallback.<reason>``)."""
+    from .telemetry import counters
+    counters.inc('macro.fallback.%s' % reason)
+
+
+def _split_count(nframe, gulp):
+    """(full_gulps, remainder_frames) of a macro span."""
+    k, r = divmod(int(nframe), int(gulp))
+    return k, r
+
+
+def build_batched_fn(per_gulp_for_shape, taxis_in, taxis_out,
+                     gulp_nframe, part_shapes, mode):
+    """Build the ONE-dispatch function over a macro span for a stage
+    chain.
+
+    ``per_gulp_for_shape(shape) -> fn`` builds the per-shape chain
+    function (the same builder the K=1 path compiles); ``taxis_in`` /
+    ``taxis_out`` are the time-axis indices of the chain's input and
+    output tensors (they differ when the chain transposes);
+    ``gulp_nframe`` the logical gulp G; ``part_shapes`` the static
+    shapes of the span's input part(s) (one part normally; several when
+    a donated macro span was claimed as multiple exclusively-owned
+    chunks); ``mode`` is 'block' or 'sliced'
+    (:func:`chain_batch_mode`).
+
+    Returns ``fn(*parts) -> array`` suitable for (donating) jit:
+
+    - parts are concatenated along ``taxis_in`` inside the program
+      (free for a single part),
+    - 'block': the composed chain runs once on the stacked span,
+    - 'sliced': ``lax.map`` applies the per-gulp body to each G-frame
+      slice and a statically-shaped tail handles the partial batch at
+      sequence end, so per-gulp semantics are preserved exactly.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    nframe = sum(int(s[taxis_in]) for s in part_shapes)
+    full_shape = list(part_shapes[0])
+    full_shape[taxis_in] = nframe
+
+    if mode == 'block':
+        body = per_gulp_for_shape(tuple(full_shape))
+
+        def fn(*parts):
+            x = parts[0] if len(parts) == 1 else \
+                jnp.concatenate(parts, axis=taxis_in)
+            return body(x)
+        return fn
+
+    k, rem = _split_count(nframe, gulp_nframe)
+    gulp_shape = list(full_shape)
+    gulp_shape[taxis_in] = int(gulp_nframe)
+    body = per_gulp_for_shape(tuple(gulp_shape)) if k else None
+    tail_shape = list(full_shape)
+    tail_shape[taxis_in] = rem
+    tail = per_gulp_for_shape(tuple(tail_shape)) if rem else None
+    G = int(gulp_nframe)
+
+    def fn(*parts):
+        x = parts[0] if len(parts) == 1 else \
+            jnp.concatenate(parts, axis=taxis_in)
+        outs = []
+        if k:
+            def per(i):
+                return body(lax.dynamic_slice_in_dim(x, i * G, G,
+                                                     axis=taxis_in))
+            ys = lax.map(per, jnp.arange(k))
+            # (k, ..., G_out, ...) -> (..., k * G_out, ...)
+            ys = jnp.moveaxis(ys, 0, taxis_out)
+            merged = (ys.shape[:taxis_out] +
+                      (ys.shape[taxis_out] * ys.shape[taxis_out + 1],) +
+                      ys.shape[taxis_out + 2:])
+            outs.append(ys.reshape(merged))
+        if rem:
+            idx = [slice(None)] * len(full_shape)
+            idx[taxis_in] = slice(k * G, nframe)
+            outs.append(tail(x[tuple(idx)]))
+        if len(outs) == 1:
+            return outs[0]
+        return jnp.concatenate(outs, axis=taxis_out)
+    return fn

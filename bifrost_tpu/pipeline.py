@@ -111,6 +111,12 @@ class BlockScope(object):
     (opt-in XLA buffer donation of exclusively-owned gulp inputs on
     device blocks; requires single-consumer topology — see
     docs/transfer.md; default off, BF_DONATE=1 enables globally),
+    gulp_batch (macro-gulp execution: eligible device blocks
+    reserve/acquire K gulps of ring span in one operation and run ONE
+    compiled XLA program over the batch, amortizing per-dispatch
+    latency K-fold — see bifrost_tpu.macro and docs/perf.md; default
+    1, BF_GULP_BATCH sets the global default; ineligible blocks fall
+    back to K=1 automatically),
     on_failure ('abort' default | 'restart' | 'skip_sequence' — the
     supervision policy applied when a block's main loop raises, see
     docs/robustness.md), max_restarts / restart_backoff (restart-policy
@@ -126,14 +132,14 @@ class BlockScope(object):
 
     _TUNABLES = ('gulp_nframe', 'buffer_nframe', 'buffer_factor', 'core',
                  'device', 'mesh', 'share_temp_storage', 'sync_depth',
-                 'sync_strict', 'donate', 'on_failure', 'max_restarts',
-                 'restart_backoff')
+                 'sync_strict', 'donate', 'gulp_batch', 'on_failure',
+                 'max_restarts', 'restart_backoff')
 
     def __init__(self, name=None, gulp_nframe=None, buffer_nframe=None,
                  buffer_factor=None, core=None, gpu=None, device=None,
                  mesh=None, share_temp_storage=False, fuse=False,
                  sync_depth=None, sync_strict=None, donate=None,
-                 on_failure=None, max_restarts=None,
+                 gulp_batch=None, on_failure=None, max_restarts=None,
                  restart_backoff=None):
         if name is None:
             name = 'BlockScope_%i' % BlockScope.instance_count
@@ -149,6 +155,7 @@ class BlockScope(object):
         self._sync_depth = sync_depth
         self._sync_strict = sync_strict
         self._donate = donate
+        self._gulp_batch = gulp_batch
         self._on_failure = on_failure
         self._max_restarts = max_restarts
         self._restart_backoff = restart_backoff
@@ -608,6 +615,15 @@ class Block(BlockScope):
         #: per-block latency histograms, created on first gulp
         self._h_gulp = None
         self._h_wait = None
+        #: dispatch amortization observability (macro-gulp execution):
+        #: one XLA/host dispatch may cover several logical gulps
+        self._h_batch = None
+        self._n_dispatches = 0
+        self._n_gulps_logical = 0
+        #: macro-gulp state for the CURRENT sequence (set per sequence
+        #: by MultiTransformBlock._process_sequence; 1 = off)
+        self._gulp_batch_active = 1
+        self._macro_gulp_in = None
         self.bind_proclog = ProcLog(self.name + '/bind')
         self.in_proclog = ProcLog(self.name + '/in')
         rnames = {'nring': len(self.irings)}
@@ -647,14 +663,35 @@ class Block(BlockScope):
         self._h_gulp.record(acquire + reserve + process)
         self._h_wait.record(acquire + reserve)
 
+    def _observe_dispatch(self, ngulps):
+        """Record one on_data dispatch covering ``ngulps`` logical
+        gulps: the ``block.<name>.dispatches`` / ``block.<name>.gulps``
+        counters and the batch-size histogram make dispatches-per-gulp
+        observable (macro-gulp execution amortizes K gulps into one
+        dispatch; K=1 blocks record 1:1)."""
+        from .telemetry import counters
+        ngulps = max(int(ngulps), 1)
+        self._n_dispatches += 1
+        self._n_gulps_logical += ngulps
+        counters.inc('block.%s.dispatches' % self.name)
+        counters.inc('block.%s.gulps' % self.name, ngulps)
+        if self._h_batch is None:
+            self._h_batch = _histograms.get_or_create(
+                'block.%s.batch_gulps' % self.name, unit='gulps')
+        self._h_batch.record(ngulps)
+
     def _perf_stats(self):
         """Percentile columns for the perf proclog (rendered by
         tools/like_top.py)."""
         if self._h_gulp is None:
             return {}
-        return {'gulp_p50': round(self._h_gulp.percentile(50), 6),
-                'gulp_p99': round(self._h_gulp.percentile(99), 6),
-                'ring_wait_p99': round(self._h_wait.percentile(99), 6)}
+        stats = {'gulp_p50': round(self._h_gulp.percentile(50), 6),
+                 'gulp_p99': round(self._h_gulp.percentile(99), 6),
+                 'ring_wait_p99': round(self._h_wait.percentile(99), 6)}
+        if self._n_dispatches:
+            stats['gulps_per_dispatch'] = round(
+                self._n_gulps_logical / float(self._n_dispatches), 3)
+        return stats
 
     def create_ring(self, *args, **kwargs):
         return Ring(*args, owner=self, **kwargs)
@@ -775,16 +812,28 @@ class Block(BlockScope):
                 for oring in orings]
 
     def begin_sequences(self, exit_stack, orings, oheaders,
-                        igulp_nframes, istride_nframes):
+                        igulp_nframes, istride_nframes, batch=1):
         # The output header's gulp_nframe excludes overlap (stride-based;
-        # reference: pipeline.py:383-399).
+        # reference: pipeline.py:383-399).  Under macro-gulp execution
+        # (batch > 1) the passed nframes are MACRO values: the ring is
+        # sized for the K-gulp span, but the header advertises the
+        # LOGICAL gulp so downstream blocks' defaults (and their own
+        # macro eligibility) are unchanged by this block's batching.
         ostride_nframes = self._define_output_nframes(istride_nframes)
         for ohdr, ostride in zip(oheaders, ostride_nframes):
-            ohdr['gulp_nframe'] = ostride
+            ohdr['gulp_nframe'] = ostride // batch
         ogulp_nframes = self._define_output_nframes(igulp_nframes)
         # Writers only buffer one gulp; extra depth belongs to readers.
+        # EXCEPT under macro-gulp batching: a reader's guarantee lags
+        # one of ITS spans behind consumption, and when the reader's
+        # own buffering request is smaller than the writer's macro
+        # span (a K=1 consumer reading logical gulps), a one-macro-
+        # span ring can never grant the next macro reserve — the
+        # writer carries a second macro span of depth instead.
+        obuf_factor = 2 if batch > 1 else 1
         oseqs = [exit_stack.enter_context(
-                     oring.begin_sequence(ohdr, ogulp, 1 * ogulp))
+                     oring.begin_sequence(ohdr, ogulp,
+                                          obuf_factor * ogulp))
                  for oring, ohdr, ogulp
                  in zip(orings, oheaders, ogulp_nframes)]
         # Init barrier (reference: pipeline.py:401-403).
@@ -988,6 +1037,7 @@ class SourceBlock(Block):
                     t2 = time.time()
                     gulp_index += 1
                     self._observe_gulp(0.0, t1 - t0, t2 - t1)
+                    self._observe_dispatch(1)
                     perf = {'acquire_time': -1,
                             'reserve_time': t1 - t0,
                             'process_time': t2 - t1}
@@ -1058,6 +1108,79 @@ class MultiTransformBlock(Block):
                     supervisor.block_skipped(self, exc)
                 self._drain_sequences(iseqs)
 
+    # -- macro-gulp execution (bifrost_tpu.macro; docs/perf.md) -----------
+    def macro_gulp_safe(self):
+        """Whether this block's on_data can process a K-gulp macro span
+        as ONE dispatch with per-gulp semantics preserved.  Default
+        False: host/compute blocks fall back to K=1 automatically.
+        Device blocks that batch (FusedBlock, the jitted _StageBlock
+        wrappers, CopyBlock's space movers) override this."""
+        return False
+
+    def _macro_input_consumers(self):
+        """Direct consumers of this block's input ring (by base-ring
+        identity, so block_view taps count).  Macro acquire holds K
+        gulps of guarantee; a multi-reader input ring falls back to
+        K=1 so batching never changes a peer's flow control."""
+        def base(r):
+            return getattr(r, '_base_ring', r)
+        target = base(self.irings[0])
+        n = 0
+        for b in self.pipeline.blocks:
+            for r in getattr(b, 'irings', ()):
+                if base(r) is target:
+                    n += 1
+        return n
+
+    def _macro_static_reason(self):
+        """Macro-gulp fallback reason derivable from STATIC block /
+        topology state (no open sequence required), or None.  Shared
+        by _resolve_macro_batch and FusedBlock._prewarm, so prewarm
+        never compiles K-gulp plans a static fallback would discard."""
+        if not self.macro_gulp_safe():
+            return 'block'
+        if len(self.irings) != 1 or len(self.orings) > 1:
+            return 'topology'
+        if not getattr(self, 'guarantee', True):
+            return 'unguaranteed'
+        if self._macro_input_consumers() > 1:
+            return 'multi_reader'
+        return None
+
+    def _resolve_macro_batch(self, iseqs, istride_nframes,
+                             igulp_overlaps):
+        """Effective macro-gulp batch for THIS sequence: the requested
+        K (gulp_batch tunable / BF_GULP_BATCH) when every eligibility
+        condition holds, else 1.  Fallbacks are recorded on the
+        ``macro.fallback.<reason>`` counters — batching silently
+        disabling itself must still be observable."""
+        from .macro import resolve_gulp_batch, fallback_reason
+        k = resolve_gulp_batch(self)
+        if k <= 1:
+            return 1
+        reason = self._macro_static_reason()
+        if reason is None and any(igulp_overlaps):
+            reason = 'overlap'
+        if reason is None and any(not g or g <= 0
+                                  for g in istride_nframes):
+            reason = 'dynamic_gulp'
+        if reason is None:
+            # nframe linearity: a K-gulp batch's output must be exactly
+            # K per-gulp outputs for the one-commit macro span to equal
+            # K sequential commits
+            try:
+                per = self._define_output_nframes(list(istride_nframes))
+                mac = self._define_output_nframes(
+                    [g * k for g in istride_nframes])
+                if mac != [o * k for o in per]:
+                    reason = 'nonlinear'
+            except Exception:
+                reason = 'nonlinear'
+        if reason is not None:
+            fallback_reason(reason)
+            return 1
+        return k
+
     def _drain_sequences(self, iseqs):
         """Consume and discard the remainder of the current input
         sequences (skip_sequence): a reader that merely stops reading
@@ -1091,6 +1214,20 @@ class MultiTransformBlock(Block):
         igulp_nframes = [g + o for g, o
                          in zip(igulp_nframes, igulp_overlaps)]
 
+        # Macro-gulp execution (bifrost_tpu.macro): an eligible block
+        # acquires/reserves K gulps per ring operation and its on_data
+        # runs ONE compiled program over the batch.  The LOGICAL gulp
+        # (istride before scaling) is recorded so on_data can recover
+        # per-gulp geometry and telemetry can count logical gulps.
+        batch = self._resolve_macro_batch(iseqs, istride_nframes,
+                                          igulp_overlaps)
+        self._gulp_batch_active = batch
+        self._macro_gulp_in = istride_nframes[0] if istride_nframes \
+            else None
+        if batch > 1:
+            igulp_nframes = [g * batch for g in igulp_nframes]
+            istride_nframes = [s * batch for s in istride_nframes]
+
         for iseq, igulp_nframe in zip(iseqs, igulp_nframes):
             if self.buffer_factor is None:
                 src_block = iseq.ring.owner
@@ -1114,7 +1251,7 @@ class MultiTransformBlock(Block):
         with ExitStack() as oseq_stack:
             oseqs, ogulp_overlaps = self.begin_sequences(
                 oseq_stack, orings, oheaders,
-                igulp_nframes, istride_nframes)
+                igulp_nframes, istride_nframes, batch=batch)
             if self.shutdown_event.is_set():
                 return False
             prev_time = time.time()
@@ -1139,8 +1276,19 @@ class MultiTransformBlock(Block):
                             ospan_stack, oseqs, iskip_nframes)
                         ostrides = self._on_skip(iskip_slices, ospans)
                         self._sync_gulp(ospans)
+                        # the zero-fill is a real dispatch: keep BOTH
+                        # the ring-level (ring.<name>.gulps via
+                        # _ngulps) and block-level (dispatches/gulps)
+                        # logical-gulp counters symmetric for it
+                        ng = 1
+                        if batch > 1 and self._macro_gulp_in:
+                            ng = max(1, -(-iskip_nframes[0] //
+                                          self._macro_gulp_in))
+                            for ospan in ospans:
+                                ospan._ngulps = ng
                         self.commit_spans(ospans, ostrides,
                                           ogulp_overlaps)
+                        self._observe_dispatch(ng)
 
                 if all(ispan.nframe == 0 for ispan in ispans):
                     continue
@@ -1186,6 +1334,15 @@ class MultiTransformBlock(Block):
                         ostrides = self._on_skip(iskip_slices, ospans)
                         self._sync_gulp(ospans)
 
+                    # logical gulps this dispatch covered (a partial
+                    # macro span at sequence end rounds up: its tail
+                    # sub-gulp is a real dispatch unit)
+                    ngulps = 1
+                    if batch > 1 and self._macro_gulp_in:
+                        ngulps = max(1, -(-ispans[0].nframe //
+                                          self._macro_gulp_in))
+                    for ospan in ospans:
+                        ospan._ngulps = ngulps
                     self.commit_spans(ospans, ostrides, ogulp_overlaps)
                 cur_time = time.time()
                 process_time = cur_time - prev_time
@@ -1193,6 +1350,7 @@ class MultiTransformBlock(Block):
                 gulp_index += 1
                 self._observe_gulp(acquire_time, reserve_time,
                                    process_time)
+                self._observe_dispatch(ngulps)
                 perf = {'acquire_time': acquire_time,
                         'reserve_time': reserve_time,
                         'process_time': process_time}
@@ -1261,15 +1419,19 @@ class TransformBlock(MultiTransformBlock):
             self._donate_on = resolve_donate(self)
         return self._donate_on
 
-    def _take_donatable(self, ispan):
+    def _take_donatable(self, ispan, allow_parts=False):
         """The input span's device chunk claimed exclusively for
         donation, or None (donation off / exclusivity unprovable —
-        callers fall back to ``ispan.data``).  Counts donation
-        hits/misses."""
+        callers fall back to ``ispan.data``).  With ``allow_parts``
+        (macro-gulp spans) the claim may return a LIST of
+        exclusively-owned chunks exactly tiling the span — the macro
+        plan concatenates them inside the donating jit, so upstream
+        K=1 producers still feed a donating macro consumer.  Counts
+        donation hits/misses."""
         if not self._donation_on():
             return None
         from .telemetry import counters
-        x = ispan.take_data()
+        x = ispan.take_data(allow_parts=allow_parts)
         counters.inc('donation.hits' if x is not None
                      else 'donation.misses')
         return x

@@ -320,6 +320,33 @@ class _DeviceStorage(object):
             pass
         return hit[1]
 
+    def take_tiling(self, offset, nbyte):
+        """Macro-span donation claim: when SEVERAL owned chunks exactly
+        tile [offset, offset+nbyte) — a K=1 producer feeding a K-gulp
+        macro consumer commits K per-gulp chunks — remove them all and
+        return the list of arrays (in offset order), else None with the
+        map untouched.  Single-chunk covers go through :meth:`take`."""
+        import bisect
+        end = offset + nbyte
+        i = bisect.bisect_left(self._offsets, offset)
+        run, covered = [], offset
+        while covered < end and i < len(self._offsets):
+            o = self._offsets[i]
+            if o != covered:
+                return None          # gap or misaligned chunk
+            cn, arr, _taxis, owned = self.chunks[o]
+            if not owned or o + cn > end:
+                return None          # foreign chunk / ragged tail
+            run.append((o, arr))
+            covered = o + cn
+            i += 1
+        if covered != end or len(run) < 2:
+            return None
+        for o, _arr in run:
+            del self.chunks[o]
+        self._offsets = sorted(self.chunks)
+        return [arr for _o, arr in run]
+
     def get(self, offset, nbyte, frame_nbyte, zeros_fn):
         """Assemble the logical array covering [offset, offset+nbyte).
         Fast path: a single committed chunk covers the request exactly."""
@@ -729,7 +756,8 @@ class Ring(object):
             self._read_cond.notify_all()
             self._span_cond.notify_all()
         if commit_nbyte:
-            _observability()[0].inc('ring.%s.gulps' % self.name)
+            _observability()[0].inc('ring.%s.gulps' % self.name,
+                                    getattr(wspan, '_ngulps', 1))
 
     # -- reader side ------------------------------------------------------
     def open_sequence(self, name, guarantee=True):
@@ -881,16 +909,19 @@ class Ring(object):
                     if f.begin is not None and f.begin < limit]
 
     # -- device-chunk donation hook ---------------------------------------
-    def _take_exclusive(self, begin, nbyte):
+    def _take_exclusive(self, begin, nbyte, allow_parts=False):
         """Claim the committed device chunk covering exactly
         [begin, begin+nbyte) for buffer donation, or None when
         exclusivity cannot be established: the chunk must be
         framework-owned and this ring must have exactly one reader
-        holding exactly one open span (the caller's).  This is a
-        point-in-time check — a second reader that is momentarily
-        between spans (e.g. an unguaranteed monitor tap) is NOT
-        detected and would later see zero-fill where the donated chunk
-        was.  Donation is therefore opt-in (BF_DONATE /
+        holding exactly one open span (the caller's).  With
+        ``allow_parts`` (macro-gulp spans) a run of several owned
+        chunks exactly tiling the range is claimed as a LIST — the
+        donation proof extends chunk-by-chunk over the macro span.
+        This is a point-in-time check — a second reader that is
+        momentarily between spans (e.g. an unguaranteed monitor tap)
+        is NOT detected and would later see zero-fill where the
+        donated chunk was.  Donation is therefore opt-in (BF_DONATE /
         BlockScope(donate=True)) and requires a single-consumer
         topology by contract — see docs/transfer.md."""
         if self.space != 'tpu':
@@ -898,7 +929,10 @@ class Ring(object):
         with self._lock:
             if self._nread_open != 1 or len(self._guarantees) > 1:
                 return None
-            return self._storage.take(begin, nbyte)
+            got = self._storage.take(begin, nbyte)
+            if got is not None or not allow_parts:
+                return got
+            return self._storage.take_tiling(begin, nbyte)
 
 
 class RingView(object):
@@ -1193,6 +1227,10 @@ class WriteSpan(_SpanAPI):
         self._native_id = None
         self._owned = False
         self._fill = None
+        #: logical gulps this span covers (macro-gulp spans set >1 so
+        #: the per-ring ``ring.<name>.gulps`` throughput counter keeps
+        #: counting LOGICAL gulps when K are committed at once)
+        self._ngulps = 1
         # ring-wait observability: how long the writer was blocked in
         # flow control (covers BOTH cores — the native reserve happens
         # inside this call)
@@ -1369,19 +1407,25 @@ class ReadSpan(_SpanAPI):
             self._data = self._host_view(writeable=False)
         return self._data
 
-    def take_data(self):
+    def take_data(self, allow_parts=False):
         """Device rings: claim this span's committed chunk exclusively
         for buffer donation (the array is consumed in place by a
         donating jit and must not be read again).  Returns the array,
         or None when exclusivity cannot be proven — partial span,
         multi-chunk stitch, multi-reader ring, or a chunk the framework
         does not own (WriteSpan.set(..., owned=True)).  Callers fall
-        back to ``.data`` on None."""
+        back to ``.data`` on None.
+
+        ``allow_parts=True`` (macro-gulp spans) additionally claims a
+        run of owned chunks exactly tiling the span, returned as a
+        LIST in offset order.  The caller must consume every part —
+        after a parts claim this span's ``.data`` would zero-fill."""
         if self._ring.space != 'tpu' or self._data is not None \
                 or not self._nbyte:
             return None
-        arr = self._ring._take_exclusive(self._begin, self._nbyte)
-        if arr is not None:
+        arr = self._ring._take_exclusive(self._begin, self._nbyte,
+                                         allow_parts=allow_parts)
+        if arr is not None and not isinstance(arr, list):
             self._data = arr
         return arr
 

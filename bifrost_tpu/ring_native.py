@@ -328,8 +328,10 @@ class NativeRing(Ring):
                 self._nwrite_open -= 1
         if commit_nbyte:
             # same per-ring throughput counter the Python core keeps
-            # (telemetry.exporter derives gulps/s from its deltas)
-            _observability()[0].inc('ring.%s.gulps' % self.name)
+            # (telemetry.exporter derives gulps/s from its deltas);
+            # macro-gulp spans credit their K logical gulps
+            _observability()[0].inc('ring.%s.gulps' % self.name,
+                                    getattr(wspan, '_ngulps', 1))
 
     # -- reader side ------------------------------------------------------
     def _register_reader(self, rseq):

@@ -38,6 +38,17 @@ class Stage(object):
     #: (num, den): output_nframe = input_nframe * num // den
     nframe_ratio = (1, 1)
 
+    #: Time-concat equivariance: True when applying the stage to K
+    #: gulps stacked along the time axis equals applying it per gulp
+    #: and concatenating the results — the condition for macro-gulp
+    #: 'block' mode to run the stacked span through ONE program
+    #: (bifrost_tpu.macro).  Every built-in stage is equivariant (the
+    #: frame axis is either untouched, reduced in whole per-gulp
+    #: groups, or only permuted); user-defined stages default to False,
+    #: which routes them through the per-gulp 'sliced' mode instead —
+    #: never a semantic change, just less fusion.
+    batch_safe = False
+
     def transform_header(self, hdr):
         return hdr
 
@@ -76,6 +87,8 @@ def _resolve_axis(tensor, axis):
 
 class FftStage(Stage):
     """(reference: blocks/fft.py:39-137; src/fft.cu)"""
+
+    batch_safe = True
 
     def __init__(self, axes, inverse=False, real_output=False,
                  axis_labels=None, apply_fftshift=False):
@@ -166,6 +179,8 @@ class FftStage(Stage):
 class DetectStage(Stage):
     """(reference: blocks/detect.py:40-138)"""
 
+    batch_safe = True
+
     def __init__(self, mode, axis=None):
         self.mode = mode.lower()
         self.axis = axis
@@ -255,6 +270,8 @@ class DetectStage(Stage):
 class ReduceStage(Stage):
     """(reference: blocks/reduce.py:39-91; src/reduce.cu)"""
 
+    batch_safe = True
+
     def __init__(self, axis, factor=None, op='sum'):
         self.specified_axis = axis
         self.specified_factor = factor
@@ -309,6 +326,8 @@ class ReduceStage(Stage):
 class FftShiftStage(Stage):
     """(reference: blocks/fftshift.py:37-81)"""
 
+    batch_safe = True
+
     def __init__(self, axes, inverse=False):
         if not isinstance(axes, (list, tuple)):
             axes = [axes]
@@ -344,6 +363,8 @@ class FftShiftStage(Stage):
 
 class ReverseStage(Stage):
     """(reference: blocks/reverse.py:36-75)"""
+
+    batch_safe = True
 
     def __init__(self, axes):
         if not isinstance(axes, (list, tuple)):
@@ -381,6 +402,8 @@ class ReverseStage(Stage):
 class TransposeStage(Stage):
     """(reference: blocks/transpose.py:41-83)"""
 
+    batch_safe = True
+
     def __init__(self, axes):
         self.specified_axes = axes
 
@@ -413,6 +436,8 @@ class TransposeStage(Stage):
 class ScrunchStage(Stage):
     """(reference: blocks/scrunch.py:38-66)"""
 
+    batch_safe = True
+
     def __init__(self, factor):
         self.factor = factor
         self.nframe_ratio = (1, factor)
@@ -441,6 +466,8 @@ class ScrunchStage(Stage):
 class MapStage(Stage):
     """User-defined elementwise stage via a bf.map expression operating on
     'a' (input) and 'b' (output); fusable with neighbors."""
+
+    batch_safe = True
 
     def __init__(self, func_string, dtype=None, scalars=None):
         self.func_string = func_string

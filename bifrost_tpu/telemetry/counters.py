@@ -46,10 +46,39 @@ by :func:`bifrost_tpu.telemetry.flush`):
 Observability counters (docs/observability.md; complemented by
 :mod:`bifrost_tpu.telemetry.histograms` for distributions):
 
-- ``ring.<name>.gulps``                    spans committed through ring
-                                           ``<name>`` (both cores) —
+- ``ring.<name>.gulps``                    LOGICAL gulps committed
+                                           through ring ``<name>``
+                                           (both cores; a macro-gulp
+                                           span credits its K gulps) —
                                            the exporter derives per-ring
                                            gulps/s from its deltas
+
+Macro-gulp execution counters (bifrost_tpu.macro — docs/perf.md):
+
+- ``block.<name>.dispatches``              on_data dispatches issued by
+                                           block ``<name>``
+- ``block.<name>.gulps``                   logical gulps those
+                                           dispatches covered —
+                                           dispatches/gulps is the
+                                           amortization ratio (1 at
+                                           K=1, ~1/K batched)
+- ``macro.fallback.<reason>``              macro-gulp requests that
+                                           fell back to K=1 (reason:
+                                           block / topology /
+                                           unguaranteed / overlap /
+                                           dynamic_gulp / multi_reader
+                                           / nonlinear)
+- ``xfer.h2d_batched``                     host gulps shipped through
+                                           the EXPLICIT batch entry
+                                           point (xfer.to_device_batch,
+                                           K separate gulps per call).
+                                           A CopyBlock moving a macro
+                                           ring span ships through
+                                           to_device (the span is one
+                                           contiguous view) and counts
+                                           on h2d_issued only — watch
+                                           block.<name>.dispatches to
+                                           confirm macro H2D engaged
 """
 
 from __future__ import annotations

@@ -65,9 +65,10 @@ import numpy as np
 
 from .testing import faults
 
-__all__ = ['to_device', 'to_host', 'to_host_async', 'prefetch',
-           'engine', 'reset_engine', 'async_enabled', 'strict_mode',
-           'TransferEngine', 'TransferFuture', 'HostFill']
+__all__ = ['to_device', 'to_device_batch', 'to_host', 'to_host_async',
+           'prefetch', 'engine', 'reset_engine', 'async_enabled',
+           'strict_mode', 'TransferEngine', 'TransferFuture',
+           'HostFill']
 
 _ALIGN = 128
 
@@ -494,6 +495,40 @@ class TransferEngine(object):
             return jax.device_put(arr, device)
         return jnp.asarray(arr)
 
+    def _stage_ship(self, shape, dtype, nbytes, fill, device):
+        """The ONE copy of the staging-slot ship protocol (shared by
+        :meth:`_stage_real` and :meth:`to_device_batch` so the slot
+        rules can never drift between them): acquire a reusable slot
+        on copying backends (size/strict gated) or a fresh aligned
+        buffer, let ``fill(buf)`` write the host bytes, async
+        device_put, bind the slot to the resulting array for later
+        recycling.  A fill/put failure returns an unused slot to the
+        pool (a swallowed slot would shrink the key's capacity for the
+        life of the process)."""
+        c = _counters()
+        slot = None
+        if not self._is_zero_copy() and nbytes >= self.stage_min \
+                and not strict_mode():
+            slot = self._pool.acquire(shape, dtype)
+        if slot is not None:
+            try:
+                fill(slot.buf)
+                out = self._put(slot.buf, device)
+            except Exception:
+                # no device array ever saw the buffer: return the slot
+                self._pool.release_unused(slot)
+                raise
+            self._pool.bind(slot, out)
+            c.inc('xfer.h2d_staged')
+        else:
+            staged = _alloc_aligned(shape, dtype)
+            fill(staged)
+            out = self._put(staged, device)
+            c.inc('xfer.h2d_unstaged')
+        c.inc('xfer.h2d_issued')
+        c.inc('xfer.h2d_bytes', int(nbytes))
+        return out
+
     def _stage_real(self, arr, device):
         """Ship a real-valued numpy array: always exactly ONE host copy
         into an engine-owned aligned buffer, then an async device_put —
@@ -514,31 +549,9 @@ class TransferEngine(object):
         caller's own memory, whose recycling would race the async
         DMA."""
         faults.fire('xfer.h2d')
-        c = _counters()
-        slot = None
-        if not self._is_zero_copy() and arr.nbytes >= self.stage_min \
-                and not strict_mode():
-            slot = self._pool.acquire(arr.shape, arr.dtype)
-        if slot is not None:
-            try:
-                np.copyto(slot.buf, arr, casting='no')
-                out = self._put(slot.buf, device)
-            except Exception:
-                # no device array ever saw the buffer: return the slot
-                # (a swallowed slot would shrink the key's capacity
-                # for the life of the process)
-                self._pool.release_unused(slot)
-                raise
-            self._pool.bind(slot, out)
-            c.inc('xfer.h2d_staged')
-        else:
-            staged = _alloc_aligned(arr.shape, arr.dtype)
-            np.copyto(staged, arr, casting='no')
-            out = self._put(staged, device)
-            c.inc('xfer.h2d_unstaged')
-        c.inc('xfer.h2d_issued')
-        c.inc('xfer.h2d_bytes', int(arr.nbytes))
-        return out
+        return self._stage_ship(
+            arr.shape, arr.dtype, int(arr.nbytes),
+            lambda buf: np.copyto(buf, arr, casting='no'), device)
 
     def to_device(self, arr, device=None):
         """numpy -> jax.Array; complex is shipped as two float planes
@@ -578,6 +591,57 @@ class TransferEngine(object):
         N+1..N+k while gulp N computes.  Identical to :meth:`to_device`
         — the name documents intent at call sites."""
         return self.to_device(arr, device)
+
+    def to_device_batch(self, arrs, device=None):
+        """Stage K same-shape host gulps with ONE engine call: one
+        aligned staging buffer covering the whole batch, one host copy
+        pass, one async ``device_put`` — K dispatch round-trips become
+        one (the H2D arm of macro-gulp execution; docs/perf.md).
+        Returns the stacked ``(K, *shape)`` device array; slice along
+        the leading axis for per-gulp views (slices keep the parent
+        alive, so per-gulp lifetime works as usual).
+
+        Note a CopyBlock moving a macro ring span already gets this
+        for free — the span is one contiguous view and
+        :meth:`to_device` ships it in one call; this entry point
+        serves producers holding K separate host gulps."""
+        arrs = [np.asarray(a) for a in arrs]
+        if not arrs:
+            raise ValueError("to_device_batch needs at least one array")
+        shape, dtype = arrs[0].shape, arrs[0].dtype
+        for a in arrs[1:]:
+            if a.shape != shape or a.dtype != dtype:
+                raise ValueError(
+                    "to_device_batch requires uniform shape/dtype "
+                    "(got %s/%s vs %s/%s)"
+                    % (a.shape, a.dtype, shape, dtype))
+        if device is None:
+            from .device import get_bound_device
+            device = get_bound_device()
+        if np.iscomplexobj(arrs[0]):
+            # complex crosses the boundary as (re, im) planes; the
+            # stack is the one extra copy the plane extraction would
+            # make anyway, and the transfer itself stays one call
+            _counters().inc('xfer.h2d_batched', len(arrs))
+            return self.to_device(np.stack(arrs), device)
+        faults.fire('xfer.h2d')
+        hist, spans = _obs()
+        t0 = time.perf_counter()
+        k = len(arrs)
+        bshape = (k,) + tuple(shape)
+        nbytes = int(np.dtype(dtype).itemsize * np.prod(bshape))
+
+        def fill(buf):
+            for i, a in enumerate(arrs):
+                np.copyto(buf[i], a, casting='no')
+
+        out = self._stage_ship(bshape, dtype, nbytes, fill, device)
+        _counters().inc('xfer.h2d_batched', k)
+        dt = time.perf_counter() - t0
+        hist.observe('xfer.h2d_s', dt)
+        hist.observe('xfer.h2d_nbytes', nbytes)
+        spans.record_elapsed('h2d', 'xfer', dt, bytes=nbytes)
+        return out
 
     # -- D2H ---------------------------------------------------------------
     @staticmethod
@@ -758,3 +822,9 @@ def to_host_async(arr):
 def prefetch(arr, device=None):
     """Issue an H2D transfer ahead of need; returns the device array."""
     return engine().prefetch(arr, device)
+
+
+def to_device_batch(arrs, device=None):
+    """Stage K same-shape host gulps with ONE engine call; returns the
+    stacked (K, *shape) device array (macro-gulp H2D)."""
+    return engine().to_device_batch(arrs, device)

@@ -1,0 +1,335 @@
+"""Macro-gulp execution (bifrost_tpu.macro; docs/perf.md): K-gulp
+batched dispatch must be byte-identical to K=1, amortize dispatches
+K-fold on the telemetry counters, flush partial batches at sequence
+end, and fall back to K=1 for every ineligible topology."""
+
+import os
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu.macro import (resolve_gulp_batch, chain_batch_mode,
+                               build_batched_fn)
+from bifrost_tpu.stages import (FftStage, DetectStage, ReduceStage,
+                                Stage)
+from bifrost_tpu.telemetry import counters
+from tests.util import NumpySourceBlock, GatherSink, simple_header
+
+NT, NP, NF, RF = 32, 2, 64, 4
+
+
+def _voltages(ngulp, seed=3):
+    rng = np.random.RandomState(seed)
+    gulps = []
+    for _ in range(ngulp):
+        raw = np.zeros((NT, NP, NF), dtype=np.dtype([('re', 'i1'),
+                                                     ('im', 'i1')]))
+        raw['re'] = rng.randint(-64, 64, raw.shape)
+        raw['im'] = rng.randint(-64, 64, raw.shape)
+        gulps.append(raw)
+    return gulps
+
+
+def _hdr():
+    return simple_header([-1, NP, NF], 'ci8',
+                         labels=['time', 'pol', 'fine_time'])
+
+
+def _run_chain(gulp_batch, ngulp, donate=None, **scope):
+    counters.reset()
+    with bf.Pipeline(gulp_batch=gulp_batch, donate=donate,
+                     **scope) as p:
+        src = NumpySourceBlock(_voltages(ngulp), _hdr(),
+                               gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='tpu')
+        fb = bf.blocks.fused(
+            b, [FftStage('fine_time', axis_labels='freq'),
+                DetectStage('stokes', axis='pol'),
+                ReduceStage('freq', RF)])
+        b2 = bf.blocks.copy(fb, space='system')
+        sink = GatherSink(b2)
+        p.run()
+    snap = counters.snapshot()
+
+    def block_counter(frag, kind):
+        return sum(v for k, v in snap.items()
+                   if k.startswith('block.') and frag in k
+                   and k.endswith('.' + kind))
+    return sink.result(), fb, snap, block_counter
+
+
+# ---------------------------------------------------------------------------
+# correctness + amortization
+# ---------------------------------------------------------------------------
+
+def test_batched_chain_identical_and_amortized():
+    """K=4 over 8 gulps: identical output stream, fused dispatches
+    drop 4x, logical gulp counters unchanged."""
+    out1, _, _, c1 = _run_chain(1, 8)
+    d1, g1 = c1('Fused', 'dispatches'), c1('Fused', 'gulps')
+    out4, fb4, snap4, c4 = _run_chain(4, 8)
+    d4, g4 = c4('Fused', 'dispatches'), c4('Fused', 'gulps')
+    assert np.array_equal(out1, out4)
+    assert (d1, g1) == (8, 8)
+    assert (d4, g4) == (2, 8)
+    # the amortization is observable as the dispatches/gulp ratio
+    assert d4 / g4 <= (d1 / g1) / 4.0 + 1e-9
+    # the copy blocks batch too (device movers are macro-eligible)
+    assert c4('Copy', 'dispatches') < c4('Copy', 'gulps')
+    # the executed plan records the batch mode
+    assert fb4.impl_info.get('batch') == 4
+    assert fb4.impl_info.get('batch_mode') == 'block'
+
+
+def test_partial_batch_flushes_at_sequence_end():
+    """ngulp not a multiple of K: the tail flushes as a partial batch
+    and the stream is still byte-identical."""
+    out1, _, _, _ = _run_chain(1, 6)
+    out4, _, _, c4 = _run_chain(4, 6)
+    assert np.array_equal(out1, out4)
+    # one full batch of 4 + one partial batch of 2
+    assert c4('Fused', 'dispatches') == 2
+    assert c4('Fused', 'gulps') == 6
+
+
+def test_env_var_enables_batching(monkeypatch):
+    monkeypatch.setenv('BF_GULP_BATCH', '4')
+    out, _, _, c = _run_chain(None, 8)
+    assert c('Fused', 'dispatches') == 2
+    out1, _, _, _ = _run_chain(1, 8)
+    assert np.array_equal(out, out1)
+
+
+def test_macro_donation_hits_and_identical():
+    """Donation composes with macro spans: the upstream macro commit
+    is claimed exclusively and the donating macro plan publishes its
+    donate_argnums."""
+    out1, _, _, _ = _run_chain(1, 8)
+    out4, fb4, snap4, _ = _run_chain(4, 8, donate=True)
+    assert np.array_equal(out1, out4)
+    assert snap4.get('donation.hits', 0) > 0
+    assert fb4.impl_info.get('donate_argnums') == [0]
+
+
+def test_ring_gulp_counters_count_logical_gulps():
+    """ring.<name>.gulps stays a LOGICAL gulp counter when K gulps are
+    committed in one span (both the batched device rings and the K=1
+    source ring read 8)."""
+    _, _, snap, _ = _run_chain(4, 8)
+    ring_gulps = [v for k, v in snap.items()
+                  if k.startswith('ring.') and k.endswith('.gulps')]
+    assert ring_gulps and all(v == 8 for v in ring_gulps)
+
+
+# ---------------------------------------------------------------------------
+# eligibility fallbacks
+# ---------------------------------------------------------------------------
+
+def test_host_blocks_fall_back():
+    """A host->host chain has no macro-eligible block: K requested but
+    every dispatch stays 1:1 and the fallback is counted."""
+    counters.reset()
+    with bf.Pipeline(gulp_batch=4) as p:
+        src = NumpySourceBlock(_voltages(6), _hdr(), gulp_nframe=NT)
+        b = bf.blocks.copy(src)            # system -> system
+        sink = GatherSink(b)
+        p.run()
+    snap = counters.snapshot()
+    disp = sum(v for k, v in snap.items()
+               if k.startswith('block.') and 'Copy' in k
+               and k.endswith('.dispatches'))
+    gulps = sum(v for k, v in snap.items()
+                if k.startswith('block.') and 'Copy' in k
+                and k.endswith('.gulps'))
+    assert disp == gulps == 6
+    assert snap.get('macro.fallback.block', 0) > 0
+
+
+def test_multi_reader_ring_falls_back():
+    """Two consumers on the fused block's input ring: batching would
+    hold K gulps of guarantee against the peer — must fall back."""
+    counters.reset()
+    with bf.Pipeline(gulp_batch=4) as p:
+        src = NumpySourceBlock(_voltages(6), _hdr(), gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='tpu')
+        fb = bf.blocks.fused(
+            b, [FftStage('fine_time', axis_labels='freq'),
+                DetectStage('stokes', axis='pol'),
+                ReduceStage('freq', RF)])
+        # second consumer of the h2d ring
+        b_tap = bf.blocks.copy(b, space='system')
+        sink1 = GatherSink(bf.blocks.copy(fb, space='system'))
+        sink2 = GatherSink(b_tap)
+        p.run()
+    snap = counters.snapshot()
+    assert snap.get('macro.fallback.multi_reader', 0) > 0
+    fused_disp = sum(v for k, v in snap.items()
+                     if 'Fused' in k and k.endswith('.dispatches'))
+    assert fused_disp == 6
+
+
+def test_overlap_falls_back():
+    """FIR-style input overlap is incompatible with macro spans."""
+    from bifrost_tpu.pipeline import TransformBlock
+
+    class OverlapIdent(TransformBlock):
+        def on_sequence(self, iseq):
+            from copy import deepcopy
+            return deepcopy(iseq.header)
+
+        def define_input_overlap_nframe(self, iseq):
+            return 4
+
+        def define_output_nframes(self, input_nframe):
+            return input_nframe - 4
+
+        def macro_gulp_safe(self):
+            return True               # overlap must still veto
+
+        def on_data(self, ispan, ospan):
+            d = ispan.data
+            ospan.set(d[4:] if ospan.ring.space == 'tpu'
+                      else d.as_numpy()[4:])
+
+    counters.reset()
+    with bf.Pipeline(gulp_batch=4) as p:
+        src = NumpySourceBlock(_voltages(6), _hdr(), gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='tpu')
+        ob = OverlapIdent(b)
+        sink = GatherSink(bf.blocks.copy(ob, space='system'))
+        p.run()
+    snap = counters.snapshot()
+    assert snap.get('macro.fallback.overlap', 0) > 0
+
+
+def test_resolve_gulp_batch_sources(monkeypatch):
+    scope = bf.Pipeline(gulp_batch=8)
+    assert resolve_gulp_batch(scope) == 8
+    monkeypatch.setenv('BF_GULP_BATCH', '16')
+    assert resolve_gulp_batch(bf.Pipeline()) == 16
+    monkeypatch.setenv('BF_GULP_BATCH', 'junk')
+    assert resolve_gulp_batch(bf.Pipeline()) == 1
+    monkeypatch.delenv('BF_GULP_BATCH')
+    assert resolve_gulp_batch(bf.Pipeline()) == 1
+
+
+# ---------------------------------------------------------------------------
+# the batched-fn builder (sliced mode) and stage classification
+# ---------------------------------------------------------------------------
+
+def test_chain_batch_mode_classification():
+    assert chain_batch_mode([FftStage('fine_time'),
+                             DetectStage('stokes', axis='pol')]) \
+        == 'block'
+
+    class Custom(Stage):
+        pass
+    assert chain_batch_mode([FftStage('fine_time'), Custom()]) \
+        == 'sliced'
+
+
+def test_sliced_batched_fn_matches_per_gulp():
+    """The lax.map sliced path (used when a stage is not provably
+    batch-safe) equals per-gulp application exactly, including the
+    statically-shaped partial tail."""
+    import jax.numpy as jnp
+    G, K_FULL, REM = 8, 3, 5      # 29 frames: 3 full gulps + tail 5
+    n = G * K_FULL + REM
+    x = np.random.RandomState(0).randn(n, 4).astype(np.float32)
+
+    def per_gulp_for_shape(shape):
+        # a fn that depends on the per-gulp shape (cumsum along time)
+        return lambda a: jnp.cumsum(a, axis=0)
+
+    fn = build_batched_fn(per_gulp_for_shape, 0, 0, G,
+                          [(n, 4)], 'sliced')
+    got = np.asarray(fn(jnp.asarray(x)))
+    want = np.concatenate(
+        [np.cumsum(x[i:i + G], axis=0)
+         for i in range(0, n, G)], axis=0)
+    # XLA's cumsum association differs from numpy's in f32 ULPs
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sliced_batched_fn_multi_part_concat():
+    import jax.numpy as jnp
+    G = 4
+    a = np.arange(16, dtype=np.float32).reshape(8, 2)
+    b = np.arange(16, 32, dtype=np.float32).reshape(8, 2)
+
+    def per_gulp_for_shape(shape):
+        return lambda v: v * 2.0
+
+    fn = build_batched_fn(per_gulp_for_shape, 0, 0, G,
+                          [(8, 2), (8, 2)], 'sliced')
+    got = np.asarray(fn(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(
+        got, np.concatenate([a, b], axis=0) * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# impl-proclog republish (executed-path changes)
+# ---------------------------------------------------------------------------
+
+def test_impl_republish_on_executed_path_change():
+    """The published impl record must track the EXECUTED path: donate
+    toggling mid-sequence republishes both ways, and a macro batch
+    engaging publishes its batch fields."""
+    import jax.numpy as jnp
+    from bifrost_tpu.blocks.fused import FusedBlock
+    from bifrost_tpu.ring import Ring
+
+    with bf.Pipeline():
+        ring = Ring(space='tpu')
+        fb = FusedBlock(ring, [DetectStage('stokes', axis='pol')])
+    hdr = simple_header([-1, NP, NF], 'cf32',
+                        labels=['time', 'pol', 'freq'])
+    hdr['gulp_nframe'] = NT
+    fb._headers = [hdr,
+                   fb.stages[0].transform_header(hdr)]
+    x = jnp.zeros((NT, NP, NF, 2), jnp.float32)
+
+    fb._execute_plan(x)
+    base = dict(fb.impl_info)
+    assert 'donate_argnums' not in base
+
+    fb._execute_plan(jnp.zeros_like(x), donate=True)
+    assert fb.impl_info.get('donate_argnums') == [0]
+
+    # toggling BACK must republish the non-donating record (the
+    # regression this satellite fixes: a cached plan key re-executing
+    # must refresh impl_info/_published_impl)
+    fb._execute_plan(x)
+    assert 'donate_argnums' not in fb.impl_info
+    assert fb._published_impl == fb.impl_info
+
+    mx = jnp.zeros((NT * 4, NP, NF, 2), jnp.float32)
+    fb._execute_macro([mx], donate=False, gulp_nframe=NT)
+    assert fb.impl_info.get('batch') == 4
+    assert fb.impl_info.get('batch_mode') == 'block'
+
+
+# ---------------------------------------------------------------------------
+# xfer: batched H2D staging
+# ---------------------------------------------------------------------------
+
+def test_to_device_batch_one_call_k_gulps():
+    from bifrost_tpu import xfer
+    counters.reset()
+    rng = np.random.RandomState(1)
+    gulps = [rng.randn(16, 8).astype(np.float32) for _ in range(4)]
+    before = counters.get('xfer.h2d_issued')
+    out = xfer.to_device_batch(gulps)
+    assert counters.get('xfer.h2d_issued') == before + 1
+    assert counters.get('xfer.h2d_batched') == 4
+    assert out.shape == (4, 16, 8)
+    for i in range(4):
+        np.testing.assert_array_equal(np.asarray(out[i]), gulps[i])
+
+
+def test_to_device_batch_rejects_ragged():
+    from bifrost_tpu import xfer
+    with pytest.raises(ValueError):
+        xfer.to_device_batch([np.zeros((4, 4), np.float32),
+                              np.zeros((4, 5), np.float32)])

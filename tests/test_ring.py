@@ -338,6 +338,210 @@ def test_reserve_after_partial_commit_rejected():
             s1.close()              # barrier applies s1 full, s2 partial
 
 
+# ---------------------------------------------------------------------------
+# multi-gulp (macro) spans — macro-gulp execution reserves/acquires K
+# gulps of ring span in one operation (bifrost_tpu.macro; docs/perf.md).
+# These run against whichever core is active; test_ring_python_core.py
+# re-runs them against the pure-Python core.
+# ---------------------------------------------------------------------------
+
+def test_macro_span_ghost_wrap():
+    """A multi-gulp span that wraps the nominal end must round-trip
+    through the ghost region: every byte written through wrapped macro
+    reserves reads back identically at macro granularity."""
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(4,))
+    NSPAN, MACRO = 5, 16          # 2-gulp macro spans, gulp=8
+    # the guarantee only protects data once the reader attached; gate
+    # the writer so it cannot lap the ring first (same pattern as
+    # test_stress_concurrent_churn)
+    reader_attached = threading.Event()
+
+    def writer():
+        with ring.begin_writing() as wr:
+            # buf 56 = 3.5 macro spans: the span at offset 48 runs to
+            # 64 > 56, crossing the nominal end mid-span — the
+            # commit-side ghost mirror must cover the wrapped MACRO
+            # span's overflow
+            with wr.begin_sequence(hdr, gulp_nframe=MACRO,
+                                   buf_nframe=56) as seq:
+                for k in range(NSPAN):
+                    if k == 1:
+                        assert reader_attached.wait(30)
+                    with seq.reserve(MACRO) as span:
+                        span.data.as_numpy()[...] = \
+                            np.arange(MACRO * 4).reshape(MACRO, 4) \
+                            + 1000 * k
+                        span.commit(MACRO)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    received = []
+    for seq in ring.read(guarantee=True):
+        reader_attached.set()
+        seq.resize(gulp_nframe=MACRO, buffer_factor=3.5)
+        for span in seq.read(MACRO):
+            received.append(np.array(span.data.as_numpy(), copy=True))
+    t.join()
+    assert len(received) == NSPAN
+    for k, arr in enumerate(received):
+        np.testing.assert_array_equal(
+            arr, np.arange(MACRO * 4).reshape(MACRO, 4) + 1000 * k)
+
+
+def test_macro_commit_barrier_k2():
+    """With two outstanding multi-gulp spans committed out of order,
+    the in-order barrier publishes nothing until the FIRST commits —
+    then both land atomically."""
+    ring = Ring(space='system')
+    hdr = _hdr()                   # frame = 4 x f32 = 16 B
+    MACRO = 16
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=MACRO,
+                               buf_nframe=4 * MACRO) as seq:
+            s1 = seq.reserve(MACRO)
+            s2 = seq.reserve(MACRO)
+            s2.data.as_numpy()[...] = 2.0
+            s2.commit(MACRO)
+            s2.close()
+            assert ring.occupancy()['head'] == 0, \
+                "head advanced past an uncommitted earlier macro span"
+            s1.data.as_numpy()[...] = 1.0
+            s1.commit(MACRO)
+            s1.close()
+            assert ring.occupancy()['head'] == 2 * MACRO * 16
+    vals = []
+    for seq in ring.read():
+        for span in seq.read(MACRO):
+            vals.append(float(span.data.as_numpy().ravel()[0]))
+    assert vals == [1.0, 2.0]
+
+
+def test_macro_blocked_acquire_partial_on_eod():
+    """A reader blocked acquiring a full macro span wakes at sequence
+    end with the partial remainder (the macro-gulp partial-batch
+    flush depends on this in both cores)."""
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(2,))
+    MACRO = 16
+    started = threading.Event()
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=MACRO,
+                                   buf_nframe=4 * MACRO) as seq:
+                with seq.reserve(MACRO) as span:
+                    span.data.as_numpy()[...] = 1.0
+                    span.commit(MACRO)
+                started.wait(10)
+                # 1.5 macro spans total: the final half-span is the
+                # partial batch the blocked reader must receive
+                with seq.reserve(MACRO // 2) as span:
+                    span.data.as_numpy()[...] = 2.0
+                    span.commit(MACRO // 2)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    sizes = []
+    for seq in ring.read(guarantee=True):
+        seq.resize(gulp_nframe=MACRO, buffer_factor=4)
+        for span in seq.read(MACRO):
+            sizes.append(span.nframe)
+            started.set()
+    t.join()
+    assert sizes == [MACRO, MACRO // 2]
+
+
+def test_macro_blocked_reserve_wakes_on_poison():
+    """A writer blocked reserving a MACRO span against a pinned
+    guarantee wakes with RingPoisonedError when the ring dies (EOD
+    alone cannot wake a writer; poison must)."""
+    from bifrost_tpu.ring import RingPoisonedError
+    ring = Ring(space='system')
+    hdr = _hdr()
+    MACRO = 16
+    caught = []
+    reader_ready = threading.Event()
+
+    def writer():
+        try:
+            with ring.begin_writing() as wr:
+                with wr.begin_sequence(hdr, gulp_nframe=MACRO,
+                                       buf_nframe=2 * MACRO) as seq:
+                    with seq.reserve(MACRO) as span:
+                        span.data.as_numpy()[...] = 0.0
+                        span.commit(MACRO)
+                    assert reader_ready.wait(10)
+                    for k in range(1, 50):
+                        with seq.reserve(MACRO) as span:
+                            span.data.as_numpy()[...] = float(k)
+                            span.commit(MACRO)
+        except RingPoisonedError as exc:
+            caught.append(exc)
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    with ring.open_earliest_sequence(guarantee=True) as rseq:
+        span = rseq.acquire(0, MACRO)   # pins the guarantee at frame 0
+        reader_ready.set()
+        import time
+        time.sleep(0.3)
+        assert wt.is_alive(), \
+            "writer should be blocked reserving the macro span"
+        ring.poison(RuntimeError("consumer died"))
+        wt.join(5)
+        alive = wt.is_alive()
+        span.release()
+    assert not alive, "poison did not wake the blocked macro reserve"
+    assert caught and 'consumer died' in str(caught[0])
+
+
+def test_device_ring_take_tiling_macro_donation():
+    """Macro-span donation proof: several exclusively-owned per-gulp
+    chunks exactly tiling a macro span are claimed as a list; a
+    foreign (unowned) chunk in the run blocks the claim (Python device
+    core only — device rings never use the native core)."""
+    import jax.numpy as jnp
+    ring = Ring(space='tpu')
+    hdr = _hdr(frame_shape=(4,))
+    frame_nbyte = 16
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=8,
+                               buf_nframe=64) as seq:
+            for k in range(4):
+                with seq.reserve(8) as span:
+                    span.set(jnp.full((8, 4), float(k)), owned=True)
+                    span.commit(8)
+            with ring.open_earliest_sequence(guarantee=True) as rseq:
+                with rseq.acquire(0, 16) as span:
+                    parts = span.take_data(allow_parts=True)
+                    assert isinstance(parts, list) and len(parts) == 2
+                    assert float(np.asarray(parts[0])[0, 0]) == 0.0
+                    assert float(np.asarray(parts[1])[0, 0]) == 1.0
+                # the claimed range is consumed: re-reading it now
+                # zero-fills (single-consumer contract)
+                with rseq.acquire(16, 16) as span2:
+                    # remaining chunks still intact
+                    assert float(np.asarray(
+                        span2.data)[0, 0]) == 2.0
+    # unowned chunk blocks the tiling claim
+    ring2 = Ring(space='tpu')
+    with ring2.begin_writing() as wr:
+        with wr.begin_sequence(_hdr(frame_shape=(4,)), gulp_nframe=8,
+                               buf_nframe=64) as seq:
+            with seq.reserve(8) as span:
+                span.set(jnp.zeros((8, 4)), owned=True)
+                span.commit(8)
+            with seq.reserve(8) as span:
+                span.set(jnp.ones((8, 4)), owned=False)
+                span.commit(8)
+            with ring2.open_earliest_sequence(guarantee=True) as rseq:
+                with rseq.acquire(0, 16) as span:
+                    assert span.take_data(allow_parts=True) is None
+                    # the fallback path still reads the data
+                    assert span.data.shape[0] == 16
+
+
 def test_native_library_selftest():
     """The in-library C++ self-test (reference analogue: bfTestSuite,
     src/testsuite.cpp) passes through the ABI."""

@@ -57,3 +57,15 @@ def test_host_storage_ringlet_grow_preserves_lanes():
     assert not new.buf[1:].any()
 test_reserve_after_partial_commit_rejected = \
     test_ring.test_reserve_after_partial_commit_rejected
+
+# multi-gulp (macro) span semantics must hold identically in the
+# pure-Python core (macro-gulp execution reserves/acquires K gulps per
+# ring operation — bifrost_tpu.macro)
+test_macro_span_ghost_wrap = test_ring.test_macro_span_ghost_wrap
+test_macro_commit_barrier_k2 = test_ring.test_macro_commit_barrier_k2
+test_macro_blocked_acquire_partial_on_eod = \
+    test_ring.test_macro_blocked_acquire_partial_on_eod
+test_macro_blocked_reserve_wakes_on_poison = \
+    test_ring.test_macro_blocked_reserve_wakes_on_poison
+test_device_ring_take_tiling_macro_donation = \
+    test_ring.test_device_ring_take_tiling_macro_donation

@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Macro-gulp batch gate: K=16 must not be slower than K=1 on CPU.
+
+Runs bench_suite config 9 (the config-8 gulp chain at K in {1,4,16}
+macro-gulp batch — bench_suite.bench_gulp_batch) in a fresh subprocess
+pinned to the CPU backend, and asserts:
+
+- ``throughput_ok``  — the K=16 arm's min-of-N wall time is not worse
+  than K=1's by more than ``--threshold`` percent (batched dispatch
+  must never cost throughput where it cannot win it; on the real chip
+  it is the ~6x headroom lever, see docs/perf.md);
+- ``dispatch_ratio_ok`` — the fused block's dispatches/gulp at K=16 is
+  at most 1/8 of the K=1 arm (the amortization actually engaged rather
+  than silently falling back to K=1);
+- ``outputs_identical`` — the batched arms produced byte-identical
+  output streams to K=1.
+
+The arm interleaving / min-of-N noise defenses live inside config 9
+itself (same policy as the observability gate: per-arm minima,
+alternating arm order between repetitions).  The full config result is
+written to the ``--out`` JSON artifact so bench rounds record the
+batch path's health next to the throughput numbers.
+
+Exit codes: 0 pass, 3 a gate condition failed, 2 the bench arm failed
+to produce a result.  ``tools/watch_and_bench.sh`` runs this after the
+observability gate (``BF_SKIP_BATCH_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config9(timeout=1800):
+    """One bench_suite --config 9 subprocess on the CPU backend;
+    returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # a configured global batch would skew the K=1 arm
+    env.pop('BF_GULP_BATCH', None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '9'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'arms' in d:
+            return d
+    raise RuntimeError(
+        'config 9 produced no arms result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1000:], out.stderr[-1000:]))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default='BENCH_BATCH.json',
+                    help='artifact path (full config-9 result + verdict)')
+    ap.add_argument('--threshold', type=float, default=5.0,
+                    help='max allowed K=16 throughput regression vs '
+                         'K=1, percent')
+    ap.add_argument('--timeout', type=float, default=1800.0,
+                    help='bench subprocess timeout in seconds')
+    args = ap.parse_args()
+
+    try:
+        res = run_config9(timeout=args.timeout)
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        print('batch_gate: bench arm failed: %s' % exc,
+              file=sys.stderr)
+        return 2
+
+    t1 = float(res['arms']['K1']['ms_min'])
+    t16 = float(res['arms']['K16']['ms_min'])
+    regression_pct = (t16 / t1 - 1.0) * 100.0 if t1 > 0 else 0.0
+    throughput_ok = regression_pct < args.threshold
+    dispatch_ok = bool(res.get('dispatch_ratio_ok'))
+    outputs_ok = bool(res.get('outputs_identical'))
+    ok = throughput_ok and dispatch_ok and outputs_ok
+    artifact = dict(res,
+                    gate={'regression_pct': round(regression_pct, 2),
+                          'threshold_pct': args.threshold,
+                          'throughput_ok': throughput_ok,
+                          'dispatch_ratio_ok': dispatch_ok,
+                          'outputs_identical': outputs_ok,
+                          'pass': ok,
+                          'round': os.environ.get('BF_BENCH_ROUND',
+                                                  '')})
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write('\n')
+    print('batch_gate: K1 %.1fms / K16 %.1fms -> %+.2f%% '
+          '(threshold %.1f%%), dispatches/gulp %.4f -> %.4f, '
+          'outputs_identical=%s %s'
+          % (t1, t16, regression_pct, args.threshold,
+             res['arms']['K1']['dispatches_per_gulp'],
+             res['arms']['K16']['dispatches_per_gulp'],
+             outputs_ok, 'PASS' if ok else 'FAIL'))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

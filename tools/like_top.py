@@ -12,13 +12,15 @@ Panes (matching the reference's information set):
     that core, total/acquire/process/reserve perf times, gulp-latency
     p50/p99 and ring-wait p99 (ms, from the telemetry histograms each
     block publishes into its perf ProcLog — docs/observability.md),
+    G/D = logical gulps per dispatch (1.0 unbatched; ~K when
+    macro-gulp execution is amortizing dispatch — docs/perf.md),
     command line
 
 Interactive curses UI with the reference's sort keys (i=pid, b=name,
 c=core, t=total, a=acquire, p=process, r=reserve, plus l=p99 gulp
-latency and w=p99 ring wait; pressing the active key again reverses;
-q quits).  ``--once`` prints one plain-text snapshot instead (usable
-in pipes/tests).
+latency, w=p99 ring wait, and g=gulps-per-dispatch; pressing the
+active key again reverses; q quits).  ``--once`` prints one
+plain-text snapshot instead (usable in pipes/tests).
 """
 
 import argparse
@@ -183,7 +185,10 @@ def collect_blocks(pids=None):
                 # latency-histogram columns (seconds; rendered as ms)
                 'p50': max(0.0, _num(perf.get('gulp_p50'))),
                 'p99': max(0.0, _num(perf.get('gulp_p99'))),
-                'wait99': max(0.0, _num(perf.get('ring_wait_p99')))}
+                'wait99': max(0.0, _num(perf.get('ring_wait_p99'))),
+                # macro-gulp amortization: logical gulps per dispatch
+                # (1.0 unbatched; K when macro-gulp execution engaged)
+                'gpd': max(0.0, _num(perf.get('gulps_per_dispatch')))}
     return rows
 
 
@@ -222,9 +227,10 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
                    % (dev['memTotal'], dev['memUsed'], dev['memFree'],
                       dev['devCount']))
     out.append('')
-    hdr = '%6s  %-24s  %4s  %5s  %8s  %8s  %8s  %8s  %8s  %8s  %8s  Cmd' \
+    hdr = '%6s  %-24s  %4s  %5s  %8s  %8s  %8s  %8s  %8s  %8s  %8s' \
+          '  %5s  Cmd' \
         % ('PID', 'Block', 'Core', '%CPU', 'Total', 'Acquire',
-           'Process', 'Reserve', 'p50(ms)', 'p99(ms)', 'Wait99')
+           'Process', 'Reserve', 'p50(ms)', 'p99(ms)', 'Wait99', 'G/D')
     out.append(hdr)
     order = sorted(rows, key=lambda k: rows[k][sort_key],
                    reverse=sort_rev)
@@ -236,18 +242,18 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
             pct = '%5s' % ' '
         name = d['name'].split('/')[-1][:24]
         out.append('%6i  %-24s  %4s  %5s  %8.3f  %8.3f  %8.3f  %8.3f'
-                   '  %8.2f  %8.2f  %8.2f  %s'
+                   '  %8.2f  %8.2f  %8.2f  %5.1f  %s'
                    % (d['pid'], name, d['core'], pct, d['total'],
                       d['acquire'], d['process'], d['reserve'],
                       d['p50'] * 1e3, d['p99'] * 1e3,
-                      d['wait99'] * 1e3,
-                      d['cmd'][:max(width - 126, 0)]))
+                      d['wait99'] * 1e3, d['gpd'],
+                      d['cmd'][:max(width - 133, 0)]))
     return out
 
 
 _SORT_KEYS = {'i': 'pid', 'b': 'name', 'c': 'core', 't': 'total',
               'a': 'acquire', 'p': 'process', 'r': 'reserve',
-              'l': 'p99', 'w': 'wait99'}
+              'l': 'p99', 'w': 'wait99', 'g': 'gpd'}
 
 
 def run_curses(args):

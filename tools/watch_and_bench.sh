@@ -15,6 +15,9 @@
 #   BENCH_SUITE_${ROUND}.json - per-config detail written by run_suite_into
 #   BENCH_OBS_${ROUND}.json   - observability overhead gate (config 8 with
 #                               spans on vs off; tools/obs_overhead.py)
+#   BENCH_BATCH_${ROUND}.json - macro-gulp batch gate (config 9 on CPU:
+#                               K=16 >= K=1 min-of-N, alternating arm
+#                               order; tools/batch_gate.py)
 #   bench_watch.log           - probe/attempt history (gitignored)
 cd "$(dirname "$0")/.." || exit 1
 ROUND="${BF_BENCH_ROUND:-r$(date -u +%Y%m%d)}"
@@ -70,6 +73,20 @@ for i in $(seq 1 400); do
         if [ "$orc" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) observability overhead gate FAILED" >> "$LOG"
           exit "$orc"
+        fi
+      fi
+      # Macro-gulp batch gate: config 9 on the CPU backend — K=16 must
+      # not regress vs K=1 (min-of-N, alternating arm order) and the
+      # dispatch amortization must actually engage.  A failure exits
+      # nonzero (the capture artifacts above are already in place).
+      if [ "${BF_SKIP_BATCH_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) macro-gulp batch gate (config 9, CPU)" >> "$LOG"
+        python tools/batch_gate.py --out "BENCH_BATCH_${ROUND}.json" >> "$LOG" 2>&1
+        grc=$?
+        echo "$(date -u +%FT%TZ) batch gate rc=$grc" >> "$LOG"
+        if [ "$grc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) macro-gulp batch gate FAILED" >> "$LOG"
+          exit "$grc"
         fi
       fi
       exit 0
