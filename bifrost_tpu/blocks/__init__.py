@@ -33,3 +33,5 @@ from .convert_visibilities import (ConvertVisibilitiesBlock,
 from .psrdada import (DadaFileSourceBlock, read_dada_file,
                       read_psrdada_buffer)
 from .audio import read_audio
+from .bridge import (BridgeSink, BridgeSource, bridge_sink,
+                     bridge_source)

@@ -1,0 +1,240 @@
+"""Bridge blocks: run the DCN ring bridge (io.bridge, wire format v2 —
+docs/networking.md) INSIDE a pipeline, so the inter-host hop
+participates in supervision (restart policies, poison propagation,
+clean MSG_END on shutdown) and telemetry (``bridge.tx/rx.*`` counters,
+send-stall / recv-wait histograms, a like_bmon bridge row) like any
+other block.
+
+- :class:`BridgeSink` reads its input ring and pumps it to a remote
+  :class:`BridgeSource` over ``nstreams`` striped TCP connections with
+  a ``window``-span credit pipeline.  Transient dial failures and
+  mid-stream drops are redialed with the shared io backoff
+  (``retry_transient``) and unacked spans retransmitted; permanent
+  failure raises and the supervisor applies the block's ``on_failure``
+  policy.
+
+- :class:`BridgeSource` listens, accepts the sender (re-accepting
+  across reconnects), and writes the stream into its output ring.
+  Sender death without a clean MSG_END and exhausted reconnect budgets
+  poison the output ring so downstream blocks fail fast instead of
+  waiting on a stream that can never complete.
+
+Typical topology (sender host / receiver host)::
+
+    # host A
+    bf.blocks.bridge_sink(producer, 'hostB', 9000)
+    # host B
+    src = bf.blocks.bridge_source('0.0.0.0', 9000)
+    ... = bf.blocks.copy(src, space='tpu')
+"""
+
+from __future__ import annotations
+
+from ..pipeline import Block
+from ..proclog import ProcLog
+from ..io.bridge import (RingSender, RingReceiver, BridgeListener,
+                         connect_striped, bridge_streams,
+                         bridge_window, bridge_crc)
+# one knob for all transient-socket budgets: BF_IO_RETRY_MAX (default
+# 8) is both the dial-retry budget and the reconnect budget here
+from ..io.udp_socket import _retry_budget as _reconnect_budget
+
+__all__ = ['BridgeSink', 'BridgeSource', 'bridge_sink', 'bridge_source']
+
+
+class _BridgeBlock(Block):
+    """Shared supervision plumbing for the bridge endpoints."""
+
+    def _release_init_barrier(self):
+        """Bridge endpoints check in at the pipeline init barrier
+        immediately and DO NOT park on it: their sequences come from
+        (or go to) the network, so downstream blocks can only open
+        their inputs — and complete the barrier — once the bridge is
+        already moving data.  (A file SourceBlock gets the same effect
+        by creating its output sequence before parking.)"""
+        self.pipeline.block_init_queue.put((self, True))
+        self.heartbeat()
+
+    def _record_reconnect(self, exc):
+        """Surface a non-fatal transport reconnect to the supervisor's
+        failure record (kind='reconnected') so operators see flapping
+        links in the pipeline's failure history, not just a counter."""
+        supervisor = getattr(self.pipeline, 'supervisor', None)
+        if supervisor is not None:
+            from ..supervision import BlockFailure
+            supervisor.record(BlockFailure(self.name, exc,
+                                           kind='reconnected',
+                                           fatal=False))
+
+
+class BridgeSink(_BridgeBlock):
+    """1-in/0-out block pumping its input ring to a remote
+    BridgeSource (io.bridge.RingSender under Pipeline supervision).
+
+    ``nstreams``/``window``/``crc`` default to ``BF_BRIDGE_STREAMS`` /
+    ``BF_BRIDGE_WINDOW`` / ``BF_BRIDGE_CRC``; the macro-gulp scope
+    tunable (``gulp_batch`` / ``BF_GULP_BATCH``) makes the sender ship
+    K gulps per frame.  ``protocol=1`` negotiates down to the legacy
+    v1 wire for old receivers.
+    """
+
+    def __init__(self, iring, address, port, nstreams=None, window=None,
+                 crc=None, guarantee=True, protocol=None,
+                 connect_timeout=10.0, reconnect_max=None,
+                 *args, **kwargs):
+        super(BridgeSink, self).__init__([iring], *args, **kwargs)
+        self.orings = []
+        self.iring = self.irings[0]
+        self.guarantee = guarantee
+        self.address = address
+        self.port = int(port)
+        self.nstreams = bridge_streams() if nstreams is None \
+            else max(int(nstreams), 1)
+        self.window = bridge_window() if window is None \
+            else max(int(window), 1)
+        self.crc = bridge_crc() if crc is None else bool(crc)
+        self.protocol = protocol
+        self.connect_timeout = float(connect_timeout)
+        self.reconnect_max = _reconnect_budget() if reconnect_max is None \
+            else int(reconnect_max)
+        self._sender = None
+        self.out_proclog = ProcLog(self.name + '/out')
+        self.out_proclog.update({'nring': 0})
+
+    def _define_valid_input_spaces(self):
+        # the bridge exports raw host bytes; device rings have no
+        # host-resident span view to frame
+        return ['system']
+
+    def _connect(self):
+        return connect_striped(self.address, self.port, self.nstreams,
+                               timeout=self.connect_timeout)
+
+    def _reconnect(self):
+        exc = ConnectionError("bridge link to %s:%d dropped; redialing"
+                              % (self.address, self.port))
+        self._record_reconnect(exc)
+        return self._connect()
+
+    def main(self, orings):
+        from ..macro import resolve_gulp_batch
+        sender = RingSender(
+            self.iring,
+            gulp_nframe=self.gulp_nframe,
+            guarantee=self.guarantee,
+            protocol=1 if self.protocol == 1 else 2,
+            window=self.window, crc=self.crc,
+            gulp_batch=resolve_gulp_batch(self),
+            naive=False,
+            dial=self._connect,
+            reconnect=self._reconnect,
+            reconnect_max=self.reconnect_max,
+            shutdown_event=self.shutdown_event,
+            heartbeat=self.heartbeat,
+            name=self.name)
+        self._sender = sender
+        # When the producing block lives in THIS pipeline, pin the read
+        # guarantee BEFORE checking in at the init barrier: the producer
+        # creates its output sequence and only starts committing gulps
+        # after the barrier completes, so no frame can be overwritten
+        # while the bridge is still dialing.  An externally-fed ring may
+        # never produce a sequence before the barrier — check in first
+        # there and accept the attach-to-live-stream race instead.
+        base = getattr(self.iring, '_base_ring', self.iring)
+        producer = getattr(base, 'owner', None)
+        if producer is not None and producer in self.pipeline.blocks:
+            sender.prime()
+        self._release_init_barrier()
+        try:
+            sender.run()
+        finally:
+            sender.close()
+
+    def define_output_nframes(self, input_nframes):
+        return []
+
+
+class BridgeSource(_BridgeBlock):
+    """0-in/1-out block receiving a bridged stream into its output
+    ring (io.bridge.RingReceiver under Pipeline supervision).
+
+    The listening socket binds at CONSTRUCTION time (``self.port``
+    carries the resolved port for ``port=0`` test topologies).  A
+    dropped sender is re-accepted up to ``reconnect_max`` times with
+    the stream state preserved (resume by frame sequence number);
+    exhaustion raises, and the supervisor poisons the output ring.
+    """
+
+    def __init__(self, address, port, space='system', crc=None,
+                 reconnect_max=None, *args, **kwargs):
+        super(BridgeSource, self).__init__([], *args, **kwargs)
+        self.orings = [self.create_ring(space=space)]
+        self.listener = BridgeListener(address, port)
+        self.address = self.listener.address
+        self.port = self.listener.port
+        self.crc = crc
+        self.reconnect_max = _reconnect_budget() if reconnect_max is None \
+            else int(reconnect_max)
+        self.out_proclog = ProcLog(self.name + '/out')
+        rnames = {'nring': len(self.orings)}
+        for i, r in enumerate(self.orings):
+            rnames['ring%i' % i] = r.name
+        self.out_proclog.update(rnames)
+        self._receiver = None
+
+    def _define_valid_input_spaces(self):
+        return []
+
+    def main(self, orings):
+        self._release_init_barrier()
+        # a restarted main (on_failure='restart') re-binds the SAME
+        # resolved port: the constructor's listener was closed by the
+        # previous attempt's finally
+        if self.listener is None:
+            self.listener = BridgeListener(self.address, self.port)
+        # the RECEIVER persists across supervisor restarts: its
+        # protocol state (expected frame seqno, session, open output
+        # sequence) is what lets a still-alive sender redial and
+        # RESUME instead of hitting a sequence-gap protocol error
+        if self._receiver is None:
+            self._receiver = RingReceiver(
+                self.listener, self.orings[0], writer=orings[0],
+                crc=self.crc, poison_on_error=False,
+                heartbeat=self.heartbeat,
+                stop_event=self.shutdown_event, name=self.name)
+        else:
+            self._receiver.sock = self.listener
+        receiver = self._receiver
+        attempts = 0
+        try:
+            while True:
+                try:
+                    receiver.run()
+                    return            # clean MSG_END
+                except (ConnectionError, OSError) as exc:
+                    # (BridgeProtocolError is a RuntimeError, not an
+                    # OSError — protocol violations propagate as fatal)
+                    if self.shutdown_event.is_set():
+                        return
+                    attempts += 1
+                    if attempts > self.reconnect_max:
+                        raise
+                    # sender dropped mid-stream: re-accept and resume
+                    # (retransmitted frames dedup by sequence number)
+                    self._record_reconnect(exc)
+        finally:
+            self.listener.close()
+            self.listener = None
+
+    def define_output_nframes(self, input_nframes):
+        return []
+
+
+def bridge_sink(iring, address, port, *args, **kwargs):
+    """Pipeline helper: pump ``iring`` to a remote bridge_source."""
+    return BridgeSink(iring, address, port, *args, **kwargs)
+
+
+def bridge_source(address, port, *args, **kwargs):
+    """Pipeline helper: receive a bridged stream into a new ring."""
+    return BridgeSource(address, port, *args, **kwargs)

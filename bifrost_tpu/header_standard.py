@@ -8,7 +8,12 @@ observation fields so blocks can interoperate.
 
 from __future__ import annotations
 
-__all__ = ['STANDARD_HEADER_FIELDS', 'enforce_header_standard']
+import json
+
+import numpy as np
+
+__all__ = ['STANDARD_HEADER_FIELDS', 'enforce_header_standard',
+           'serialize_header', 'deserialize_header']
 
 # field -> required type(s)
 STANDARD_HEADER_FIELDS = {
@@ -20,6 +25,34 @@ STANDARD_HEADER_FIELDS = {
     'tstart': (int, float),
     'tsamp': (int, float),
 }
+
+
+def _json_default(obj):
+    """JSON coercions for the numpy-typed values that header transforms
+    and capture engines commonly leave in sequence headers: scalars
+    become native Python numbers, arrays become (nested) lists.  A bare
+    ``json.dumps(dict(seq.header))`` raises TypeError on these."""
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError("header value of type %s is not JSON-serializable"
+                    % type(obj).__name__)
+
+
+def serialize_header(header):
+    """Serialize a sequence header to UTF-8 JSON bytes, coercing numpy
+    scalars/arrays to native JSON types.  This is the ONE header
+    serializer for wire transports (io.bridge) and file sinks — use it
+    instead of ``json.dumps(dict(header)).encode()``."""
+    return json.dumps(header, default=_json_default).encode()
+
+
+def deserialize_header(payload):
+    """Inverse of :func:`serialize_header` (accepts bytes or str)."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        payload = bytes(payload).decode()
+    return json.loads(payload)
 
 
 def enforce_header_standard(header):

@@ -39,7 +39,7 @@ def _retry_backoff():
         return 0.005
 
 
-def retry_transient(fn, budget=None, backoff=None):
+def retry_transient(fn, budget=None, backoff=None, extra=()):
     """Run ``fn()`` retrying transient socket errnos (EINTR /
     ECONNREFUSED) with exponential backoff, up to a capped budget
     (``BF_IO_RETRY_MAX``, default 8; base ``BF_IO_RETRY_BACKOFF``
@@ -47,7 +47,9 @@ def retry_transient(fn, budget=None, backoff=None):
     ``io.socket_retries`` telemetry counter; budget exhaustion
     re-raises the last error.  EAGAIN/EWOULDBLOCK are NOT retried here
     — on a nonblocking/timeout socket they mean "no data", which
-    callers handle as a normal condition."""
+    callers handle as a normal condition.  ``extra`` names additional
+    errnos the CALLER knows are transient in its context (the TCP ring
+    bridge retries ETIMEDOUT on cross-host dials, io/bridge.py)."""
     if budget is None:
         budget = _retry_budget()
     if backoff is None:
@@ -57,7 +59,8 @@ def retry_transient(fn, budget=None, backoff=None):
         try:
             return fn()
         except OSError as e:
-            if e.errno not in _TRANSIENT_ERRNOS:
+            if e.errno not in _TRANSIENT_ERRNOS and \
+                    e.errno not in extra:
                 raise
             attempt += 1
             if attempt > budget:

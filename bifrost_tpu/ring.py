@@ -480,6 +480,20 @@ class Ring(object):
         self._seq_by_name = {}
         self._open_wspans = []        # in reserve order
         self._guarantees = {}         # id(ReadSequence) -> abs offset
+        #: id(ReadSequence) -> begin offsets of that reader's OPEN
+        #: spans.  A guaranteed reader holding several spans (the
+        #: bridge's credit window keeps spans un-released until the
+        #: peer acks their bytes) pins the guarantee at the OLDEST
+        #: open span — the reference refcount-locks the tail per span
+        #: (ring_impl.hpp:110-141); a bare watermark would let a later
+        #: acquire unlock bytes an earlier open span still exports
+        #: zero-copy.
+        self._open_reads = {}
+        #: id(ReadSequence) -> highest span begin that reader ever
+        #: RELEASED: out-of-order releases (acquire 0 and 8, release
+        #: 8 then 0) must advance the guarantee to the high-water
+        #: mark once no span is open, not to the last-released begin
+        self._release_high = {}
         self._writing = False
         self._eod = False
         self._nwrite_open = 0
@@ -678,7 +692,13 @@ class Ring(object):
     def _reader_moved(self, rseq, new_seq):
         if rseq.guarantee:
             with self._lock:
-                self._guarantees[id(rseq)] = max(new_seq.begin, self._tail)
+                g = max(new_seq.begin, self._tail)
+                # never unlock bytes a still-open span of the previous
+                # sequence is exporting
+                opens = self._open_reads.get(id(rseq))
+                if opens:
+                    g = min(g, min(opens))
+                self._guarantees[id(rseq)] = g
 
     def _reserve_span(self, nbyte, nonblocking=False, span=None):
         with self._lock:
@@ -830,7 +850,9 @@ class Ring(object):
         with self._lock:
             self._check_poison()
             want_begin = seq.begin + offset
-            if rseq.guarantee:
+            # pre-wait bump: only when no span is open — an open span's
+            # begin already bounds the guarantee and must keep doing so
+            if rseq.guarantee and not self._open_reads.get(id(rseq)):
                 self._guarantees[id(rseq)] = max(
                     self._guarantees.get(id(rseq), want_begin),
                     min(want_begin, self._head))
@@ -857,16 +879,36 @@ class Ring(object):
                 skip = -(-skip // frame_nbyte) * frame_nbyte
                 begin = min(begin + skip, end)
             if rseq.guarantee:
-                self._guarantees[id(rseq)] = begin
-                # (no overwrite possible beyond here until released)
+                opens = self._open_reads.setdefault(id(rseq), [])
+                opens.append(begin)
+                # guarantee = oldest open span (never jumps past a
+                # held span; no overwrite beyond it until released);
+                # an ADVANCE frees writer space, so notify
+                g = min(opens)
+                if g > self._guarantees.get(id(rseq), g):
+                    self._write_cond.notify_all()
+                self._guarantees[id(rseq)] = g
             self._nread_open += 1
             return begin, max(end - begin, 0)
 
     def _release_span(self, rseq, span_begin):
         with self._lock:
             if rseq.guarantee and id(rseq) in self._guarantees:
+                opens = self._open_reads.get(id(rseq))
+                if opens:
+                    try:
+                        opens.remove(span_begin)
+                    except ValueError:
+                        pass
+                rh = max(self._release_high.get(id(rseq), 0),
+                         span_begin)
+                self._release_high[id(rseq)] = rh
+                # advance to the oldest still-open span, else to the
+                # high-water released span (out-of-order releases must
+                # not park the guarantee at an already-released begin)
+                g = min(opens) if opens else rh
                 self._guarantees[id(rseq)] = max(
-                    self._guarantees[id(rseq)], span_begin)
+                    self._guarantees[id(rseq)], g)
             self._nread_open -= 1
             self._write_cond.notify_all()
             self._span_cond.notify_all()
@@ -874,6 +916,8 @@ class Ring(object):
     def _close_read_seq(self, rseq):
         with self._lock:
             self._guarantees.pop(id(rseq), None)
+            self._open_reads.pop(id(rseq), None)
+            self._release_high.pop(id(rseq), None)
             self._write_cond.notify_all()
 
     def _overwritten_in(self, begin, nbyte):
@@ -1176,6 +1220,21 @@ class _SpanAPI(object):
     @property
     def dtype(self):
         return self.tensor['dtype']
+
+    def lane_memoryviews(self):
+        """Zero-copy byte views over this span's ring storage, one
+        contiguous ``memoryview`` per ringlet lane in ringlet-major
+        order (the bridge wire layout).  Host rings only — returns
+        ``None`` for device ('tpu') rings and empty spans.  Works on
+        BOTH cores (the native storage also exposes per-lane
+        contiguous numpy views).  The views alias the ring buffer:
+        they are valid only while the span is open, and writable for
+        write spans (``recv_into`` targets) as well as read spans
+        (vectored ``sendmsg`` sources)."""
+        if self._ring.space == 'tpu' or not self._nbyte:
+            return None
+        raw = self._ring._storage.read_view(self._begin, self._nbyte)
+        return [memoryview(raw[i]) for i in range(raw.shape[0])]
 
     def _host_view(self, writeable):
         """Zero-copy strided numpy view over the ring buffer, shaped

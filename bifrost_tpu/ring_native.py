@@ -265,8 +265,10 @@ class NativeRing(Ring):
             with self._lock:
                 rids = list(self._native_reader_ids)
             for rid in rids:
+                # mode 2: force past open spans (a held span must not
+                # keep a blocked writer waiting on a dead ring)
                 self._lib.bft_reader_set_guarantee(
-                    self._handle, rid, head.value, 1)
+                    self._handle, rid, head.value, 2)
         except Exception:
             pass
 

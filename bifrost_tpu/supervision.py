@@ -79,7 +79,9 @@ def _env_int(name, default):
 class BlockFailure(object):
     """One recorded failure: which block, what was raised, the formatted
     traceback, and whether it was fatal to the pipeline (``kind`` is
-    'error', 'restarted', 'skipped', 'poisoned', or 'stall')."""
+    'error', 'restarted', 'skipped', 'poisoned', 'reconnected', or
+    'stall' — 'reconnected' records a bridge endpoint's non-fatal
+    transport redial, blocks/bridge.py)."""
 
     __slots__ = ('block_name', 'exc', 'traceback', 'when', 'kind',
                  'fatal', 'restarts')

@@ -43,6 +43,29 @@ by :func:`bifrost_tpu.telemetry.flush`):
                                            (EINTR/ECONNREFUSED) retried
                                            with backoff
 
+Ring-bridge counters (io/bridge.py wire v2 — docs/networking.md):
+
+- ``bridge.tx.frames`` / ``bridge.tx.bytes`` /
+  ``bridge.tx.spans``                      frames/payload bytes/span
+                                           frames sent by RingSender
+- ``bridge.tx.reconnects``                 sender-side transport
+                                           redials (unacked frames
+                                           retransmitted)
+- ``bridge.rx.frames`` / ``bridge.rx.bytes`` /
+  ``bridge.rx.spans``                      frames/bytes/spans committed
+                                           by RingReceiver
+- ``bridge.rx.dups``                       retransmitted frames dropped
+                                           by sequence number after a
+                                           reconnect
+- ``bridge.rx.crc_errors``                 span CRC32 mismatches
+                                           (BF_BRIDGE_CRC=1); each one
+                                           raises BridgeProtocolError
+
+(Send-stall / recv-wait distributions live on the
+``bridge.<name>.send_stall_s`` / ``bridge.<name>.recv_wait_s``
+histograms; per-endpoint byte totals also feed the like_bmon bridge
+rows via ``<name>_bridge_transmit|capture/stats`` proclogs.)
+
 Observability counters (docs/observability.md; complemented by
 :mod:`bifrost_tpu.telemetry.histograms` for distributions):
 

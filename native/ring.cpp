@@ -34,6 +34,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -72,6 +73,18 @@ struct Reader {
     int64_t id = 0;
     bool guarantee = true;
     int64_t guarantee_offset = 0;   // only meaningful if guarantee
+    // Begin offsets of this reader's OPEN spans.  A guaranteed reader
+    // with several spans outstanding (the bridge's credit window holds
+    // spans un-released until the peer acks them) must keep the
+    // guarantee at the OLDEST open span — the reference refcount-locks
+    // the tail per span (ring_impl.hpp:110-141); a bare watermark
+    // would let a later acquire unlock bytes an earlier open span is
+    // still exporting zero-copy.
+    std::multiset<int64_t> open_spans;
+    // Highest span begin ever RELEASED: out-of-order releases must
+    // advance the guarantee to this high-water mark once no span is
+    // open, not to the last-released begin.
+    int64_t release_high = 0;
 };
 
 // Bind freshly allocated ring pages to the NUMA node of `core` via the
@@ -498,6 +511,12 @@ int bft_reader_set_guarantee(void* ring_, long long reader_id,
     auto it = r->readers.find(reader_id);
     if (it == r->readers.end()) return BFT_ERR_INVALID;
     Reader* rd = it->second.get();
+    // a sequence move (reader_moved) must not unlock bytes a still-open
+    // span of the previous sequence is exporting; mode 2 (the poison
+    // wakeup) forces past open spans — the ring is dead and blocked
+    // writers must be released
+    if (clamp_forward_only != 2 && !rd->open_spans.empty())
+        offset = std::min<long long>(offset, *rd->open_spans.begin());
     if (clamp_forward_only && offset < rd->guarantee_offset)
         return BFT_OK;
     rd->guarantee_offset = std::max<int64_t>(offset, 0);
@@ -583,7 +602,9 @@ int bft_reader_acquire(void* ring_, long long reader_id, void* seq_,
     };
     {
         Reader* rd = find_reader();
-        if (rd && rd->guarantee) {
+        // pre-wait bump: only when no span is open — an open span's
+        // begin already bounds the guarantee and must keep doing so
+        if (rd && rd->guarantee && rd->open_spans.empty()) {
             int64_t g = std::min<int64_t>(want_begin, r->head);
             if (g > rd->guarantee_offset) rd->guarantee_offset = g;
         }
@@ -614,7 +635,14 @@ int bft_reader_acquire(void* ring_, long long reader_id, void* seq_,
         begin = std::min<int64_t>(begin + skip, end);
     }
     Reader* rd = find_reader();   // re-lookup: may have been destroyed
-    if (rd && rd->guarantee) rd->guarantee_offset = begin;
+    if (rd && rd->guarantee) {
+        rd->open_spans.insert(begin);
+        // guarantee = oldest open span (never jumps past a held
+        // span); an ADVANCE frees writer space, so notify
+        int64_t g = *rd->open_spans.begin();
+        if (g > rd->guarantee_offset) r->write_cv.notify_all();
+        rd->guarantee_offset = g;
+    }
     int64_t got = std::max<int64_t>(end - begin, 0);
     if (got > 0) r->ghost_read_locked(begin, got);
     r->nread_open += 1;
@@ -631,8 +659,18 @@ int bft_reader_release(void* ring_, long long reader_id,
     auto it = r->readers.find(reader_id);
     if (it != r->readers.end()) {
         Reader* rd = it->second.get();
-        if (rd->guarantee && span_begin > rd->guarantee_offset)
-            rd->guarantee_offset = span_begin;
+        if (rd->guarantee) {
+            auto os = rd->open_spans.find(span_begin);
+            if (os != rd->open_spans.end()) rd->open_spans.erase(os);
+            if (span_begin > rd->release_high)
+                rd->release_high = span_begin;
+            // advance to the oldest still-open span, else to the
+            // high-water RELEASED span (out-of-order releases must
+            // not park the guarantee at an already-released begin)
+            int64_t g = rd->open_spans.empty()
+                        ? rd->release_high : *rd->open_spans.begin();
+            if (g > rd->guarantee_offset) rd->guarantee_offset = g;
+        }
     }
     r->nread_open -= 1;
     r->write_cv.notify_all();

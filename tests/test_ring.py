@@ -549,3 +549,111 @@ def test_native_library_selftest():
     if not native.available():
         pytest.skip('native library unavailable')
     assert native.load().bft_selftest() == 0
+
+
+def test_multi_open_spans_pin_guarantee():
+    """A guaranteed reader holding SEVERAL open spans (the bridge's
+    credit window keeps spans un-released until the peer acks their
+    bytes) pins the guarantee at the OLDEST open span: the writer must
+    not overwrite a held span's bytes, in either core (the reference
+    refcount-locks the tail per span, ring_impl.hpp:110-141)."""
+    ring = Ring(space='system')
+    hdr = _hdr()
+    wrote = threading.Event()
+    reader_ready = threading.Event()
+    done = threading.Event()
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=8,
+                                   buf_nframe=32) as seq:
+                for k in range(12):
+                    with seq.reserve(8) as span:
+                        span.data.as_numpy()[...] = float(k)
+                        span.commit(8)
+                    if k == 3:
+                        # buffer full; hold until the reader's spans
+                        # are pinned so the lap attempt races nothing
+                        wrote.set()
+                        assert reader_ready.wait(10)
+        done.set()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    with ring.open_earliest_sequence(guarantee=True) as rseq:
+        assert wrote.wait(10)
+        spans = [rseq.acquire(k * 8, 8) for k in range(3)]
+        reader_ready.set()
+        # the writer wants to lap the 32-frame ring; the three held
+        # spans (frames 0..24) must pin the tail at frame 0
+        assert not done.wait(0.4), \
+            "writer lapped the ring over held read spans"
+        for k, span in enumerate(spans):
+            np.testing.assert_array_equal(
+                np.asarray(span.data.as_numpy()),
+                np.full((8, 4), float(k), np.float32))
+        # releasing the spans returns write credit
+        for span in spans:
+            span.release()
+    # (closing the read sequence drops the remaining guarantee so the
+    # writer can lap freely and finish)
+    assert done.wait(10), "writer still blocked after release"
+    t.join(5)
+
+
+def test_open_span_survives_later_acquires():
+    """Acquiring a NEWER span must not unprotect an older still-open
+    one (the historical watermark semantics did)."""
+    ring = Ring(space='system')
+    hdr = _hdr()
+
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=4,
+                               buf_nframe=16) as seq:
+            for k in range(4):
+                with seq.reserve(4) as span:
+                    span.data.as_numpy()[...] = float(k)
+                    span.commit(4)
+            with ring.open_earliest_sequence(guarantee=True) as rseq:
+                first = rseq.acquire(0, 4)
+                later = rseq.acquire(8, 4)
+                # the ring is full (16/16 frames): another gulp would
+                # need to reclaim frames 0..4, which the held FIRST
+                # span forbids even though a LATER acquire moved past
+                # it (the old watermark semantics allowed this)
+                from bifrost_tpu.ring import WouldBlock
+                with pytest.raises(WouldBlock):
+                    seq.reserve(4, nonblocking=True)
+                first.release()
+                # with only the later span (frames 8..12) open, one
+                # gulp of tail reclaim is legal again
+                with seq.reserve(4, nonblocking=True) as span:
+                    span.commit(0)
+                later.release()
+
+
+def test_out_of_order_span_release_frees_writer():
+    """Releasing held spans OUT of acquisition order (the bridge's
+    striped acks can complete newest-first) must advance the guarantee
+    to the released high-water mark once nothing is open — parking it
+    at the last-released begin deadlocks the writer."""
+    ring = Ring(space='system')
+    hdr = _hdr()
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=4,
+                               buf_nframe=16) as seq:
+            for k in range(4):
+                with seq.reserve(4) as span:
+                    span.data.as_numpy()[...] = float(k)
+                    span.commit(4)
+            with ring.open_earliest_sequence(guarantee=True) as rseq:
+                first = rseq.acquire(0, 4)
+                later = rseq.acquire(8, 4)
+                later.release()          # newest first
+                first.release()
+                # both released: frames 0..12 are reclaimable — two
+                # more gulps must fit without blocking
+                with seq.reserve(4, nonblocking=True) as span:
+                    span.commit(4)
+                with seq.reserve(4, nonblocking=True) as span:
+                    span.commit(0)

@@ -69,3 +69,12 @@ test_macro_blocked_reserve_wakes_on_poison = \
     test_ring.test_macro_blocked_reserve_wakes_on_poison
 test_device_ring_take_tiling_macro_donation = \
     test_ring.test_device_ring_take_tiling_macro_donation
+
+# credit-window span holds (io.bridge): the guarantee must pin at the
+# oldest OPEN span in the pure-Python core exactly like the native one
+test_multi_open_spans_pin_guarantee = \
+    test_ring.test_multi_open_spans_pin_guarantee
+test_open_span_survives_later_acquires = \
+    test_ring.test_open_span_survives_later_acquires
+test_out_of_order_span_release_frees_writer = \
+    test_ring.test_out_of_order_span_release_frees_writer

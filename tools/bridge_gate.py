@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Ring-bridge wire gate: wire v2 must not be slower than the naive v1
+pump, and both arms must move bytes losslessly.
+
+Runs bench_suite config 10 (loopback ring->TCP->ring pump: the seed
+implementation's copying v1 sender/receiver vs the zero-copy windowed
+v2 wire — bench_suite.bench_bridge) in a fresh subprocess pinned to
+the CPU backend, and asserts:
+
+- ``throughput_ok``     — the v2 arm's min-of-N wall time is not worse
+  than naive v1's by more than ``--threshold`` percent (default 0: the
+  pipelined wire must never cost throughput; the acceptance target for
+  this machine class is >= 2x the naive arm, recorded in the artifact);
+- ``outputs_identical`` — every received span in BOTH arms memcmp'd
+  equal to the source gulp (a faster wire that corrupts or drops data
+  must fail the gate, not pass silently).
+
+The arm interleaving / min-of-N noise defenses live inside config 10
+itself (same policy as the observability and batch gates: per-arm
+minima, alternating arm order between repetitions).  The full config
+result is written to the ``--out`` JSON artifact so bench rounds record
+the bridge path's health next to the throughput numbers.
+
+Exit codes: 0 pass, 3 a gate condition failed, 2 the bench arm failed
+to produce a result.  ``tools/watch_and_bench.sh`` runs this after the
+batch gate (``BF_SKIP_BRIDGE_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config10(timeout=1800):
+    """One bench_suite --config 10 subprocess on the CPU backend;
+    returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # configured bridge tuning would skew the fixed-arm comparison
+    for var in ('BF_BRIDGE_STREAMS', 'BF_BRIDGE_WINDOW',
+                'BF_BRIDGE_CRC'):
+        env.pop(var, None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '10'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'arms' in d:
+            return d
+    raise RuntimeError(
+        'config 10 produced no arms result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1000:], out.stderr[-1000:]))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default='BENCH_BRIDGE.json',
+                    help='artifact path (full config-10 result + '
+                         'verdict)')
+    ap.add_argument('--threshold', type=float, default=0.0,
+                    help='max allowed v2 throughput regression vs '
+                         'naive v1, percent (default 0: v2 >= v1)')
+    ap.add_argument('--timeout', type=float, default=1800.0,
+                    help='bench subprocess timeout in seconds')
+    args = ap.parse_args()
+
+    try:
+        res = run_config10(timeout=args.timeout)
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        print('bridge_gate: bench arm failed: %s' % exc,
+              file=sys.stderr)
+        return 2
+
+    t1 = float(res['arms']['v1_naive']['ms_min'])
+    t2 = float(res['arms']['v2']['ms_min'])
+    regression_pct = (t2 / t1 - 1.0) * 100.0 if t1 > 0 else 0.0
+    throughput_ok = regression_pct <= args.threshold
+    outputs_ok = bool(res.get('outputs_identical'))
+    ok = throughput_ok and outputs_ok
+    artifact = dict(res,
+                    gate={'regression_pct': round(regression_pct, 2),
+                          'threshold_pct': args.threshold,
+                          'throughput_ok': throughput_ok,
+                          'outputs_identical': outputs_ok,
+                          'pass': ok,
+                          'round': os.environ.get('BF_BENCH_ROUND',
+                                                  '')})
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write('\n')
+    print('bridge_gate: v1 %.1fms / v2 %.1fms -> %.2fx '
+          '(threshold %.1f%%), outputs_identical=%s %s'
+          % (t1, t2, t1 / t2 if t2 > 0 else 0.0, args.threshold,
+             outputs_ok, 'PASS' if ok else 'FAIL'))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -11,7 +11,11 @@ Information set matching the reference:
 Rates come from deltas of successive ProcLog samples of the capture
 engines' ``*_capture/stats`` entries (ngood_bytes/nmissing_bytes/
 ninvalid/nignored/npackets) and the writers' ``*_transmit_*/stats``
-(nbytes/npackets).  Curses UI: up/down select PID, q quits; ``--once``
+(nbytes/npackets).  Ring-bridge endpoints (io/bridge.py) publish the
+same stats shapes under ``*_bridge_transmit`` / ``*_bridge_capture``
+and show up as rows tagged ``[bridge]`` — for a bridge, ``invalid``
+counts CRC failures and ``ignored`` counts duplicate frames dropped
+after a reconnect.  Curses UI: up/down select PID, q quits; ``--once``
 prints a plain-text snapshot of every PID.
 """
 
@@ -55,7 +59,8 @@ def get_transmit_receive():
             else:
                 continue
             entry.update({'pid': pid, 'name': block, 'kind': kind,
-                          'time': now})
+                          'time': now,
+                          'bridge': '_bridge_' in block})
             found['%d-%s' % (pid, block)] = entry
     return found
 
@@ -97,7 +102,8 @@ def get_statistics(curr_list, prev_list):
             'name': curr['name'], 'good': curr['good'],
             'missing': curr['missing'], 'invalid': curr['invalid'],
             'ignored': curr['ignored'], 'drate': max(0.0, drate),
-            'prate': max(0.0, prate), 'gloss': gloss, 'closs': closs})
+            'prate': max(0.0, prate), 'gloss': gloss, 'closs': closs,
+            'bridge': curr.get('bridge', False)})
     return out
 
 
@@ -145,9 +151,10 @@ def render_pid(pid, stats, history, width=78):
                       'ignored', 'rate'))
         for b in sorted(agg['blocks'], key=lambda b: b['name']):
             bv, bu = set_units(b['drate'])
-            out.append('  %-28s %12d %12d %9d %9d %5.1f%s'
+            tag = ' [bridge]' if b.get('bridge') else ''
+            out.append('  %-28s %12d %12d %9d %9d %5.1f%s%s'
                        % (b['name'][:28], b['good'], b['missing'],
-                          b['invalid'], b['ignored'], bv, bu[0]))
+                          b['invalid'], b['ignored'], bv, bu[0], tag))
         hist = history.get((pid, kind))
         if hist:
             out.append('  history (%ds):' % len(hist))

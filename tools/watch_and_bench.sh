@@ -89,6 +89,21 @@ for i in $(seq 1 400); do
           exit "$grc"
         fi
       fi
+      # Ring-bridge wire gate: config 10 on the CPU backend — wire v2
+      # (zero-copy, windowed) must not regress vs the naive v1 pump
+      # and both arms must round-trip byte-identically.  A failure
+      # exits nonzero (the capture artifacts above are already in
+      # place).
+      if [ "${BF_SKIP_BRIDGE_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) ring bridge wire gate (config 10, CPU)" >> "$LOG"
+        python tools/bridge_gate.py --out "BENCH_BRIDGE_${ROUND}.json" >> "$LOG" 2>&1
+        brg=$?
+        echo "$(date -u +%FT%TZ) bridge gate rc=$brg" >> "$LOG"
+        if [ "$brg" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) ring bridge wire gate FAILED" >> "$LOG"
+          exit "$brg"
+        fi
+      fi
       exit 0
     fi
     # never leave a truncated artifact where round automation could
