@@ -55,7 +55,44 @@ class CopyBlock(TransformBlock):
         return 'tpu' in (self.irings[0].space, self.orings[0].space)
 
     def on_sequence(self, iseq):
-        return deepcopy(iseq.header)
+        ohdr = deepcopy(iseq.header)
+        self._h2d_taxis = None
+        if self.orings[0].space != 'tpu':
+            # host rings have no device layout: a D2H copy gathers
+            ohdr.pop('_sharding', None)
+        if self.mesh is not None and self.orings[0].space == 'tpu' \
+                and self.irings[0].space != 'tpu':
+            # mesh-resident placement: this mover will commit spans
+            # sharded over the scope mesh's time axis; advertise the
+            # ring-resident layout so downstream blocks jit with
+            # matching in_shardings (zero inter-block reshards) and
+            # monitors can see it (docs/parallel.md)
+            from ..parallel.scope import sharding_descriptor
+            try:
+                taxis = ohdr['_tensor']['shape'].index(-1)
+            except (KeyError, ValueError):
+                taxis = None
+            if taxis is not None:
+                self._h2d_taxis = taxis
+                ohdr['_sharding'] = sharding_descriptor(self.mesh, taxis)
+        return ohdr
+
+    def _h2d_sharding(self, ispan):
+        """NamedSharding for this gulp's DEVICE-REP array (frame axis
+        over the mesh time axis), or None when no mesh is scoped or the
+        gulp's frame count does not divide the shards (the partial tail
+        at sequence end lands single-device; consumers fall back the
+        same way)."""
+        if self._h2d_taxis is None:
+            return None
+        from ..parallel.scope import time_sharding, time_axis_size
+        if ispan.nframe % time_axis_size(self.mesh):
+            return None
+        from ..dtype import DataType
+        ndim = len(ispan.shape)
+        if DataType(ispan.dtype).kind == 'ci':
+            ndim += 1        # device rep grows a trailing (re,im) axis
+        return time_sharding(self.mesh, ndim, self._h2d_taxis)
 
     def _d2h_strict(self):
         """Synchronous D2H required?  Scope sync_strict wins; else the
@@ -72,7 +109,9 @@ class CopyBlock(TransformBlock):
             buf = ispan.data.as_numpy()
             # engine-created device array: the committed chunk is
             # exclusively this ring's (donation-eligible downstream)
-            ospan.set(to_device_rep(buf, ispan.dtype), owned=True)
+            ospan.set(to_device_rep(buf, ispan.dtype,
+                                    sharding=self._h2d_sharding(ispan)),
+                      owned=True)
         elif ispace == 'tpu' and ospace != 'tpu':
             out = ospan.data.as_numpy()
             if self._d2h_strict():

@@ -27,7 +27,8 @@ class _StageBlock(TransformBlock):
     def __init__(self, iring, stage, *args, **kwargs):
         super(_StageBlock, self).__init__(iring, *args, **kwargs)
         self._stage = stage
-        self._plans = {}       # (shape, dtype, donate) -> jitted fn
+        self._plans = {}   # (shape, dtype, donate) -> (jitted fn,
+        #                    mesh width of that plan: 1 single-device)
         self._donate_on = None
 
     def define_valid_input_spaces(self):
@@ -45,7 +46,26 @@ class _StageBlock(TransformBlock):
         self._ihdr = iseq.header
         self._plans = {}
         self._donate_on = None
-        return self._stage.transform_header(iseq.header)
+        ohdr = self._stage.transform_header(iseq.header)
+        # ring-resident sharding advertisement, mirroring FusedBlock:
+        # under a mesh this block commits spans sharded over the
+        # OUTPUT frame axis; never leak a stale input descriptor
+        ohdr.pop('_sharding', None)
+        self._taxis_in = self._taxis_out = None
+        if self.mesh is not None:
+            from ..parallel.scope import (sharding_descriptor,
+                                          check_descriptor)
+            try:
+                self._taxis_in = \
+                    self._ihdr['_tensor']['shape'].index(-1)
+                check_descriptor(self._ihdr, self.mesh,
+                                 self._taxis_in)
+                self._taxis_out = ohdr['_tensor']['shape'].index(-1)
+                ohdr['_sharding'] = sharding_descriptor(
+                    self.mesh, self._taxis_out)
+            except (KeyError, ValueError):
+                self._taxis_in = self._taxis_out = None
+        return ohdr
 
     def define_output_nframes(self, input_nframe):
         return self._stage.output_nframe(input_nframe)
@@ -54,23 +74,80 @@ class _StageBlock(TransformBlock):
         import jax
         from ..ops.common import donating_jit
         key = (tuple(x.shape), str(x.dtype), bool(donate))
-        fn = self._plans.get(key)
-        if fn is None:
+        hit = self._plans.get(key)
+        if hit is None:
             idt = DataType(self._ihdr['_tensor']['dtype'])
             meta = {'shape': list(x.shape), 'dtype': idt,
                     'reim': idt.kind == 'ci'}
             built = self._stage.build(meta)
-            fn = donating_jit(built, donate_argnums=(0,)) if donate \
-                else jax.jit(built)
-            self._plans[key] = fn
-        return fn
+            dargs = (0,) if donate else ()
+            fn = in_sh = None
+            nsh = 1
+            mesh_ok = False
+            if self.mesh is not None and self._taxis_in is not None:
+                from ..parallel.scope import time_axis_size
+                mesh_ok = x.shape[self._taxis_in] % \
+                    time_axis_size(self.mesh) == 0
+            if mesh_ok:
+                # mesh plan with the ring-resident in/out shardings so
+                # a chain of unfused stage blocks under one mesh scope
+                # exchanges spans with zero reshards, exactly like a
+                # FusedBlock plan: frame-local shard_map for
+                # batch_safe stages (zero collectives by
+                # construction), GSPMD otherwise (docs/parallel.md)
+                from ..parallel.scope import (frame_local_plan,
+                                              time_sharding,
+                                              time_axis_size,
+                                              hlo_stats_enabled,
+                                              record_collectives)
+                nsh = time_axis_size(self.mesh)
+                if getattr(self._stage, 'batch_safe', False):
+                    def build_local(local_shape):
+                        lmeta = dict(meta, shape=list(local_shape))
+                        return self._stage.build(lmeta)
+                    got = frame_local_plan(
+                        self.mesh, build_local, x.shape, x.dtype,
+                        self._taxis_in, self._taxis_out,
+                        donate_argnums=dargs)
+                    if got is not None:
+                        fn, in_sh, _o = got
+                if fn is None:
+                    in_sh = time_sharding(self.mesh, x.ndim,
+                                          self._taxis_in)
+                    from .fused import FusedBlock
+                    out_sh = FusedBlock._out_sharding(
+                        built, x.shape, x.dtype, self.mesh,
+                        self._taxis_out)
+                    kw = {'out_shardings': out_sh} \
+                        if out_sh is not None else {}
+                    fn = donating_jit(built, donate_argnums=dargs,
+                                      in_shardings=in_sh, **kw)
+                if hlo_stats_enabled():
+                    arg = jax.ShapeDtypeStruct(tuple(x.shape),
+                                               x.dtype,
+                                               sharding=in_sh)
+                    record_collectives(fn, (arg,), self.name)
+            if fn is None:
+                fn = donating_jit(built, donate_argnums=dargs) \
+                    if donate else jax.jit(built)
+                nsh = 1
+            hit = self._plans[key] = (fn, nsh)
+        # refresh on EVERY dispatch (cache hits included): a sequence
+        # can alternate sharded full gulps with an unshardable tail,
+        # and the Shd telemetry must describe the EXECUTING plan
+        self._shards_active = hit[1]
+        return hit[0]
 
     def on_data(self, ispan, ospan):
         x = self._take_donatable(ispan)
         donate = x is not None
         if not donate:
             x = ispan.data
-        ospan.set(self._plan_for(x, donate)(x), owned=True)
+        plan = self._plan_for(x, donate)
+        if self.mesh is not None and self._taxis_in is not None:
+            from ..parallel.scope import shard_gulp
+            x = shard_gulp(x, self.mesh, self._taxis_in)
+        ospan.set(plan(x), owned=True)
 
 
 class FftBlock(_StageBlock):

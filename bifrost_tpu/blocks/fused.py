@@ -42,10 +42,12 @@ class FusedBlock(TransformBlock):
         return ('tpu',)
 
     def macro_gulp_safe(self):
-        """Macro-gulp eligible: the jitted chain batches K gulps into
-        one program (mesh plans excluded — sharded macro aliasing is
-        not threaded through)."""
-        return self.mesh is None
+        """Macro-gulp eligible — including under a mesh: the K-gulp
+        span shards over the mesh time axis exactly like a single gulp
+        (K·G frames instead of G), so batched dispatch composes with
+        sharded plans.  This is where dispatch amortization actually
+        pays on TPU: one program K gulps wide AND N chips wide."""
+        return True
 
     def on_sequence(self, iseq):
         hdr = iseq.header
@@ -58,6 +60,26 @@ class FusedBlock(TransformBlock):
         self._published_impl = None
         self._published_key = None
         self._donate_on = None
+        # ring-resident sharding advertisement: under a mesh this block
+        # commits output spans sharded over the OUTPUT frame axis; a
+        # stale input descriptor must never survive a layout change
+        hdr.pop('_sharding', None)
+        if self.mesh is not None:
+            from ..parallel.scope import (sharding_descriptor,
+                                          check_descriptor)
+            try:
+                # a producer advertising a layout this scope's mesh
+                # would relayout is a per-sequence misconfiguration —
+                # flag it once (mesh.layout_mismatch) up front
+                check_descriptor(iseq.header,
+                                 self.mesh,
+                                 self._headers[0]['_tensor']
+                                 ['shape'].index(-1))
+                taxis_out = hdr['_tensor']['shape'].index(-1)
+                hdr['_sharding'] = sharding_descriptor(self.mesh,
+                                                       taxis_out)
+            except (KeyError, ValueError):
+                pass
         self._prewarm(iseq.header)
         return hdr
 
@@ -95,11 +117,11 @@ class FusedBlock(TransformBlock):
             from ..macro import resolve_gulp_batch
             k = resolve_gulp_batch(self)
             # skip the K-gulp compile when a static fallback (host
-            # topology, multi-reader ring, ...) would discard it —
-            # only the sequence-dependent conditions (overlap /
-            # dynamic gulp) can still fall back after this
-            if k > 1 and self.mesh is None and \
-                    self._macro_static_reason() is None:
+            # topology, ...) would discard it — only the
+            # sequence-dependent conditions (overlap / dynamic gulp)
+            # can still fall back after this.  Mesh scopes prewarm the
+            # macro plan too (macro × mesh composes since PR 6).
+            if k > 1 and self._macro_static_reason() is None:
                 import jax
                 from ..devrep import device_rep_zeros
                 taxis = t['shape'].index(-1)
@@ -126,7 +148,7 @@ class FusedBlock(TransformBlock):
 
     def _build_plan(self, shape, dtype, donate=False):
         import jax
-        from ..stages import compose_stages, match_spectrometer
+        from ..stages import compose_stages
         from ..ops.common import donating_jit
         mesh = self.mesh
         if mesh is None:
@@ -148,51 +170,116 @@ class FusedBlock(TransformBlock):
         # gulp's frame axis, let GSPMD partition every stage and insert
         # any collectives (the TPU generalization of the reference's
         # per-block gpu=N placement, reference: pipeline.py:365-366).
+        # Plans carry BOTH in_shardings and out_shardings matching the
+        # ring-resident layout: a sharded-H2D producer commits spans in
+        # exactly the in_sharding, and this block commits its output in
+        # exactly the out_sharding the next mesh block expects — chained
+        # mesh blocks then exchange spans with ZERO reshards (only the
+        # genuine collectives of the math remain; docs/parallel.md).
         from ..parallel.scope import (shardable_nframe,
                                       time_sharding,
                                       time_axis_name,
                                       time_axis_size)
         taxis = self._headers[0]['_tensor']['shape'].index(-1)
         if shardable_nframe(mesh, shape[taxis]):
-            if taxis == 0:
-                # the spectrometer kernel is independent per time
-                # step, so under a mesh it runs per-shard inside
-                # shard_map on the frame axis; match at the
-                # PER-SHARD shape (that is what each device
-                # compiles and what kernel_usable must probe)
-                nsh = time_axis_size(mesh)
-                local = (shape[0] // nsh,) + tuple(shape[1:])
-                spec_fn = match_spectrometer(
-                    self.stages, self._headers, local, dtype)
-                if spec_fn is not None:
-                    self._set_impl(dict(
-                        spec_fn.info,
-                        mesh='shard_map[%d]' % nsh))
-                    import inspect
-                    from ..parallel.ops import _shard_map
-                    from jax.sharding import PartitionSpec
-                    sm = _shard_map()
-                    # the pallas body carries no varying-mesh-axis
-                    # metadata; disable the check under either API
-                    # generation (check_vma >= 0.8, check_rep before)
-                    params = inspect.signature(sm).parameters
-                    kw = {}
-                    if 'check_vma' in params:
-                        kw['check_vma'] = False
-                    elif 'check_rep' in params:
-                        kw['check_rep'] = False
-                    p = PartitionSpec(time_axis_name(mesh))
-                    sharded = sm(spec_fn, mesh=mesh, in_specs=p,
-                                 out_specs=p, **kw)
-                    return jax.jit(sharded), taxis
+            nsh = time_axis_size(mesh)
+            taxis_out = self._headers[-1]['_tensor']['shape'].index(-1)
             sharding = time_sharding(mesh, len(shape), taxis)
-            self._set_impl({'impl': 'xla-fused', 'mesh': 'gspmd'})
-            return (jax.jit(composed, in_shardings=sharding),
-                    taxis)
+            dargs = (0,) if donate else ()
+            # FRAME-LOCAL first: a time-concat-equivariant chain (every
+            # stage batch_safe — includes the whole-chain spectrometer
+            # substitution, matched at the PER-SHARD shape each device
+            # actually compiles) runs inside shard_map on the frame
+            # axis, so the compiled program provably contains zero
+            # collectives — nothing for the partitioner to get wrong
+            # (the CPU partitioner all-gathers FFT batch dims under
+            # plain GSPMD).
+            from ..macro import chain_batch_mode
+            from ..parallel.scope import frame_local_plan
+            if chain_batch_mode(self.stages) == 'block':
+                def build_local(local_shape):
+                    fn, info = compose_stages(self.stages,
+                                              self._headers,
+                                              local_shape, dtype)
+                    self._local_info = info
+                    return fn
+                self._local_info = {}
+                got = frame_local_plan(mesh, build_local, shape, dtype,
+                                       taxis, taxis_out,
+                                       donate_argnums=dargs)
+                if got is not None:
+                    plan, in_sh, _out_sh = got
+                    info = dict(self._local_info,
+                                mesh='shard_map[%d]' % nsh,
+                                shards=nsh)
+                    if donate:
+                        info['donate_argnums'] = [0]
+                    self._set_impl(info)
+                    self._analyze_plan(plan, shape, dtype, in_sh)
+                    return plan, taxis
+            # GSPMD: non-equivariant chains (or a failed local build)
+            # — XLA partitions the whole composition and inserts the
+            # genuine collectives; in/out shardings still pin the
+            # ring-resident layout at the boundaries
+            info = {'impl': 'xla-fused', 'mesh': 'gspmd', 'shards': nsh}
+            if donate:
+                info['donate_argnums'] = [0]
+            self._set_impl(info)
+            out_sh = self._out_sharding(composed, shape, dtype, mesh,
+                                        taxis_out)
+            from ..ops.common import donating_jit
+            plan = donating_jit(composed, donate_argnums=dargs,
+                                in_shardings=sharding,
+                                out_shardings=out_sh)
+            self._analyze_plan(plan, shape, dtype, sharding)
+            return plan, taxis
         # mesh present but the gulp's frame count is not shardable:
-        # run unsharded
+        # run unsharded (partial tail gulps; the producer committed
+        # them single-device for the same reason)
         self._set_impl({'impl': 'xla-fused'})
+        if donate:
+            from ..ops.common import donating_jit
+            return donating_jit(composed, donate_argnums=(0,)), None
         return jax.jit(composed), None
+
+    @staticmethod
+    def _out_sharding(fn, shape, dtype, mesh, taxis_out):
+        """out_shardings for a mesh plan: the output frame axis over
+        the mesh time axis when it divides (the ring-resident layout
+        the NEXT mesh block's in_shardings expects), else None (XLA
+        decides; the consumer falls back like any unshardable gulp)."""
+        import jax
+        from ..parallel.scope import time_sharding, time_axis_size
+        try:
+            out = jax.eval_shape(fn, jax.ShapeDtypeStruct(tuple(shape),
+                                                          dtype))
+        except Exception:
+            return None
+        if taxis_out >= out.ndim or \
+                out.shape[taxis_out] % time_axis_size(mesh):
+            return None
+        return time_sharding(mesh, out.ndim, taxis_out)
+
+    def _analyze_plan(self, plan, shapes, dtype, in_sharding):
+        """BF_MESH_HLO_STATS=1: compile an analysis copy of the plan at
+        the ring-resident input layout and count the collectives XLA
+        inserted (``mesh.collectives.<kind>``); the count lands in the
+        published impl info so monitors can see the plan is
+        reshard-free.  ``shapes`` is one shape per plan argument (a
+        multi-part macro plan takes one array per donated chunk — the
+        analysis must match its arity or it silently fails)."""
+        from ..parallel.scope import hlo_stats_enabled, record_collectives
+        if not hlo_stats_enabled():
+            return
+        import jax
+        if shapes and not isinstance(shapes[0], (tuple, list)):
+            shapes = [shapes]
+        args = tuple(jax.ShapeDtypeStruct(tuple(s), dtype,
+                                          sharding=in_sharding)
+                     for s in shapes)
+        counts = record_collectives(plan, args, self.name)
+        if counts is not None and self._last_built_impl is not None:
+            self._last_built_impl['collectives'] = counts or {}
 
     def _set_impl(self, info):
         """Record the configuration of the plan being BUILT; publishing
@@ -208,6 +295,9 @@ class FusedBlock(TransformBlock):
         engaging, a new shape) or info change — so monitors never read
         a stale impl while a different program is running."""
         self.impl_info = dict(info)
+        # like_top's Shd column (docs/parallel.md): how many chips the
+        # executing plan spans (1 = single-device)
+        self._shards_active = int(info.get('shards', 1) or 1)
         if info == self._published_impl and \
                 (key is None or key == self._published_key):
             return
@@ -226,10 +316,9 @@ class FusedBlock(TransformBlock):
         _prewarm (one copy of the key/shard logic, so the pre-warmed
         key can never drift from the hot path's).  ``donate=True``
         requires an exclusively-owned ``x`` (it is deleted by the
-        call); mesh plans never donate (sharded aliasing is not
-        threaded through)."""
-        if self.mesh is not None:
-            donate = False
+        call) — mesh plans donate too: the sharded input's per-device
+        buffers alias same-layout intermediates/outputs shard by
+        shard (donation-under-sharding, docs/parallel.md)."""
         key = (tuple(x.shape), str(x.dtype), bool(donate))
         plan = self._plans.get(key)
         if plan is None:
@@ -285,23 +374,80 @@ class FusedBlock(TransformBlock):
             info = dict(info_box,
                         batch=-(-nframe // int(gulp_nframe)),
                         batch_mode=mode)
+            dargs = tuple(range(len(parts))) if donate else ()
             if donate:
-                info['donate_argnums'] = list(range(len(parts)))
-                fn = donating_jit(
-                    fn, donate_argnums=tuple(range(len(parts))))
+                info['donate_argnums'] = list(dargs)
+            # macro × mesh: the K-gulp span shards over the mesh time
+            # axis exactly like a single gulp (K·G frames instead of
+            # G).  A single-part 'block'-mode span takes the same
+            # frame-local shard_map shape as the per-gulp mesh plan —
+            # zero collectives by construction; multi-part spans (a
+            # K=1 producer feeding this macro consumer) and 'sliced'
+            # chains jit GSPMD with in_shardings per part instead —
+            # the in-program concat/slice is then the partitioner's
+            # to place.
+            built = None
+            if self.mesh is not None:
+                from ..parallel.scope import (frame_local_plan,
+                                              time_sharding,
+                                              time_axis_size)
+                nsh = time_axis_size(self.mesh)
+                ndim = len(part_shapes[0])
+                if all(s[taxis_in] % nsh == 0 for s in part_shapes):
+                    if mode == 'block' and len(parts) == 1:
+                        got = frame_local_plan(
+                            self.mesh, per_shape, part_shapes[0],
+                            dtype, taxis_in, taxis_out,
+                            donate_argnums=dargs)
+                        if got is not None:
+                            built, in_sh, _o = got
+                            info = dict(info, **info_box)
+                            info['mesh'] = 'shard_map[%d]' % nsh
+                            info['shards'] = nsh
+                    if built is None:
+                        in_sh = time_sharding(self.mesh, ndim,
+                                              taxis_in)
+                        shard_kw = {'in_shardings':
+                                    tuple(in_sh for _ in parts)
+                                    if len(parts) > 1 else in_sh}
+                        if len(parts) == 1:
+                            out_sh = self._out_sharding(
+                                fn, part_shapes[0], dtype, self.mesh,
+                                taxis_out)
+                            if out_sh is not None:
+                                shard_kw['out_shardings'] = out_sh
+                        info = dict(info, mesh='gspmd', shards=nsh)
+                        built = donating_jit(fn, donate_argnums=dargs,
+                                             **shard_kw)
+                    self._last_built_impl = info
+                    self._analyze_plan(built, list(part_shapes), dtype,
+                                       in_sh)
+                    info = self._last_built_impl
+            if built is not None:
+                # mesh-sharded plan: remember the shard axis so
+                # execution can relayout stray single-device parts
+                # (mirroring _execute_plan's shard_gulp step — a jit
+                # with explicit in_shardings REJECTS committed
+                # mismatched inputs rather than moving them)
+                fn, shard_taxis = built, taxis_in
             else:
-                fn = jax.jit(fn)
-            plan = (fn, None)
+                fn, shard_taxis = donating_jit(
+                    fn, donate_argnums=dargs), None
+            plan = (fn, shard_taxis)
             self._plans[key] = plan
             self._plan_impls[key] = info
         info = self._plan_impls.get(key)
         if info is not None:
             self._publish_impl(info, key)
-        return plan[0](*parts)
+        fn, shard_taxis = plan
+        if shard_taxis is not None:
+            from ..parallel.scope import shard_gulp
+            parts = [shard_gulp(p, self.mesh, shard_taxis)
+                     for p in parts]
+        return fn(*parts)
 
     def on_data(self, ispan, ospan):
-        if self._gulp_batch_active > 1 and self.mesh is None \
-                and self._macro_gulp_in:
+        if self._gulp_batch_active > 1 and self._macro_gulp_in:
             x = self._take_donatable(ispan, allow_parts=True)
             if x is None:
                 parts, donate = [ispan.data], False
@@ -313,7 +459,7 @@ class FusedBlock(TransformBlock):
                                           self._macro_gulp_in),
                       owned=True)
             return
-        x = self._take_donatable(ispan) if self.mesh is None else None
+        x = self._take_donatable(ispan)
         if x is not None:
             ospan.set(self._execute_plan(x, donate=True), owned=True)
         else:

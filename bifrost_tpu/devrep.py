@@ -37,25 +37,29 @@ def device_rep_dtype(dtype):
     return jnp.dtype(dtype.as_jax_dtype()), False
 
 
-def to_device_rep(buf, dtype):
-    """numpy storage -> device-representation jax array."""
+def to_device_rep(buf, dtype, sharding=None):
+    """numpy storage -> device-representation jax array.  ``sharding``
+    (a jax Sharding over the DEVICE-REP shape — note ci* types grow a
+    trailing (re, im) axis) places the gulp mesh-resident via the
+    sharded H2D path (xfer.to_device)."""
     dtype = DataType(dtype)
     if dtype.kind == 'ci':
         if dtype.nbits == 4:
             b = np.ascontiguousarray(buf).view(np.uint8)
             re = (b.astype(np.int8) >> 4)
             im = (np.left_shift(b, 4).astype(np.int8) >> 4)
-            return to_device(np.stack([re, im], axis=-1))
+            return to_device(np.stack([re, im], axis=-1),
+                             sharding=sharding)
         return to_device(np.ascontiguousarray(buf).view(
-            buf.dtype[0]).reshape(buf.shape + (2,)))
+            buf.dtype[0]).reshape(buf.shape + (2,)), sharding=sharding)
     if dtype.kind == 'cf' and dtype.nbits == 16:
         re = buf['re'].astype(np.float32)
         im = buf['im'].astype(np.float32)
-        return to_device(re + 1j * im)
+        return to_device(re + 1j * im, sharding=sharding)
     if dtype.is_packed:
         from .ops.map import _to_logical
-        return to_device(_to_logical(buf, dtype))
-    return to_device(buf)
+        return to_device(_to_logical(buf, dtype), sharding=sharding)
+    return to_device(buf, sharding=sharding)
 
 
 def from_device_rep(arr, dtype, out_buf):

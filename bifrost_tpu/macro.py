@@ -33,10 +33,17 @@ Two batch-execution shapes, chosen per stage chain
 
 Eligibility (:meth:`bifrost_tpu.pipeline.MultiTransformBlock.
 _resolve_macro_batch`) falls back to K=1 — never an error — for host
-blocks, multi-reader rings, overlapped (FIR-history) reads,
-unguaranteed readers, dynamic gulp geometry, and nframe-nonlinear
-blocks.  K=1 is the default and is byte-identical in behavior to the
-pre-macro runtime.
+blocks, overlapped (FIR-history) reads, unguaranteed readers, dynamic
+gulp geometry, and nframe-nonlinear blocks.  K=1 is the default and
+is byte-identical in behavior to the pre-macro runtime.  Two former
+fallbacks are RETIRED (PR 6): multi-reader input rings batch (each
+reader's guarantee independently pins its own oldest open span —
+both ring cores prove this since the PR 5 multi-open-span fix — so a
+K-gulp acquire cannot wedge a peer; sequences that would have been
+penalized count on ``macro.fallback.multi_reader_retired``), and
+mesh scopes batch (the K-gulp span shards over the mesh time axis
+exactly like a single gulp — see docs/parallel.md, "Macro-gulp x
+mesh").
 
 Controlled by ``BF_GULP_BATCH`` or the ``gulp_batch`` scope tunable
 (``Pipeline(gulp_batch=K)``).  See docs/perf.md ("Macro-gulp

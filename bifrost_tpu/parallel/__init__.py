@@ -19,4 +19,6 @@ from .ops import (sharded_spectrometer, sharded_beamform,
 from .fft import sharded_fft, distributed_fft_local
 from .scope import (time_axis_name, station_axis_name, time_axis_size,
                     time_sharding, replicated_sharding, shardable_nframe,
-                    shard_gulp)
+                    shard_gulp, sharding_descriptor, descriptor_matches,
+                    frame_local_plan, mesh_h2d_enabled,
+                    hlo_stats_enabled, collective_counts)

@@ -30,7 +30,7 @@ import numpy as np
 
 __all__ = ['sharded_fft', 'distributed_fft_local']
 
-from .ops import _shard_map, _P
+from .ops import _shard_map, _P, axis_size as _axis_size
 # reuse the cached four-step factor matrices and the re/im-plane
 # constant embedding (a raw complex jit constant would raise
 # UNIMPLEMENTED on the tunneled TPU backend and poison the process —
@@ -46,7 +46,7 @@ def distributed_fft_local(x_loc, n1, n2, axis_name,
     import jax.numpy as jnp
     from jax import lax
 
-    d = lax.axis_size(axis_name)
+    d = _axis_size(axis_name)
     if n1 % d or n2 % d:
         raise ValueError(
             "distributed fft needs D | N1 and D | N2 "

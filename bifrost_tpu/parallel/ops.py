@@ -32,6 +32,16 @@ def _shard_map():
     return shard_map
 
 
+def axis_size(axis_name):
+    """Size of a named mesh axis from inside a shard_map/pmap body.
+    ``jax.lax.axis_size`` only exists on newer jax; the psum-of-one
+    fallback is constant-folded to the same static int everywhere."""
+    import jax
+    if hasattr(jax.lax, 'axis_size'):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def _P(*args):
     from jax.sharding import PartitionSpec
     return PartitionSpec(*args)
@@ -55,9 +65,9 @@ def _local_fir_stateful(x, coeffs, state, axis_name, decim=1):
     if ntap == 1:
         y = coeffs[0] * x
         return (y[::decim] if decim > 1 else y), state
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size_ = axis_size(axis_name)
     halo = x[-(ntap - 1):]
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    perm = [(i, (i + 1) % axis_size_) for i in range(axis_size_)]
     left = jax.lax.ppermute(halo, axis_name, perm)
     idx = jax.lax.axis_index(axis_name)
     left = jnp.where(idx == 0, state.astype(x.dtype), left)
@@ -69,7 +79,7 @@ def _local_fir_stateful(x, coeffs, state, axis_name, decim=1):
         out = out[::decim]
     # New state = the LAST shard's halo; a masked psum (rather than
     # all_gather + index) so shard_map can prove the result replicated.
-    mask = (idx == axis_size - 1).astype(halo.dtype)
+    mask = (idx == axis_size_ - 1).astype(halo.dtype)
     new_state = jax.lax.psum(halo * mask, axis_name)
     return out, new_state
 

@@ -624,6 +624,10 @@ class Block(BlockScope):
         #: by MultiTransformBlock._process_sequence; 1 = off)
         self._gulp_batch_active = 1
         self._macro_gulp_in = None
+        #: mesh width of the executing plan (blocks running sharded
+        #: plans set this when they publish impl info; 1 = one device).
+        #: Rendered as like_top's Shd column from the perf proclog.
+        self._shards_active = 1
         self.bind_proclog = ProcLog(self.name + '/bind')
         self.in_proclog = ProcLog(self.name + '/in')
         rnames = {'nring': len(self.irings)}
@@ -691,6 +695,8 @@ class Block(BlockScope):
         if self._n_dispatches:
             stats['gulps_per_dispatch'] = round(
                 self._n_gulps_logical / float(self._n_dispatches), 3)
+        if self._shards_active > 1:
+            stats['shards'] = int(self._shards_active)
         return stats
 
     def create_ring(self, *args, **kwargs):
@@ -1119,9 +1125,16 @@ class MultiTransformBlock(Block):
 
     def _macro_input_consumers(self):
         """Direct consumers of this block's input ring (by base-ring
-        identity, so block_view taps count).  Macro acquire holds K
-        gulps of guarantee; a multi-reader input ring falls back to
-        K=1 so batching never changes a peer's flow control."""
+        identity, so block_view taps count).  A multi-reader input
+        ring used to force a K=1 fallback; macro acquire is now
+        eligible there — each reader's guarantee independently pins
+        its own oldest open span (both ring cores prove this since the
+        PR 5 multi-open-span fix), and the reader-side resize sizes
+        the ring for the largest consumer's macro span, so a K-gulp
+        guarantee never wedges a K=1 peer.  The count is kept for the
+        retirement telemetry (donation exclusivity is still enforced
+        per-claim by ring._take_exclusive, which multi-reader rings
+        fail by construction)."""
         def base(r):
             return getattr(r, '_base_ring', r)
         target = base(self.irings[0])
@@ -1143,8 +1156,6 @@ class MultiTransformBlock(Block):
             return 'topology'
         if not getattr(self, 'guarantee', True):
             return 'unguaranteed'
-        if self._macro_input_consumers() > 1:
-            return 'multi_reader'
         return None
 
     def _resolve_macro_batch(self, iseqs, istride_nframes,
@@ -1179,6 +1190,12 @@ class MultiTransformBlock(Block):
         if reason is not None:
             fallback_reason(reason)
             return 1
+        if self._macro_input_consumers() > 1:
+            # formerly a K=1 fallback; count each sequence that NOW
+            # batches on a multi-reader ring (every other eligibility
+            # condition already passed) so the retirement is observable
+            # next to the remaining macro.fallback.* reasons
+            fallback_reason('multi_reader_retired')
         return k
 
     def _drain_sequences(self, iseqs):

@@ -269,6 +269,17 @@ class _DeviceStorage(object):
     keyed by absolute byte offset.  Logical shape of each chunk is
     (*ringlet_shape, nframe, *frame_shape).
 
+    Mesh-resident pipelines (docs/parallel.md): a committed chunk may be
+    a SHARDED jax Array carrying a ``jax.sharding.NamedSharding`` — the
+    ring then holds shard-local HBM buffers on each mesh device instead
+    of one monolithic per-chip allocation, and readers that consume the
+    chunk whole (the exact-cover fast path in :meth:`get`, and
+    :meth:`take`/:meth:`take_tiling` donation claims) hand the array to
+    the next block's plan with its layout intact — span exchange between
+    mesh blocks costs zero reshards.  Only the multi-chunk stitch path
+    collapses layouts (XLA inserts whatever movement the concatenate
+    needs), which overlap reads pay anyway.
+
     Overlap reads (FIR/FDMT input history) straddle chunk boundaries
     every gulp; the piece plan is found by bisect over a maintained
     sorted offset index and executed by a per-pattern cached jitted
@@ -776,8 +787,33 @@ class Ring(object):
             self._read_cond.notify_all()
             self._span_cond.notify_all()
         if commit_nbyte:
-            _observability()[0].inc('ring.%s.gulps' % self.name,
-                                    getattr(wspan, '_ngulps', 1))
+            self._note_commit(wspan, commit_nbyte)
+
+    def _note_commit(self, wspan, commit_nbyte):
+        """Per-commit telemetry shared by BOTH ring cores: the logical
+        gulp throughput counter (macro spans credit their K gulps), and
+        — for device rings whose committed chunk is a mesh-resident
+        array — sharded-chunk accounting: ``ring.<name>.sharded_gulps``
+        and ``ring.<name>.shard_bytes`` (bytes landing on EACH device;
+        the per-chip slice of the span).  The storage itself holds the
+        sharded jax Array, i.e. shard-local HBM buffers per device
+        rather than one monolithic allocation — these counters are how
+        an operator sees that layout without a device query."""
+        c = _observability()[0]
+        ngulps = getattr(wspan, '_ngulps', 1)
+        c.inc('ring.%s.gulps' % self.name, ngulps)
+        arr = getattr(wspan, '_device_array', None)
+        if arr is None:
+            return
+        try:
+            ndev = len(arr.sharding.device_set)
+        except Exception:
+            ndev = 1
+        if ndev > 1:
+            c.inc('ring.%s.sharded_gulps' % self.name, ngulps)
+            c.inc('ring.%s.shard_bytes' % self.name,
+                  commit_nbyte // ndev)
+            c.inc('mesh.sharded_commits')
 
     # -- reader side ------------------------------------------------------
     def open_sequence(self, name, guarantee=True):

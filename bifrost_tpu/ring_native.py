@@ -329,11 +329,12 @@ class NativeRing(Ring):
                 self._open_wspans.remove(wspan)
                 self._nwrite_open -= 1
         if commit_nbyte:
-            # same per-ring throughput counter the Python core keeps
-            # (telemetry.exporter derives gulps/s from its deltas);
-            # macro-gulp spans credit their K logical gulps
-            _observability()[0].inc('ring.%s.gulps' % self.name,
-                                    getattr(wspan, '_ngulps', 1))
+            # shared commit telemetry (Ring._note_commit): the per-ring
+            # logical-gulp throughput counter the exporter derives
+            # gulps/s from, macro spans crediting their K gulps; the
+            # sharded-chunk accounting inside is a no-op here (native
+            # rings are host-space — no device arrays)
+            self._note_commit(wspan, commit_nbyte)
 
     # -- reader side ------------------------------------------------------
     def _register_reader(self, rseq):

@@ -89,8 +89,12 @@ Macro-gulp execution counters (bifrost_tpu.macro — docs/perf.md):
                                            fell back to K=1 (reason:
                                            block / topology /
                                            unguaranteed / overlap /
-                                           dynamic_gulp / multi_reader
-                                           / nonlinear)
+                                           dynamic_gulp / nonlinear;
+                                           multi_reader_retired counts
+                                           sequences that batch on a
+                                           multi-reader ring the PRE-6
+                                           runtime would have forced
+                                           to K=1)
 - ``xfer.h2d_batched``                     host gulps shipped through
                                            the EXPLICIT batch entry
                                            point (xfer.to_device_batch,
@@ -102,6 +106,59 @@ Macro-gulp execution counters (bifrost_tpu.macro — docs/perf.md):
                                            on h2d_issued only — watch
                                            block.<name>.dispatches to
                                            confirm macro H2D engaged
+
+Mesh-resident pipeline counters (docs/parallel.md):
+
+- ``mesh.reshards`` / ``mesh.reshard_bytes``  gulps a block had to
+                                           relayout before its mesh
+                                           plan (shard_gulp
+                                           device_put).  Steady state
+                                           in a mesh-resident chain is
+                                           ZERO beyond prewarm — a
+                                           per-gulp rate means a span
+                                           is committed in the wrong
+                                           layout
+- ``mesh.sharded_commits``                 device-ring span commits
+                                           whose chunk spans > 1
+                                           device
+- ``mesh.layout_mismatch``                 sequences whose producer
+                                           advertised a ``_sharding``
+                                           header descriptor this
+                                           consumer's mesh scope would
+                                           relayout (once per
+                                           sequence; the per-gulp cost
+                                           shows up on mesh.reshards)
+- ``ring.<name>.sharded_gulps`` /
+  ``ring.<name>.shard_bytes``              per-ring sharded commits
+                                           and bytes landing on EACH
+                                           device (the per-chip slice)
+- ``xfer.h2d_sharded`` /
+  ``xfer.h2d_shard_bytes``                 sharded H2D placements
+                                           (per-shard staged
+                                           device_put + assembly) and
+                                           per-shard bytes;
+                                           ``xfer.h2d_sharded_fallback``
+                                           counts whole-array
+                                           device_put fallbacks
+                                           (BF_MESH_H2D=0 or an
+                                           unstageable sharding)
+- ``mesh.frame_local_fallback``            frame-local shard_map plan
+                                           builds that FAILED and
+                                           degraded to GSPMD (the
+                                           divisible-geometry
+                                           early-out is not counted —
+                                           only unexpected build
+                                           errors)
+- ``mesh.plans_analyzed`` /
+  ``mesh.plans_collective_free`` /
+  ``mesh.collectives.<kind>``              BF_MESH_HLO_STATS=1 plan
+                                           analysis: compiled mesh
+                                           plans inspected, how many
+                                           contained no collectives,
+                                           and the per-kind counts
+                                           (all_gather / all_reduce /
+                                           reduce_scatter / all_to_all
+                                           / collective_permute)
 """
 
 from __future__ import annotations

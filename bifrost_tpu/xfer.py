@@ -529,6 +529,96 @@ class TransferEngine(object):
         c.inc('xfer.h2d_bytes', int(nbytes))
         return out
 
+    # -- sharded H2D (mesh-resident pipelines; docs/parallel.md) ----------
+    def _shard_plan(self, shape, sharding):
+        """Per-device (device, index) placement plan for a sharded H2D,
+        or None when the sharding cannot be staged per shard (not fully
+        addressable, or a degenerate single-device layout)."""
+        try:
+            devices = sharding.device_set
+            if len(devices) <= 1 or not sharding.is_fully_addressable:
+                return None
+            items = list(
+                sharding.addressable_devices_indices_map(
+                    tuple(shape)).items())
+            if len(items) != len(devices):
+                return None
+            return items
+        except Exception:
+            return None
+
+    def _stage_ship_sharded(self, arr, sharding, plan):
+        """Per-shard variant of the ship protocol: each device's shard
+        slice is staged into its OWN aligned buffer (same slot pool /
+        zero-copy rules as :meth:`_stage_ship`, applied per shard),
+        device_put to its device, and the shard arrays are assembled
+        into one global array with
+        ``jax.make_array_from_single_device_arrays`` — the host never
+        materializes a monolithic device-side copy and each chip
+        receives exactly its bytes.  The PR 1 staging semantics hold
+        per shard: the caller may recycle ``arr`` on return, and the
+        assembled array is framework-owned (donation-eligible once
+        committed with ``owned=True``).
+
+        Slot lifetime: every acquired slot is bound to the ASSEMBLED
+        global array, not its per-shard wrapper — the wrappers die the
+        moment this method returns (only the buffers live on inside
+        the global array), so binding to them would fire the
+        death-finalizer and permanently drop every slot, regressing
+        copying backends to per-gulp fresh allocation.  The global
+        array's ``is_ready()`` proves all shard DMAs drained, which is
+        exactly the recycle condition each slot needs."""
+        import jax
+        c = _counters()
+        use_pool = not self._is_zero_copy() and not strict_mode()
+        shard_arrays = []
+        slots = []
+        shard_bytes = 0
+        try:
+            for dev, idx in plan:
+                piece = arr[idx]
+                nbytes = int(piece.nbytes)
+                shard_bytes = nbytes
+                slot = self._pool.acquire(piece.shape, piece.dtype) \
+                    if use_pool and nbytes >= self.stage_min else None
+                if slot is not None:
+                    # track BEFORE the copy/put: a failure must settle
+                    # every acquired-but-unbound slot, not just this
+                    # one; the flag records whether this slot's DMA
+                    # was ever issued
+                    slots.append([slot, False])
+                    np.copyto(slot.buf, piece, casting='no')
+                    shard_arrays.append(self._put(slot.buf, dev))
+                    slots[-1][1] = True
+                    c.inc('xfer.h2d_staged')
+                else:
+                    staged = _alloc_aligned(piece.shape, piece.dtype)
+                    np.copyto(staged, piece, casting='no')
+                    shard_arrays.append(self._put(staged, dev))
+                    c.inc('xfer.h2d_unstaged')
+                c.inc('xfer.h2d_issued')
+                c.inc('xfer.h2d_bytes', nbytes)
+            out = jax.make_array_from_single_device_arrays(
+                tuple(arr.shape), sharding, shard_arrays)
+        except Exception:
+            # settle every acquired slot: one whose device_put never
+            # ran is clean and returns to the free list; one whose DMA
+            # may already be in flight must never be reused — drop it
+            # (the pool allocates a replacement; accounting stays
+            # balanced either way)
+            for slot, shipped in slots:
+                if shipped:
+                    self._pool._on_array_death(slot)
+                else:
+                    self._pool.release_unused(slot)
+            raise
+        for slot, _shipped in slots:
+            self._pool.bind(slot, out)
+        c.inc('xfer.h2d_sharded')
+        c.inc('xfer.h2d_shard_bytes', shard_bytes)
+        _obs()[0].observe('xfer.h2d_shard_nbytes', shard_bytes)
+        return out
+
     def _stage_real(self, arr, device):
         """Ship a real-valued numpy array: always exactly ONE host copy
         into an engine-owned aligned buffer, then an async device_put —
@@ -553,11 +643,21 @@ class TransferEngine(object):
             arr.shape, arr.dtype, int(arr.nbytes),
             lambda buf: np.copyto(buf, arr, casting='no'), device)
 
-    def to_device(self, arr, device=None):
+    def to_device(self, arr, device=None, sharding=None):
         """numpy -> jax.Array; complex is shipped as two float planes
         and recombined on device.  Safe against the caller mutating or
         recycling ``arr`` after the call returns (the staging-pool
-        contract)."""
+        contract).
+
+        ``sharding`` (a jax Sharding spanning several devices) routes
+        the transfer through the sharded H2D path: host bytes are
+        staged into per-shard aligned buffers, device_put per device,
+        and assembled with ``make_array_from_single_device_arrays`` —
+        the gulp lands mesh-resident with no monolithic copy and no
+        post-hoc reshard.  BF_MESH_H2D=0 (or an unstageable sharding)
+        falls back to one whole-array device_put onto the sharding."""
+        if sharding is not None:
+            return self._to_device_sharded(np.asarray(arr), sharding)
         if device is None:
             # honor the block thread's BlockScope(device=N) binding
             from .device import get_bound_device
@@ -584,6 +684,48 @@ class TransferEngine(object):
         hist.observe('xfer.h2d_nbytes', int(arr.nbytes))
         spans.record_elapsed('h2d', 'xfer', dt, bytes=int(arr.nbytes))
         return out
+
+    def _to_device_sharded(self, arr, sharding):
+        """Sharded H2D (see :meth:`to_device`).  Complex crosses as
+        (re, im) planes each shipped sharded; the on-device recombine
+        keeps the planes' layout, so the result is mesh-resident too.
+        One transfer observation regardless of plane count (matching
+        the single-device complex path), so the sharded and
+        single-device arms of config 11 read comparable histograms."""
+        hist, spans = _obs()
+        t0 = time.perf_counter()
+        faults.fire('xfer.h2d')
+        if np.iscomplexobj(arr):
+            ft = np.float64 if arr.dtype == np.complex128 else np.float32
+            re = np.ascontiguousarray(arr.real, dtype=ft)
+            im = np.ascontiguousarray(arr.imag, dtype=ft)
+            out = _combine(self._ship_sharded_real(re, sharding),
+                           self._ship_sharded_real(im, sharding))
+        else:
+            out = self._ship_sharded_real(arr, sharding)
+        dt = time.perf_counter() - t0
+        hist.observe('xfer.h2d_s', dt)
+        hist.observe('xfer.h2d_nbytes', int(arr.nbytes))
+        spans.record_elapsed('h2d', 'xfer', dt, bytes=int(arr.nbytes))
+        return out
+
+    def _ship_sharded_real(self, arr, sharding):
+        """One real-valued sharded placement: per-shard staged shards
+        when the sharding is stageable (and BF_MESH_H2D allows), else
+        one whole-array staged copy device_put onto the sharding — the
+        staging-slot ship protocol applies on BOTH routes, so neither
+        regresses to per-gulp fresh allocation."""
+        from .parallel.scope import mesh_h2d_enabled
+        plan = self._shard_plan(arr.shape, sharding) \
+            if mesh_h2d_enabled() else None
+        if plan is not None:
+            return self._stage_ship_sharded(arr, sharding, plan)
+        # whole-array fallback: jax.device_put accepts a Sharding as
+        # the placement target (the runtime scatters)
+        _counters().inc('xfer.h2d_sharded_fallback')
+        return self._stage_ship(
+            arr.shape, arr.dtype, int(arr.nbytes),
+            lambda buf: np.copyto(buf, arr, casting='no'), sharding)
 
     def prefetch(self, arr, device=None):
         """Issue the H2D transfer for ``arr`` now and return the device
@@ -798,10 +940,12 @@ def reset_engine():
         _engine = None
 
 
-def to_device(arr, device=None):
+def to_device(arr, device=None, sharding=None):
     """numpy -> jax.Array via the transfer engine (module docstring).
-    Alias-safe: the caller may mutate/recycle ``arr`` immediately."""
-    return engine().to_device(arr, device)
+    Alias-safe: the caller may mutate/recycle ``arr`` immediately.
+    ``sharding`` routes through the sharded H2D path (per-shard staged
+    placement over a mesh — docs/parallel.md)."""
+    return engine().to_device(arr, device, sharding=sharding)
 
 
 def to_host(arr):

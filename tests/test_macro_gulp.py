@@ -146,12 +146,16 @@ def test_host_blocks_fall_back():
     assert snap.get('macro.fallback.block', 0) > 0
 
 
-def test_multi_reader_ring_falls_back():
-    """Two consumers on the fused block's input ring: batching would
-    hold K gulps of guarantee against the peer — must fall back."""
+def test_multi_reader_ring_batches():
+    """Two consumers on the fused block's input ring: formerly a K=1
+    fallback (``macro.fallback.multi_reader``), retired in PR 6 — each
+    reader's guarantee independently pins its own oldest open span, so
+    a K-gulp macro acquire cannot wedge a peer.  Both consumers must
+    see the full correct stream, the fused block must actually batch,
+    and the retirement must be counted."""
     counters.reset()
     with bf.Pipeline(gulp_batch=4) as p:
-        src = NumpySourceBlock(_voltages(6), _hdr(), gulp_nframe=NT)
+        src = NumpySourceBlock(_voltages(8), _hdr(), gulp_nframe=NT)
         b = bf.blocks.copy(src, space='tpu')
         fb = bf.blocks.fused(
             b, [FftStage('fine_time', axis_labels='freq'),
@@ -163,10 +167,21 @@ def test_multi_reader_ring_falls_back():
         sink2 = GatherSink(b_tap)
         p.run()
     snap = counters.snapshot()
-    assert snap.get('macro.fallback.multi_reader', 0) > 0
+    assert snap.get('macro.fallback.multi_reader', 0) == 0
+    assert snap.get('macro.fallback.multi_reader_retired', 0) > 0
     fused_disp = sum(v for k, v in snap.items()
                      if 'Fused' in k and k.endswith('.dispatches'))
-    assert fused_disp == 6
+    fused_gulps = sum(v for k, v in snap.items()
+                      if 'Fused' in k and k.endswith('.gulps'))
+    assert fused_gulps == 8
+    assert fused_disp == 2            # 8 gulps / K=4 -> 2 dispatches
+    # the tap consumer saw every gulp, unmangled by the macro peer
+    base, _fb, _s, _bc = _run_chain(1, 8)
+    assert sink1.result() is not None
+    np.testing.assert_array_equal(sink1.result(), base)
+    raw = np.concatenate([g['re'].astype(np.int8) for g in _voltages(8)])
+    np.testing.assert_array_equal(sink2.result()['re'].astype(np.int8),
+                                  raw)
 
 
 def test_overlap_falls_back():

@@ -326,3 +326,259 @@ def test_fdmt_block_mesh_indivisible_falls_back():
     n = min(base.shape[-1], meshed.shape[-1])
     np.testing.assert_allclose(meshed[:, :n], base[:, :n],
                                rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# mesh-resident pipelines (PR 6): sharded rings, sharded H2D, zero-reshard
+# plans, macro-gulp x mesh, donation under sharding
+# ---------------------------------------------------------------------------
+
+from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+from bifrost_tpu.telemetry import counters
+
+
+def _mesh_chain(mesh, k=1, donate=None, n=6, hlo_stats=False,
+                monkeypatch=None):
+    """config-8-style chain with the WHOLE device segment (H2D copy +
+    fused chain) inside the mesh scope — the zero-reshard topology."""
+    if hlo_stats and monkeypatch is not None:
+        monkeypatch.setenv('BF_MESH_HLO_STATS', '1')
+    counters.reset()
+    rng = np.random.RandomState(42)
+    gulps = [(rng.randn(16, 2, 32) + 1j * rng.randn(16, 2, 32))
+             .astype(np.complex64) for _ in range(n)]
+    hdr = simple_header([-1, 2, 32], 'cf32',
+                        labels=['time', 'pol', 'fine_time'])
+    with bf.Pipeline(gulp_batch=k, donate=donate) as p:
+        src = NumpySourceBlock(gulps, hdr, gulp_nframe=16)
+        with bf.block_scope(mesh=mesh):
+            b = bf.blocks.copy(src, space='tpu')
+            fb = bf.blocks.fused(b, [
+                FftStage('fine_time', axis_labels='freq'),
+                DetectStage('stokes', axis='pol'),
+                ReduceStage('freq', factor=4)], name='MeshFused')
+        b = bf.blocks.copy(fb, space='system')
+        sink = GatherSink(b)
+        p.run()
+    return sink.result(), counters.snapshot()
+
+
+def test_sharded_ring_span_roundtrip():
+    """A sharded jax Array committed into a 'tpu' ring span comes back
+    with its NamedSharding intact (shard-local chunk storage), and the
+    commit is counted on the sharded-gulp/per-shard-bytes telemetry."""
+    import jax
+    from bifrost_tpu.ring import Ring
+    from bifrost_tpu.parallel.scope import time_sharding
+    counters.reset()
+    mesh = create_mesh({'sp': 8})
+    sharding = time_sharding(mesh, 2, 0)
+    ring = Ring(space='tpu', name='shard_rt')
+    hdr = simple_header([-1, 4], 'f32', gulp_nframe=16)
+    data = np.arange(64, dtype=np.float32).reshape(16, 4)
+    arr = jax.device_put(data, sharding)
+    with ring.begin_writing() as w:
+        with w.begin_sequence(hdr, 16, 48) as seq:
+            with ring.open_earliest_sequence(guarantee=True) as rseq:
+                with seq.reserve(16) as ospan:
+                    ospan.set(arr, owned=True)
+                    ospan.commit(16)
+                with rseq.acquire(0, 16) as ispan:
+                    got = ispan.data
+                    assert got.sharding == sharding
+                    np.testing.assert_array_equal(np.asarray(got), data)
+    snap = counters.snapshot()
+    assert snap.get('ring.shard_rt.sharded_gulps') == 1
+    assert snap.get('ring.shard_rt.shard_bytes') == data.nbytes // 8
+    assert snap.get('mesh.sharded_commits') == 1
+
+
+def test_sharded_h2d_placement():
+    """xfer.to_device(sharding=...) stages per-shard aligned buffers and
+    assembles with make_array_from_single_device_arrays — bytes land
+    identical and mesh-resident, and per-shard telemetry is counted."""
+    from bifrost_tpu import xfer
+    from bifrost_tpu.parallel.scope import time_sharding
+    counters.reset()
+    mesh = create_mesh({'sp': 8})
+    sharding = time_sharding(mesh, 3, 0)
+    host = np.random.RandomState(0).randn(32, 3, 5).astype(np.float32)
+    arr = xfer.to_device(host, sharding=sharding)
+    assert arr.sharding == sharding
+    np.testing.assert_array_equal(np.asarray(arr), host)
+    snap = counters.snapshot()
+    assert snap.get('xfer.h2d_sharded') == 1
+    assert snap.get('xfer.h2d_shard_bytes') == host.nbytes // 8
+    # complex rides as two sharded planes recombined on device
+    chost = (host + 1j * host).astype(np.complex64)
+    carr = xfer.to_device(chost, sharding=sharding)
+    assert len(carr.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(carr), chost, rtol=1e-6)
+
+
+def test_sharded_h2d_env_fallback(monkeypatch):
+    """BF_MESH_H2D=0 still lands the gulp on the sharding (whole-array
+    device_put fallback), counted separately."""
+    from bifrost_tpu import xfer
+    from bifrost_tpu.parallel.scope import time_sharding
+    monkeypatch.setenv('BF_MESH_H2D', '0')
+    counters.reset()
+    mesh = create_mesh({'sp': 8})
+    sharding = time_sharding(mesh, 2, 0)
+    host = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    arr = xfer.to_device(host, sharding=sharding)
+    assert arr.sharding == sharding
+    np.testing.assert_array_equal(np.asarray(arr), host)
+    assert counters.snapshot().get('xfer.h2d_sharded_fallback') == 1
+
+
+def test_mesh_chain_zero_reshards(monkeypatch):
+    """The mesh-resident chain: sharded H2D places gulps in exactly the
+    fused plan's in_sharding, the plan carries out_shardings, and the
+    compiled program contains NO collectives (frame-local shard_map) —
+    the only reshard in the whole run is the prewarm's zeros gulp."""
+    mesh = create_mesh({'sp': 8})
+    meshed, snap = _mesh_chain(mesh, hlo_stats=True,
+                               monkeypatch=monkeypatch)
+    base, _ = _mesh_chain(None)
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-4)
+    # compiled mesh plans are collective-free
+    assert snap.get('mesh.plans_analyzed', 0) >= 1
+    assert snap.get('mesh.plans_analyzed') == \
+        snap.get('mesh.plans_collective_free')
+    assert not any(k.startswith('mesh.collectives.') for k in snap)
+    # steady-state gulps arrive pre-sharded: only the prewarm zeros
+    # gulp needed a relayout, and the producer's advertised header
+    # layout matched the consumer's expectation
+    assert snap.get('mesh.reshards', 0) <= 1
+    assert snap.get('mesh.layout_mismatch', 0) == 0
+    # the H2D mover committed sharded spans (6 gulps x re+im planes)
+    assert snap.get('xfer.h2d_sharded', 0) >= 6
+    assert snap.get('mesh.sharded_commits', 0) >= 6
+
+
+def test_mesh_fused_plan_hlo_direct():
+    """Belt-and-braces zero-reshard assertion straight from compiled
+    HLO text: the fused FFT->detect->reduce plan at the ring-resident
+    sharding contains no all-gather / all-reduce / all-to-all /
+    collective-permute instructions."""
+    import jax
+    from bifrost_tpu.parallel.scope import (time_sharding,
+                                            frame_local_plan,
+                                            collective_counts)
+    from bifrost_tpu.stages import walk_headers, compose_stages
+    mesh = create_mesh({'sp': 8})
+    hdr = simple_header([-1, 2, 32], 'cf32',
+                        labels=['time', 'pol', 'fine_time'])
+    stages = [FftStage('fine_time', axis_labels='freq'),
+              DetectStage('stokes', axis='pol'),
+              ReduceStage('freq', factor=4)]
+    headers = walk_headers(stages, hdr)
+    shape = (16, 2, 32)
+
+    def build_local(local_shape):
+        fn, _info = compose_stages(stages, headers, local_shape,
+                                   'complex64')
+        return fn
+
+    got = frame_local_plan(mesh, build_local, shape, 'complex64', 0, 0)
+    assert got is not None
+    plan, in_sh, out_sh = got
+    arg = jax.ShapeDtypeStruct(shape, np.complex64, sharding=in_sh)
+    txt = plan.lower(arg).compile().as_text()
+    assert collective_counts(txt) == {}, collective_counts(txt)
+
+
+def test_mesh_macro_gulp_k_gt_1():
+    """macro-gulp x mesh: K>1 batched dispatch composes with sharded
+    plans — no macro fallback for the mesh block, dispatches amortized,
+    outputs equal the K=1 single-device stream."""
+    mesh = create_mesh({'sp': 8})
+    base, _ = _mesh_chain(None)
+    meshed, snap = _mesh_chain(mesh, k=3, n=6)
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-4)
+    # the fused mesh block took the macro path: 6 gulps in 2 dispatches
+    disp = sum(v for k_, v in snap.items()
+               if 'MeshFused' in k_ and k_.endswith('.dispatches'))
+    gulps = sum(v for k_, v in snap.items()
+                if 'MeshFused' in k_ and k_.endswith('.gulps'))
+    assert (disp, gulps) == (2, 6)
+    # no fallback reason fired for the mesh-eligible blocks (host
+    # source/sink fallbacks are counted under 'block' and are expected)
+    assert snap.get('macro.fallback.overlap', 0) == 0
+    assert snap.get('macro.fallback.topology', 0) == 0
+    assert snap.get('macro.fallback.multi_reader', 0) == 0
+
+
+def test_mesh_donation_under_sharding():
+    """BF_DONATE-style donation composes with sharded plans: the
+    exclusively-owned sharded input chunk is donated into the mesh plan
+    (per-device buffers alias shard by shard) and the output stream is
+    unchanged."""
+    mesh = create_mesh({'sp': 8})
+    base, _ = _mesh_chain(None)
+    meshed, snap = _mesh_chain(mesh, k=2, donate=True, n=6)
+    np.testing.assert_allclose(meshed, base, rtol=1e-5, atol=1e-4)
+    assert snap.get('donation.hits', 0) >= 3
+    assert snap.get('donation.misses', 0) == 0
+
+
+def test_mesh_stage_block_sharded_plan_parity():
+    """An unfused _StageBlock chain under a mesh scope also runs
+    sharded with ring-resident shardings (frame-local shard_map for
+    batch_safe stages) and matches the single-device output."""
+    rng = np.random.RandomState(5)
+    gulps = [(rng.randn(16, 2, 32) + 1j * rng.randn(16, 2, 32))
+             .astype(np.complex64) for _ in range(3)]
+    hdr = simple_header([-1, 2, 32], 'cf32',
+                        labels=['time', 'pol', 'fine_time'])
+
+    def run(mesh):
+        counters.reset()
+        with bf.Pipeline() as p:
+            src = NumpySourceBlock(gulps, hdr, gulp_nframe=16)
+            with bf.block_scope(mesh=mesh):
+                b = bf.blocks.copy(src, space='tpu')
+                b = bf.blocks.fft(b, 'fine_time', axis_labels='freq')
+                b = bf.blocks.detect(b, 'stokes', axis='pol')
+            b = bf.blocks.copy(b, space='system')
+            sink = GatherSink(b)
+            p.run()
+        return sink.result(), counters.snapshot()
+
+    base, _ = run(None)
+    meshed, snap = run(create_mesh({'sp': 8}))
+    np.testing.assert_allclose(meshed, base, rtol=1e-4, atol=1e-3)
+    # both stage blocks committed sharded output spans
+    assert snap.get('mesh.sharded_commits', 0) >= 6
+
+
+def test_mesh_macro_committed_single_device_input():
+    """A producer OUTSIDE the mesh scope, pinned to device 0, commits
+    COMMITTED single-device chunks; the mesh macro consumer must
+    relayout them (counted on mesh.reshards) rather than crash — a jit
+    with explicit in_shardings rejects committed mismatched inputs."""
+    counters.reset()
+    rng = np.random.RandomState(42)
+    gulps = [(rng.randn(16, 2, 32) + 1j * rng.randn(16, 2, 32))
+             .astype(np.complex64) for _ in range(6)]
+    hdr = simple_header([-1, 2, 32], 'cf32',
+                        labels=['time', 'pol', 'fine_time'])
+    mesh = create_mesh({'sp': 8})
+    with bf.Pipeline(gulp_batch=2) as p:
+        src = NumpySourceBlock(gulps, hdr, gulp_nframe=16)
+        b = bf.blocks.copy(src, space='tpu', device=0)   # committed
+        with bf.block_scope(mesh=mesh):
+            fb = bf.blocks.fused(b, [
+                FftStage('fine_time', axis_labels='freq'),
+                DetectStage('stokes', axis='pol'),
+                ReduceStage('freq', factor=4)], name='MacroReshard')
+        b = bf.blocks.copy(fb, space='system')
+        sink = GatherSink(b)
+        p.run()
+    snap = counters.snapshot()
+    base, _ = _mesh_chain(None)
+    np.testing.assert_allclose(sink.result(), base, rtol=1e-5,
+                               atol=1e-4)
+    # the wrong-layout producer is visible: per-macro-span relayouts
+    assert snap.get('mesh.reshards', 0) >= 3

@@ -14,12 +14,14 @@ Panes (matching the reference's information set):
     block publishes into its perf ProcLog — docs/observability.md),
     G/D = logical gulps per dispatch (1.0 unbatched; ~K when
     macro-gulp execution is amortizing dispatch — docs/perf.md),
+    Shd = mesh width of the executing plan (1 single-device; N when
+    the block runs sharded over an N-chip mesh — docs/parallel.md),
     command line
 
 Interactive curses UI with the reference's sort keys (i=pid, b=name,
 c=core, t=total, a=acquire, p=process, r=reserve, plus l=p99 gulp
-latency, w=p99 ring wait, and g=gulps-per-dispatch; pressing the
-active key again reverses; q quits).  ``--once`` prints one
+latency, w=p99 ring wait, g=gulps-per-dispatch, and s=shards; pressing
+the active key again reverses; q quits).  ``--once`` prints one
 plain-text snapshot instead (usable in pipes/tests).
 """
 
@@ -188,7 +190,10 @@ def collect_blocks(pids=None):
                 'wait99': max(0.0, _num(perf.get('ring_wait_p99'))),
                 # macro-gulp amortization: logical gulps per dispatch
                 # (1.0 unbatched; K when macro-gulp execution engaged)
-                'gpd': max(0.0, _num(perf.get('gulps_per_dispatch')))}
+                'gpd': max(0.0, _num(perf.get('gulps_per_dispatch'))),
+                # mesh width of the executing plan (docs/parallel.md;
+                # 1 = single device, N = sharded over N chips)
+                'shards': max(1.0, _num(perf.get('shards')) or 1.0)}
     return rows
 
 
@@ -228,9 +233,10 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
                       dev['devCount']))
     out.append('')
     hdr = '%6s  %-24s  %4s  %5s  %8s  %8s  %8s  %8s  %8s  %8s  %8s' \
-          '  %5s  Cmd' \
+          '  %5s  %3s  Cmd' \
         % ('PID', 'Block', 'Core', '%CPU', 'Total', 'Acquire',
-           'Process', 'Reserve', 'p50(ms)', 'p99(ms)', 'Wait99', 'G/D')
+           'Process', 'Reserve', 'p50(ms)', 'p99(ms)', 'Wait99', 'G/D',
+           'Shd')
     out.append(hdr)
     order = sorted(rows, key=lambda k: rows[k][sort_key],
                    reverse=sort_rev)
@@ -242,18 +248,18 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
             pct = '%5s' % ' '
         name = d['name'].split('/')[-1][:24]
         out.append('%6i  %-24s  %4s  %5s  %8.3f  %8.3f  %8.3f  %8.3f'
-                   '  %8.2f  %8.2f  %8.2f  %5.1f  %s'
+                   '  %8.2f  %8.2f  %8.2f  %5.1f  %3i  %s'
                    % (d['pid'], name, d['core'], pct, d['total'],
                       d['acquire'], d['process'], d['reserve'],
                       d['p50'] * 1e3, d['p99'] * 1e3,
-                      d['wait99'] * 1e3, d['gpd'],
-                      d['cmd'][:max(width - 133, 0)]))
+                      d['wait99'] * 1e3, d['gpd'], int(d['shards']),
+                      d['cmd'][:max(width - 138, 0)]))
     return out
 
 
 _SORT_KEYS = {'i': 'pid', 'b': 'name', 'c': 'core', 't': 'total',
               'a': 'acquire', 'p': 'process', 'r': 'reserve',
-              'l': 'p99', 'w': 'wait99', 'g': 'gpd'}
+              'l': 'p99', 'w': 'wait99', 'g': 'gpd', 's': 'shards'}
 
 
 def run_curses(args):

@@ -18,6 +18,10 @@
 #   BENCH_BATCH_${ROUND}.json - macro-gulp batch gate (config 9 on CPU:
 #                               K=16 >= K=1 min-of-N, alternating arm
 #                               order; tools/batch_gate.py)
+#   MULTICHIP_${ROUND}.json   - mesh pipeline gate (config 11 on an
+#                               8-device host mesh: sharded arm matches
+#                               single-device, zero-reshard plans;
+#                               tools/mesh_gate.py)
 #   bench_watch.log           - probe/attempt history (gitignored)
 cd "$(dirname "$0")/.." || exit 1
 ROUND="${BF_BENCH_ROUND:-r$(date -u +%Y%m%d)}"
@@ -102,6 +106,23 @@ for i in $(seq 1 400); do
         if [ "$brg" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) ring bridge wire gate FAILED" >> "$LOG"
           exit "$brg"
+        fi
+      fi
+      # Mesh-resident pipeline gate: config 11 on an 8-device
+      # host-platform mesh — the sharded arm must match the
+      # single-device arm, sharded spans must actually flow, and the
+      # compiled mesh plans must be collective-free (zero reshards).
+      # Writes MULTICHIP_${ROUND}.json (the revived multichip artifact
+      # series).  A failure exits nonzero (the capture artifacts above
+      # are already in place).
+      if [ "${BF_SKIP_MESH_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) mesh pipeline gate (config 11, 8-dev host mesh)" >> "$LOG"
+        python tools/mesh_gate.py --out "MULTICHIP_${ROUND}.json" >> "$LOG" 2>&1
+        mrc=$?
+        echo "$(date -u +%FT%TZ) mesh gate rc=$mrc" >> "$LOG"
+        if [ "$mrc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) mesh pipeline gate FAILED" >> "$LOG"
+          exit "$mrc"
         fi
       fi
       exit 0
