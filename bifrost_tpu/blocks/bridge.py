@@ -45,6 +45,15 @@ __all__ = ['BridgeSink', 'BridgeSource', 'bridge_sink', 'bridge_source']
 class _BridgeBlock(Block):
     """Shared supervision plumbing for the bridge endpoints."""
 
+    def _publish_bridge_role(self, role, peer):
+        """``<block>/bridge`` ProcLog marking this block as a
+        CROSS-HOST boundary: tools/pipeline2dot.py renders bridge
+        endpoints distinctly (annotated with the live tx/rx rates and
+        reconnect counts from the ``*_bridge_transmit|capture/stats``
+        entries the transport publishes)."""
+        ProcLog(self.name + '/bridge').update(
+            {'role': role, 'peer': peer}, force=True)
+
     def _release_init_barrier(self):
         """Bridge endpoints check in at the pipeline init barrier
         immediately and DO NOT park on it: their sequences come from
@@ -100,6 +109,8 @@ class BridgeSink(_BridgeBlock):
         self._sender = None
         self.out_proclog = ProcLog(self.name + '/out')
         self.out_proclog.update({'nring': 0})
+        self._publish_bridge_role('sink',
+                                  '%s:%d' % (self.address, self.port))
 
     def _define_valid_input_spaces(self):
         # the bridge exports raw host bytes; device rings have no
@@ -181,6 +192,8 @@ class BridgeSource(_BridgeBlock):
             rnames['ring%i' % i] = r.name
         self.out_proclog.update(rnames)
         self._receiver = None
+        self._publish_bridge_role('source',
+                                  '%s:%d' % (self.address, self.port))
 
     def _define_valid_input_spaces(self):
         return []

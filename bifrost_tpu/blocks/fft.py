@@ -147,7 +147,7 @@ class _StageBlock(TransformBlock):
         if self.mesh is not None and self._taxis_in is not None:
             from ..parallel.scope import shard_gulp
             x = shard_gulp(x, self.mesh, self._taxis_in)
-        ospan.set(plan(x), owned=True)
+        ospan.set(self._dispatch_device(plan, (x,)), owned=True)
 
 
 class FftBlock(_StageBlock):

@@ -333,7 +333,7 @@ class FusedBlock(TransformBlock):
         if taxis is not None:
             from ..parallel.scope import shard_gulp
             x = shard_gulp(x, self.mesh, taxis)
-        return fn(x)
+        return self._dispatch_device(fn, (x,))
 
     def _execute_macro(self, parts, donate, gulp_nframe):
         """Macro-gulp execution: run ONE compiled program over a
@@ -444,7 +444,7 @@ class FusedBlock(TransformBlock):
             from ..parallel.scope import shard_gulp
             parts = [shard_gulp(p, self.mesh, shard_taxis)
                      for p in parts]
-        return fn(*parts)
+        return self._dispatch_device(fn, parts)
 
     def on_data(self, ispan, ospan):
         if self._gulp_batch_active > 1 and self._macro_gulp_in:

@@ -4,16 +4,36 @@
 A bifrost_tpu sequence header is a JSON-able dict with at minimum a
 ``_tensor`` block; this module documents/validates the recommended
 observation fields so blocks can interoperate.
+
+It also owns the **trace context** a distributed stream carries
+(docs/observability.md "Distributed tracing & SLOs"): the block that
+ORIGINATES a stream stamps a stream-unique trace id plus an origin
+wall-clock timestamp into the sequence header under ``_trace`` at
+first commit; every downstream block copies it into its output
+headers, and the ring bridge ships headers verbatim — so the identity
+survives process and host boundaries without any side channel.  The
+trace id keys cross-host span correlation (``tools/trace_merge.py``)
+and the origin timestamp feeds the capture-to-commit SLO tracker
+(:mod:`bifrost_tpu.telemetry.slo`).  ``BF_TRACE_CONTEXT=0`` disables
+stamping (headers then carry no ``_trace`` and both consumers degrade
+to per-host views).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import socket
+import time
+import uuid
 
 import numpy as np
 
 __all__ = ['STANDARD_HEADER_FIELDS', 'enforce_header_standard',
-           'serialize_header', 'deserialize_header']
+           'serialize_header', 'deserialize_header',
+           'TRACE_CONTEXT_KEY', 'trace_context_enabled',
+           'new_trace_context', 'ensure_trace_context',
+           'trace_context', 'propagate_trace_context']
 
 # field -> required type(s)
 STANDARD_HEADER_FIELDS = {
@@ -53,6 +73,75 @@ def deserialize_header(payload):
     if isinstance(payload, (bytes, bytearray, memoryview)):
         payload = bytes(payload).decode()
     return json.loads(payload)
+
+
+# ---------------------------------------------------------------------------
+# trace context (docs/observability.md "Distributed tracing & SLOs")
+# ---------------------------------------------------------------------------
+
+#: header key carrying the stream's trace context (a plain JSON dict,
+#: so it serializes through every transport the headers already use)
+TRACE_CONTEXT_KEY = '_trace'
+
+
+def trace_context_enabled():
+    """Whether new streams get a trace context stamped
+    (``BF_TRACE_CONTEXT`` != '0'; default on — the stamp is one small
+    dict per SEQUENCE, not per gulp)."""
+    return os.environ.get('BF_TRACE_CONTEXT', '1') != '0'
+
+
+def new_trace_context():
+    """A fresh trace context::
+
+        {'id':        16-hex stream-unique trace id,
+         'origin_ns': wall-clock ns when the stream was first
+                      committed (the capture instant the SLO tracker
+                      ages against; wall clock — NOT the per-process
+                      span clock — so it survives host hops),
+         'host':      origin hostname (merged-trace labeling)}
+    """
+    return {'id': uuid.uuid4().hex[:16],
+            'origin_ns': time.time_ns(),
+            'host': socket.gethostname()}
+
+
+def trace_context(header):
+    """The header's trace context dict, or None (absent / malformed)."""
+    if not isinstance(header, dict):
+        return None
+    ctx = header.get(TRACE_CONTEXT_KEY)
+    if isinstance(ctx, dict) and ctx.get('id'):
+        return ctx
+    return None
+
+
+def ensure_trace_context(header):
+    """Stamp a fresh trace context into ``header`` if it has none (and
+    stamping is enabled).  Returns the context in effect, or None.
+    Called by stream-ORIGIN blocks (SourceBlock and externally-fed
+    writers) at first commit; transforms propagate instead."""
+    ctx = trace_context(header)
+    if ctx is not None:
+        return ctx
+    if not trace_context_enabled():
+        return None
+    ctx = new_trace_context()
+    header[TRACE_CONTEXT_KEY] = ctx
+    return ctx
+
+
+def propagate_trace_context(iheader, oheaders):
+    """Copy the input sequence's trace context into every output
+    header that lacks one (transform/sink blocks: the stream identity
+    follows the data).  Returns the context, or None."""
+    ctx = trace_context(iheader)
+    if ctx is None:
+        return None
+    for ohdr in oheaders:
+        if isinstance(ohdr, dict) and trace_context(ohdr) is None:
+            ohdr[TRACE_CONTEXT_KEY] = dict(ctx)
+    return ctx
 
 
 def enforce_header_standard(header):

@@ -62,7 +62,8 @@ from collections import OrderedDict
 
 import numpy as np
 
-from ..header_standard import serialize_header, deserialize_header
+from ..header_standard import (serialize_header, deserialize_header,
+                               trace_context)
 from ..ring import EndOfDataStop, RingPoisonedError
 from .udp_socket import retry_transient
 
@@ -130,6 +131,33 @@ def _counters():
 def _histograms():
     from ..telemetry import histograms
     return histograms
+
+
+def _spans():
+    from ..telemetry import spans
+    return spans
+
+
+def _trace_id(hdr):
+    """The stream's trace id from a sequence header's trace context
+    (header_standard.trace_context), or None — bridge tx/rx spans
+    carry it so a gulp is traceable across the host boundary
+    (tools/trace_merge.py)."""
+    ctx = trace_context(hdr)
+    return ctx['id'] if ctx else None
+
+
+def _rate_mbps(last_pub, nbytes):
+    """Inter-publish byte rate in MB/s for the stats proclogs:
+    ``(rate, new_last_pub)`` given the previous ``(monotonic, bytes)``
+    pair (or None on the first publish)."""
+    now = time.monotonic()
+    rate = 0.0
+    if last_pub is not None:
+        dt = now - last_pub[0]
+        if dt > 0:
+            rate = (nbytes - last_pub[1]) / dt / 1e6
+    return max(rate, 0.0), (now, nbytes)
 
 
 # ---------------------------------------------------------------------------
@@ -431,8 +459,13 @@ class RingSender(object):
         self._tx_bytes = 0
         self._tx_frames = 0
         self._tx_spans = 0
+        self._last_pub = None        # (monotonic, bytes) for rate
         self._seqs = None
         self._seq_gen = None
+        #: per-sequence trace identity for tx spans (trace id from the
+        #: header's trace context + local sequence ordinal)
+        self._cur_trace = None
+        self._cur_seq = -1
 
     # -- public ------------------------------------------------------------
     def prime(self):
@@ -503,17 +536,22 @@ class RingSender(object):
 
     def _publish_stats(self, force=False):
         """like_bmon TX row: the monitors read ``*_transmit_*/stats``
-        entries with nbytes/npackets (tools/like_bmon.py)."""
+        entries with nbytes/npackets (tools/like_bmon.py); the
+        inter-publish byte rate feeds pipeline2dot's cross-host
+        boundary annotation."""
         try:
             if self._stats_proclog is None:
                 from ..proclog import ProcLog
                 self._stats_proclog = ProcLog(
                     '%s_bridge_transmit/stats' % self.name)
             if force or self._stats_proclog.ready():
+                rate, self._last_pub = _rate_mbps(self._last_pub,
+                                                  self._tx_bytes)
                 self._stats_proclog.update(
                     {'nbytes': self._tx_bytes,
                      'npackets': self._tx_frames,
                      'nspans': self._tx_spans,
+                     'rate_MBps': round(rate, 3),
                      'reconnects': self._reconnects}, force=force)
         except Exception:
             pass
@@ -620,23 +658,52 @@ class RingSender(object):
     def _handshake(self, socks, timeout=30.0):
         """HELLO/HELLO_ACK exchange, bounded: a peer that accepted
         the TCP connection but never answers must surface as a
-        ConnectionError (retryable), not a forever-blocked thread."""
+        ConnectionError (retryable), not a forever-blocked thread.
+
+        The exchange doubles as a clock PING (docs/observability.md):
+        each HELLO carries this side's span-clock timestamp; a
+        context-aware receiver echoes its own in the HELLO_ACK, and
+        the sender estimates the peer's span-clock offset at half the
+        round trip — the shift ``tools/trace_merge.py`` uses to join
+        both hosts' Chrome traces onto one timeline.  v2 peers without
+        the timestamps simply omit them (extra JSON keys are ignored
+        both ways), so the wire stays version-compatible."""
+        spans_mod = _spans()
         for s in socks:
             s.settimeout(timeout)
+        t_sent = {}
         try:
             for i, s in enumerate(socks):
                 hello = {'version': WIRE_VERSION,
                          'session': self.session,
                          'stream_id': i, 'nstreams': len(socks),
-                         'window': self.window, 'crc': bool(self.crc)}
+                         'window': self.window, 'crc': bool(self.crc),
+                         'ts_us': round(spans_mod.now_us(), 3)}
+                t_sent[i] = spans_mod.now_us()
                 _send_msg(s, MSG_HELLO, serialize_header(hello))
-            for s in socks:
+            for i, s in enumerate(socks):
                 mtype, payload = _recv_msg(s)
+                t_ack = spans_mod.now_us()
                 if mtype != MSG_HELLO_ACK:
                     raise BridgeProtocolError(
                         "expected HELLO_ACK, got message type %d "
                         "(v1-only peer? configure "
                         "RingSender(protocol=1))" % mtype)
+                try:
+                    ack = deserialize_header(payload)
+                except Exception:
+                    ack = {}
+                peer_ts = ack.get('ts_us')
+                if isinstance(peer_ts, (int, float)):
+                    rtt = max(t_ack - t_sent[i], 0.0)
+                    # peer stamped its clock ~mid-flight: offset =
+                    # peer_clock - our_clock at the same instant
+                    offset = peer_ts - (t_sent[i] + rtt / 2.0)
+                    spans_mod.note_peer_clock(self.session, 'tx',
+                                              offset_us=offset,
+                                              rtt_us=rtt)
+                else:
+                    spans_mod.note_peer_clock(self.session, 'tx')
         except socket.timeout as exc:
             raise ConnectionError(
                 "bridge handshake timed out after %.0fs"
@@ -836,8 +903,20 @@ class RingSender(object):
         lanes, nbyte = self._span_lanes(span)
         crc = _lane_crc(lanes) if self.crc else 0
         ngulps = max(1, -(-span.nframe // max(gulp, 1)))
+        spans_mod = _spans()
+        t0 = spans_mod.now_us() if spans_mod.enabled() else None
         self._emit(MSG_SPAN, span=span, lanes=lanes,
                    meta=_SPAN2.pack(ngulps, crc))
+        if t0 is not None:
+            # tx span under the stream's trace identity: the same
+            # (trace, seq, gulp) triple the receiving host records,
+            # so the merged timeline shows the hop itself
+            spans_mod.record('bridge.tx.%s' % self.name, 'bridge', t0,
+                             spans_mod.now_us() - t0,
+                             {'trace': self._cur_trace,
+                              'seq': self._cur_seq,
+                              'gulp': span.frame_offset // max(gulp, 1),
+                              'gulps': ngulps, 'bytes': nbyte})
         if self.heartbeat is not None:
             self.heartbeat()
 
@@ -943,6 +1022,14 @@ class RingSender(object):
                 gulp = int(self.gulp_nframe
                            or hdr.get('gulp_nframe', 1) or 1)
                 batch = gulp * self.gulp_batch
+                # span identity + logical-gulp crediting must use the
+                # SHIPPED header's gulp size — the receiver derives its
+                # (trace, seq, gulp) triple and ring.<name>.gulps
+                # credits from that header (falling back to 1), so a
+                # sender-side gulp_nframe override must not skew either
+                hdr_gulp = int(hdr.get('gulp_nframe', 1) or 1)
+                self._cur_trace = _trace_id(hdr)
+                self._cur_seq += 1
                 self._emit(MSG_HEADER, serialize_header(hdr))
                 # reader-side buffering: the credit window pins the
                 # tail at the oldest unacked span, so the ring needs
@@ -969,7 +1056,7 @@ class RingSender(object):
                             continue
                         break
                     offset = advanced
-                    self._emit_span(span, gulp)
+                    self._emit_span(span, hdr_gulp)
                 self._emit(MSG_END_SEQ)
                 if self._stop_requested():
                     break
@@ -1050,6 +1137,12 @@ class RingReceiver(object):
         self._rx_spans = 0
         self._rx_dups = 0
         self._rx_crc_errors = 0
+        self._last_pub = None        # (monotonic, bytes) for rate
+        #: per-sequence trace identity for rx spans (mirrors the
+        #: sender: trace id from the shipped header + local ordinal)
+        self._cur_trace = None
+        self._cur_seq = -1
+        self._cur_gulp_nframe = 1
 
     # -- public ------------------------------------------------------------
     def run(self):
@@ -1147,18 +1240,23 @@ class RingReceiver(object):
 
     def _publish_stats(self, force=False):
         """like_bmon RX row: ``*_capture/stats`` shape the monitors
-        already parse (ngood/missing/invalid/ignored)."""
+        already parse (ngood/missing/invalid/ignored); the
+        inter-publish byte rate feeds pipeline2dot's cross-host
+        boundary annotation."""
         try:
             if self._stats_proclog is None:
                 from ..proclog import ProcLog
                 self._stats_proclog = ProcLog(
                     '%s_bridge_capture/stats' % self.name)
             if force or self._stats_proclog.ready():
+                rate, self._last_pub = _rate_mbps(self._last_pub,
+                                                  self._rx_bytes)
                 self._stats_proclog.update(
                     {'ngood_bytes': self._rx_bytes,
                      'nmissing_bytes': 0,
                      'ninvalid': self._rx_crc_errors,
                      'nignored': self._rx_dups,
+                     'rate_MBps': round(rate, 3),
                      'npackets': self._rx_frames}, force=force)
         except Exception:
             pass
@@ -1177,6 +1275,9 @@ class RingReceiver(object):
                 "MSG_HEADER while the previous sequence %r is still "
                 "open (missing MSG_END_SEQ)" % (self._wseq.name,))
         gulp = hdr.get('gulp_nframe', 1) or 1
+        self._cur_trace = _trace_id(hdr)
+        self._cur_seq += 1
+        self._cur_gulp_nframe = max(int(gulp), 1)
         # receive-side buffering stays at the classic 3 gulps: the
         # credit window's overlap lives on the SENDER side (spans in
         # flight) and in the kernel socket buffers — a window-scaled
@@ -1215,14 +1316,28 @@ class RingReceiver(object):
                 % (payload_nbyte, self._nringlet, self._frame_nbyte))
         return self._wseq.reserve(nframe), nframe
 
+    def _record_rx_span(self, t0, nbyte, ngulps, frame_offset):
+        """One rx span under the stream's trace identity — the
+        receiving-host twin of the sender's ``bridge.tx.*`` span."""
+        spans_mod = _spans()
+        spans_mod.record(
+            'bridge.rx.%s' % self.name, 'bridge', t0,
+            spans_mod.now_us() - t0,
+            {'trace': self._cur_trace, 'seq': self._cur_seq,
+             'gulp': frame_offset // self._cur_gulp_nframe,
+             'gulps': ngulps, 'bytes': nbyte})
+
     def _commit_span_bytes(self, payload, ngulps=1, crc=None):
         """Striped / v1 path: payload already in host memory; scatter
         into the reserved span."""
+        spans_mod = _spans()
+        t0 = spans_mod.now_us() if spans_mod.enabled() else None
         if crc is not None and self._crc:
             got = zlib.crc32(payload) & 0xffffffff
             if got != crc:
                 raise self._crc_mismatch(crc, got)
         span, nframe = self._reserve(len(payload))
+        frame_offset = span.frame_offset
         try:
             lanes = span.lane_memoryviews()
             if lanes is not None:
@@ -1241,11 +1356,17 @@ class RingReceiver(object):
             span.close()
             raise
         span.close()
+        if t0 is not None:
+            self._record_rx_span(t0, len(payload), ngulps,
+                                 frame_offset)
 
     def _recv_span_into_ring(self, sock, payload_nbyte, ngulps, crc):
         """Single-stream zero-copy path: ``recv_into`` straight into
         the reserved span's lane views (no intermediate buffer)."""
+        spans_mod = _spans()
+        t0 = spans_mod.now_us() if spans_mod.enabled() else None
         span, nframe = self._reserve(payload_nbyte)
+        frame_offset = span.frame_offset
         try:
             lanes = span.lane_memoryviews()
             if lanes is None:
@@ -1271,6 +1392,9 @@ class RingReceiver(object):
             span.close()
             raise
         span.close()
+        if t0 is not None:
+            self._record_rx_span(t0, payload_nbyte, ngulps,
+                                 frame_offset)
 
     def _crc_mismatch(self, want, got):
         self._rx_crc_errors += 1
@@ -1334,6 +1458,11 @@ class RingReceiver(object):
                 "HELLO from a different session (%r, expected %r)"
                 % (session, self._session))
         self._session = session
+        if session:
+            # register the session in this process's trace metadata so
+            # trace_merge.py can pair this host's timeline with the
+            # sender's (which holds the ping-estimated clock offset)
+            _spans().note_peer_clock(session, 'rx')
         nstreams = max(int(hello.get('nstreams', 1) or 1), 1)
         self._window = max(int(hello.get('window', 1) or 1), 1)
         if self.crc_forced is None:
@@ -1355,8 +1484,14 @@ class RingReceiver(object):
             if peer.get('session') != self._session:
                 raise BridgeProtocolError(
                     "stripe HELLO from a different session")
-        ack = serialize_header({'version': WIRE_VERSION})
+        spans_mod = _spans()
         for s in socks:
+            # per-sock timestamp: the clock-ping echo must be stamped
+            # at SEND time, not once for the batch (the sender halves
+            # its measured RTT around this instant)
+            ack = serialize_header({'version': WIRE_VERSION,
+                                    'ts_us': round(spans_mod.now_us(),
+                                                   3)})
             _send_msg(s, MSG_HELLO_ACK, ack)
         return socks
 

@@ -34,6 +34,7 @@ import struct
 import numpy as np
 
 __all__ = ['IpcRing', 'DadaHDU', 'sysv_available',
+           'shm_accounting_available',
            'DADA_HEADER_SIZE', 'DEFAULT_KEY',
            'PSRDADA_SYNC_SIZE', 'decode_psrdada_sync',
            'encode_psrdada_sync']
@@ -180,6 +181,32 @@ def sysv_available():
         return True
     except Exception:
         return False
+
+
+def shm_accounting_available():
+    """Whether SysV segment ATTACHMENT accounting works here: the
+    stale-segment recovery and live-ring protection read nattch from
+    ``/proc/sysvipc/shm``, which sandboxed kernels (gVisor-style
+    containers) omit even when shmget/shmat themselves work.  Without
+    it those protections silently degrade (a live ring cannot be
+    distinguished from a stale one) — tests exercising them should
+    skip rather than fail (tests/test_dada_shm.py)."""
+    if not sysv_available():
+        return False
+    import errno as errno_mod
+    probe_key = 0x5bfb
+    libc = _get_libc()
+    # EXCL: a pre-existing segment at the probe key belongs to someone
+    # else and must not be attached (or RMID'd out from under them)
+    shmid = libc.shmget(probe_key, 4096, IPC_CREAT | IPC_EXCL | 0o600)
+    if shmid < 0:
+        if ctypes.get_errno() == errno_mod.EEXIST:
+            return _shm_nattch(probe_key) is not None
+        return False
+    try:
+        return _shm_nattch(probe_key) is not None
+    finally:
+        libc.shmctl(shmid, IPC_RMID, None)
 
 
 def _shm_nattch(key):

@@ -34,8 +34,11 @@ from contextlib import ExitStack, nullcontext
 from copy import copy
 
 from . import affinity, device, memory
+from .header_standard import (TRACE_CONTEXT_KEY, ensure_trace_context,
+                              propagate_trace_context)
 from .telemetry import exporter as _metrics_exporter
 from .telemetry import histograms as _histograms
+from .telemetry import slo as _slo
 from .telemetry import spans as _spans
 from .trace import ScopedTracer, tracing_enabled as _tracing
 from .ring import Ring, ring_view, EndOfDataStop, RingPoisonedError
@@ -470,12 +473,13 @@ class Pipeline(BlockScope):
             from .device import ensure_backend
             ensure_backend()
         faults.arm_from_env()
-        # honor BF_TRACE_FILE / BF_SPAN_BUFFER changes made since the
-        # last run (tests, long-lived operator processes), and drop
-        # dead threads' span buffers so this run's trace export /
-        # flight record is not contaminated by earlier runs
+        # honor BF_TRACE_FILE / BF_SPAN_BUFFER / BF_SLO_MS changes made
+        # since the last run (tests, long-lived operator processes),
+        # and drop dead threads' span buffers so this run's trace
+        # export / flight record is not contaminated by earlier runs
         _spans.reconfigure()
         _spans.prune_dead_buffers()
+        _slo.reset_budget()
         self._shutting_down = False
         self.supervisor = Supervisor(self)
         self.threads = [threading.Thread(target=block.run, name=block.name)
@@ -628,6 +632,12 @@ class Block(BlockScope):
         #: plans set this when they publish impl info; 1 = one device).
         #: Rendered as like_top's Shd column from the perf proclog.
         self._shards_active = 1
+        #: trace context of the CURRENT sequence (docs/observability.md
+        #: "Distributed tracing & SLOs"): stamped by stream-origin
+        #: blocks, propagated input->output by transforms/sinks, and
+        #: carried in compute-span args so one gulp is traceable
+        #: across blocks, pipelines, and hosts
+        self._trace_ctx = None
         self.bind_proclog = ProcLog(self.name + '/bind')
         self.in_proclog = ProcLog(self.name + '/in')
         rnames = {'nring': len(self.irings)}
@@ -648,12 +658,26 @@ class Block(BlockScope):
     # -- observability (docs/observability.md) ----------------------------
     def _compute_span(self, seq, gulp):
         """Gulp-identity compute span: every gulp is traceable across
-        blocks by its (sequence, gulp_index) args in the Chrome trace /
-        flight recorder.  Free when span recording is off."""
+        blocks by its (sequence, gulp_index) args — and, when the
+        stream carries a trace context, across PIPELINES AND HOSTS by
+        the stream-unique trace id (tools/trace_merge.py joins on the
+        (trace, seq, gulp) triple).  Free when span recording is
+        off."""
         if _spans.enabled():
+            kwargs = {'seq': seq, 'gulp': gulp}
+            if self._trace_ctx is not None:
+                kwargs['trace'] = self._trace_ctx.get('id')
             return _spans.span(self.name + '.on_data', 'compute',
-                               seq=seq, gulp=gulp)
+                               **kwargs)
         return nullcontext()
+
+    def _observe_exit_age(self, iheader, frame_end):
+        """Capture->pipeline-exit SLO observation (sink blocks: the
+        data is leaving the pipeline here).  No-op without a
+        trace-context origin in the input header."""
+        age = _slo.capture_age_s(iheader, frame_end)
+        if age is not None:
+            _slo.observe_exit(self.name, age)
 
     def _observe_gulp(self, acquire, reserve, process):
         """Record this gulp into the block's latency histograms
@@ -697,6 +721,13 @@ class Block(BlockScope):
                 self._n_gulps_logical / float(self._n_dispatches), 3)
         if self._shards_active > 1:
             stats['shards'] = int(self._shards_active)
+        # capture-to-commit age p99 (telemetry.slo; like_top's Age99
+        # column): transforms age at their output-ring commits, sinks
+        # at pipeline exit
+        h_age = _histograms.get('slo.%s.commit_age_s' % self.name) \
+            or _histograms.get('slo.%s.exit_age_s' % self.name)
+        if h_age is not None and h_age.count:
+            stats['commit_age_p99'] = round(h_age.percentile(99), 6)
         return stats
 
     def create_ring(self, *args, **kwargs):
@@ -1016,10 +1047,21 @@ class SourceBlock(Block):
         with self.create_reader(sourcename) as ireader:
             faults.fire('block.on_sequence', self.name)
             oheaders = self.on_sequence(ireader, sourcename)
+            ctx = None
             for ohdr in oheaders:
                 ohdr.setdefault('time_tag', self._seq_count)
                 ohdr.setdefault('name',
                                 'unnamed-sequence-%i' % self._seq_count)
+                # stream origin: stamp the stream-unique trace id +
+                # capture timestamp here, at first commit — every
+                # downstream block (and host, via the bridge) inherits
+                # it (docs/observability.md).  One context per source
+                # sequence: multi-output sources share the identity.
+                if ctx is None:
+                    ctx = ensure_trace_context(ohdr)
+                elif isinstance(ohdr, dict):
+                    ohdr.setdefault(TRACE_CONTEXT_KEY, dict(ctx))
+            self._trace_ctx = ctx
             self._seq_count += 1
             seq_id = self._seq_count - 1
             gulp_index = 0
@@ -1220,6 +1262,12 @@ class MultiTransformBlock(Block):
         oheaders = self._on_sequence(iseqs)
         for ohdr in oheaders:
             ohdr.setdefault('time_tag', self._seq_count)
+        # trace-context propagation: the stream identity follows the
+        # data input->output (a block's own on_sequence may override
+        # by stamping `_trace` itself; absent upstream context — e.g.
+        # BF_TRACE_CONTEXT=0 at the origin — nothing is stamped)
+        self._trace_ctx = propagate_trace_context(iseqs[0].header,
+                                                  oheaders)
         self._seq_count += 1
         seq_id = self._seq_count - 1
         gulp_index = 0
@@ -1368,6 +1416,13 @@ class MultiTransformBlock(Block):
                 self._observe_gulp(acquire_time, reserve_time,
                                    process_time)
                 self._observe_dispatch(ngulps)
+                if not self.orings and self._trace_ctx is not None:
+                    # sink block: the gulp leaves the pipeline here —
+                    # record its capture->exit age (the pipeline-exit
+                    # p50/p99 of the capture-to-commit SLO)
+                    self._observe_exit_age(
+                        iseqs[0].header,
+                        ispans[0].frame_offset + ispans[0].nframe)
                 perf = {'acquire_time': acquire_time,
                         'reserve_time': reserve_time,
                         'process_time': process_time}
@@ -1435,6 +1490,26 @@ class TransformBlock(MultiTransformBlock):
         if getattr(self, '_donate_on', None) is None:
             self._donate_on = resolve_donate(self)
         return self._donate_on
+
+    def _dispatch_device(self, fn, args):
+        """One compiled-plan dispatch (shared by FusedBlock and the
+        jitted stage blocks, per-gulp and macro paths alike): brackets
+        the FIRST dispatch of the process with the JAX profiler when
+        ``BF_JAX_PROFILE=<dir>`` is armed (telemetry.profiling — one
+        capture, then free), and records a per-shard dispatch span
+        when the executing plan is mesh-wide (cat 'mesh', args
+        shards=N + the stream's trace id) so the Chrome trace shows
+        which dispatches ran N chips wide."""
+        from .telemetry import profiling
+        thunk = lambda: fn(*args)               # noqa: E731
+        if _spans.enabled() and self._shards_active > 1:
+            span_args = {'shards': int(self._shards_active)}
+            if self._trace_ctx is not None:
+                span_args['trace'] = self._trace_ctx.get('id')
+            with _spans.span('%s.dispatch' % self.name, 'mesh',
+                             **span_args):
+                return profiling.profiled_dispatch(thunk)
+        return profiling.profiled_dispatch(thunk)
 
     def _take_donatable(self, ispan, allow_parts=False):
         """The input span's device chunk claimed exclusively for

@@ -50,6 +50,7 @@ from functools import reduce
 import numpy as np
 
 from .dtype import DataType
+from .header_standard import trace_context
 from .space import canonical
 from .ndarray import ndarray
 from .testing import faults
@@ -78,8 +79,8 @@ _obs = None
 def _observability():
     global _obs
     if _obs is None:
-        from .telemetry import counters, histograms, spans
-        _obs = (counters, histograms, spans)
+        from .telemetry import counters, histograms, spans, slo
+        _obs = (counters, histograms, spans, slo)
     return _obs
 
 _INF = float('inf')
@@ -791,17 +792,33 @@ class Ring(object):
 
     def _note_commit(self, wspan, commit_nbyte):
         """Per-commit telemetry shared by BOTH ring cores: the logical
-        gulp throughput counter (macro spans credit their K gulps), and
-        — for device rings whose committed chunk is a mesh-resident
-        array — sharded-chunk accounting: ``ring.<name>.sharded_gulps``
-        and ``ring.<name>.shard_bytes`` (bytes landing on EACH device;
-        the per-chip slice of the span).  The storage itself holds the
-        sharded jax Array, i.e. shard-local HBM buffers per device
-        rather than one monolithic allocation — these counters are how
-        an operator sees that layout without a device query."""
-        c = _observability()[0]
+        gulp throughput counter (macro spans credit their K gulps), the
+        capture-to-commit SLO age (telemetry.slo — when the sequence
+        header carries a trace-context origin, which crosses hosts via
+        the bridge), and — for device rings whose committed chunk is a
+        mesh-resident array — sharded-chunk accounting:
+        ``ring.<name>.sharded_gulps`` and ``ring.<name>.shard_bytes``
+        (bytes landing on EACH device; the per-chip slice of the
+        span).  The storage itself holds the sharded jax Array, i.e.
+        shard-local HBM buffers per device rather than one monolithic
+        allocation — these counters are how an operator sees that
+        layout without a device query."""
+        obs = _observability()
+        c, slo = obs[0], obs[3]
         ngulps = getattr(wspan, '_ngulps', 1)
         c.inc('ring.%s.gulps' % self.name, ngulps)
+        try:
+            header = wspan._sequence.header
+            if trace_context(header) is not None:
+                owner = getattr(self, 'owner', None)
+                name = owner.name if owner is not None else self.name
+                frame_end = wspan.frame_offset + \
+                    commit_nbyte // wspan.frame_nbyte
+                age = slo.capture_age_s(header, frame_end)
+                if age is not None:
+                    slo.observe_commit(name, age, ngulps)
+        except Exception:
+            pass                     # SLO feed must never break commits
         arr = getattr(wspan, '_device_array', None)
         if arr is None:
             return
@@ -1329,7 +1346,7 @@ class WriteSpan(_SpanAPI):
         # ring-wait observability: how long the writer was blocked in
         # flow control (covers BOTH cores — the native reserve happens
         # inside this call)
-        _, hist, spans_ = _observability()
+        _, hist, spans_ = _observability()[:3]
         t0 = time.perf_counter()
         self._begin = ring._reserve_span(self._nbyte, nonblocking,
                                          span=self)
@@ -1454,7 +1471,7 @@ class ReadSpan(_SpanAPI):
         fb = t['frame_nbyte']
         # ring-wait observability: reader blocked-time in flow control
         # (both cores — the native acquire happens inside this call)
-        _, hist, spans_ = _observability()
+        _, hist, spans_ = _observability()[:3]
         t0 = time.perf_counter()
         begin, nbyte = self._ring._acquire_span(
             sequence, frame_offset * fb, nframe * fb, fb)

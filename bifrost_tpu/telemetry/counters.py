@@ -159,6 +159,25 @@ Mesh-resident pipeline counters (docs/parallel.md):
                                            (all_gather / all_reduce /
                                            reduce_scatter / all_to_all
                                            / collective_permute)
+
+Distributed-observability counters (docs/observability.md
+"Distributed tracing & SLOs"):
+
+- ``slo.violations``                       capture-to-commit/-exit age
+                                           observations above the
+                                           ``BF_SLO_MS`` budget (see
+                                           telemetry.slo); per-block
+                                           breakdown on
+                                           ``slo.<block>.violations``
+- ``trace.dropped_spans``                  spans evicted by per-thread
+                                           span-buffer overflow
+                                           (BF_SPAN_BUFFER saturation)
+                                           — synthesized into
+                                           ``telemetry.snapshot()``
+                                           from the live buffers
+- ``jaxprof.captures``                     one-shot BF_JAX_PROFILE
+                                           gulp captures taken
+                                           (telemetry.profiling)
 """
 
 from __future__ import annotations

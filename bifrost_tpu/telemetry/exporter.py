@@ -32,7 +32,7 @@ import os
 import threading
 import time
 
-from . import counters, histograms
+from . import counters, histograms, spans
 
 __all__ = ['snapshot', 'write_prometheus', 'prometheus_text',
            'MetricsPublisher']
@@ -66,20 +66,86 @@ def _ring_occupancy(pipeline=None):
     return out
 
 
+def _device_stats():
+    """Per-device HBM/allocator stats from jax ``memory_stats()``
+    (docs/parallel.md / docs/observability.md mesh telemetry):
+    ``{device_index: {platform, bytes_in_use, bytes_limit,
+    peak_bytes_in_use?}}``.  Empty when jax was never imported by this
+    process (a snapshot must not drag the backend in) or when
+    ``BF_DEVICE_METRICS=0``."""
+    import sys
+    if os.environ.get('BF_DEVICE_METRICS', '1') == '0':
+        return {}
+    if 'jax' not in sys.modules:
+        return {}
+    out = {}
+    try:
+        import jax
+        for i, d in enumerate(jax.local_devices()):
+            try:
+                s = d.memory_stats() or {}
+            except Exception:
+                s = {}
+            entry = {'platform': str(getattr(d, 'platform', '?'))}
+            for src, dst in (('bytes_in_use', 'bytes_in_use'),
+                             ('bytes_limit', 'bytes_limit'),
+                             ('peak_bytes_in_use', 'peak_bytes_in_use'),
+                             ('largest_alloc_size', 'largest_alloc')):
+                if src in s:
+                    entry[dst] = int(s[src])
+            out[i] = entry
+    except Exception:
+        return {}
+    return out
+
+
+#: mesh counter prefixes folded into the snapshot's 'mesh' summary
+_MESH_KEYS = ('mesh.reshards', 'mesh.reshard_bytes',
+              'mesh.sharded_commits', 'mesh.layout_mismatch',
+              'mesh.plans_analyzed', 'mesh.plans_collective_free',
+              'mesh.frame_local_fallback')
+
+
+def _mesh_summary(counts):
+    """The mesh-resident pipeline counters regrouped into one section
+    (they remain in 'counters' too — this is the at-a-glance view, with
+    ``mesh.collectives.<kind>`` folded into a sub-dict)."""
+    out = {k.split('.', 1)[1]: counts[k] for k in _MESH_KEYS
+           if k in counts}
+    coll = {k.split('.', 2)[2]: v for k, v in counts.items()
+            if k.startswith('mesh.collectives.')}
+    if coll:
+        out['collectives'] = coll
+    return out
+
+
 def snapshot(pipeline=None):
     """The unified metrics snapshot::
 
         {'counters':   {name: int},
          'histograms': {name: {count,sum,min,max,p50,p90,p99,buckets}},
-         'rings':      {name: {tail,head,size,...,fill}}}
+         'rings':      {name: {tail,head,size,...,fill}},
+         'devices':    {index: {platform,bytes_in_use,bytes_limit,...}},
+         'mesh':       {reshards,sharded_commits,collectives,...}}
 
     ``pipeline`` narrows the ring section to one pipeline's rings;
-    without it every live ring in the process is reported.
+    without it every live ring in the process is reported.  The
+    'counters' section includes the live ``trace.dropped_spans`` total
+    (per-thread span-buffer overflow — docs/observability.md); the SLO
+    age histograms/violation counters (telemetry.slo) appear under
+    their ``slo.*`` names in 'histograms'/'counters'.
     """
+    counts = counters.snapshot()
+    dropped = spans.dropped_spans()
+    if dropped:
+        counts['trace.dropped_spans'] = \
+            counts.get('trace.dropped_spans', 0) + dropped
     return {
-        'counters': counters.snapshot(),
+        'counters': counts,
         'histograms': histograms.snapshot(),
         'rings': _ring_occupancy(pipeline),
+        'devices': _device_stats(),
+        'mesh': _mesh_summary(counts),
     }
 
 
@@ -132,6 +198,20 @@ def prometheus_text(snap=None):
             if key in d:
                 lines.append('bifrost_tpu_ring_bytes{ring="%s",'
                              'kind="%s"} %d' % (label, key, d[key]))
+    devices = snap.get('devices', {})
+    if devices:
+        lines.append('# TYPE bifrost_tpu_device_bytes gauge')
+    for idx in sorted(devices):
+        d = devices[idx]
+        for key, kind in (('bytes_in_use', 'in_use'),
+                          ('bytes_limit', 'limit'),
+                          ('peak_bytes_in_use', 'peak'),
+                          ('largest_alloc', 'largest_alloc'),
+                          ('watermark_bytes', 'watermark')):
+            if key in d:
+                lines.append('bifrost_tpu_device_bytes{device="%s",'
+                             'kind="%s"} %d' % (_esc(idx), kind,
+                                                d[key]))
     return '\n'.join(lines) + '\n'
 
 
@@ -174,6 +254,11 @@ class MetricsPublisher(threading.Thread):
         self._proclogs = {}
         self._last_gulps = {}
         self._last_time = None
+        #: per-device HBM watermark: the highest bytes_in_use this
+        #: publisher has SAMPLED (coarser than the allocator's own
+        #: peak_bytes_in_use where available, but live on every
+        #: backend and reset-free across allocator stat resets)
+        self._hbm_watermark = {}
 
     def stop(self, wait=True):
         """Stop the loop; publishes one final snapshot first."""
@@ -197,12 +282,24 @@ class MetricsPublisher(threading.Thread):
     def publish(self):
         try:
             snap = snapshot(self.pipeline)
+            self._note_watermarks(snap)
             self._publish_proclog(snap)
             path = os.environ.get('BF_METRICS_FILE')
             if path:
                 write_prometheus(path, snap)
         except Exception:
             pass                     # never take the pipeline down
+
+    def _note_watermarks(self, snap):
+        """Fold the publisher's sampled HBM watermark into the
+        snapshot's device entries (and keep it across publishes)."""
+        for idx, d in snap.get('devices', {}).items():
+            in_use = d.get('bytes_in_use')
+            if in_use is None:
+                continue
+            mark = max(self._hbm_watermark.get(idx, 0), in_use)
+            self._hbm_watermark[idx] = mark
+            d['watermark_bytes'] = mark
 
     def _publish_proclog(self, snap):
         flat = {}
@@ -237,3 +334,11 @@ class MetricsPublisher(threading.Thread):
                         round(h['p99'] * 1e3, 3)
             self._proclog('rings_flow/%s' % name).update(entry,
                                                          force=True)
+        # per-device HBM telemetry (mesh observability): one proclog
+        # entry per local device with in-use/limit/peak/watermark
+        for idx, d in sorted(snap.get('devices', {}).items()):
+            entry = {k: v for k, v in d.items() if k != 'platform'}
+            if not entry:
+                continue
+            entry['platform'] = d.get('platform', '?')
+            self._proclog('devices/%s' % idx).update(entry, force=True)

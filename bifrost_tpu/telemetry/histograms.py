@@ -24,6 +24,12 @@ and operators may add their own):
 - ``ring.<ring>.acquire_s``      reader-side span acquisition time
 - ``xfer.h2d_s`` / ``xfer.d2h_wait_s``  host-side transfer time
 - ``xfer.h2d_nbytes`` / ``xfer.d2h_nbytes``  transfer sizes
+- ``slo.<block>.commit_age_s``   capture -> block-commit data age
+                                 (telemetry.slo; needs a trace-context
+                                 origin in the sequence header)
+- ``slo.<block>.exit_age_s`` / ``slo.exit_age_s``  capture ->
+                                 pipeline-exit age per sink / merged
+                                 (the capture-to-commit SLO p50/p99)
 
 Percentiles are bucket UPPER bounds clamped to the observed min/max:
 an estimate, monotone in ``p`` by construction (the exporter tests

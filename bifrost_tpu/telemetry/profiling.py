@@ -1,0 +1,84 @@
+"""Opt-in JAX profiler bracket for ONE gulp dispatch
+(docs/observability.md; docs/envvars.md ``BF_JAX_PROFILE``).
+
+``BF_JAX_PROFILE=<dir>`` makes the FIRST eligible device dispatch of
+the process (a FusedBlock / stage-block gulp — under macro-gulp
+execution that is one whole K-gulp program) run inside
+``jax.profiler.start_trace(<dir>)`` / ``stop_trace``, with a
+``block_until_ready`` on the result so the device timeline is complete
+before the capture closes.  Exactly one capture per process: profiler
+captures are far too heavy for per-gulp use, but one macro-gulp's
+XLA-level timeline is what you need when the host-side spans say "the
+dispatch is slow" and you want to know WHY.
+
+The capture is strictly best-effort: a missing/failing profiler never
+takes the pipeline down (the gulp still executes; the error lands on
+stderr once).  ``jaxprof.captures`` counts successful captures.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+__all__ = ['profile_dir', 'profiled_dispatch', 'reset']
+
+_lock = threading.Lock()
+_done = False
+
+
+def profile_dir():
+    """The ``BF_JAX_PROFILE`` capture directory, or None."""
+    return os.environ.get('BF_JAX_PROFILE') or None
+
+
+def reset():
+    """Re-arm the one-shot (tests)."""
+    global _done
+    with _lock:
+        _done = False
+
+
+def profiled_dispatch(fn):
+    """Run ``fn()`` (a zero-arg dispatch thunk returning jax arrays),
+    bracketing it with the JAX profiler when this process's one-shot
+    capture is armed and unspent.  Returns ``fn()``'s result either
+    way."""
+    global _done
+    path = profile_dir()
+    if path is None or _done:
+        return fn()
+    with _lock:
+        if _done:
+            return fn()
+        _done = True
+    started = False
+    try:
+        import jax
+        jax.profiler.start_trace(path)
+        started = True
+    except Exception as exc:
+        sys.stderr.write('bifrost_tpu: BF_JAX_PROFILE capture failed '
+                         'to start: %s\n' % exc)
+        return fn()
+    try:
+        out = fn()
+        try:
+            import jax
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+        from . import counters
+        counters.inc('jaxprof.captures')
+        return out
+    finally:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            sys.stderr.write('bifrost_tpu: BF_JAX_PROFILE stop_trace '
+                             'failed: %s\n' % exc)
+        if started:
+            sys.stderr.write('bifrost_tpu: one-gulp JAX profile '
+                             'captured to %s\n' % path)

@@ -44,7 +44,8 @@ __all__ = ['enabled', 'trace_file', 'span', 'record',
            'record_elapsed', 'now_us', 'configure', 'reconfigure',
            'enable_flight_recorder', 'disable_flight_recorder',
            'export', 'export_if_configured', 'flight_record',
-           'prune_dead_buffers', 'reset', 'events']
+           'prune_dead_buffers', 'reset', 'events', 'dropped_spans',
+           'note_peer_clock', 'clock_info']
 
 DEFAULT_BUFFER = 65536
 #: per-thread buffer size in flight-recorder-only mode (no trace
@@ -69,7 +70,19 @@ _gen = 0
 
 _tls = threading.local()
 _buffers_lock = threading.Lock()
-_buffers = []            # [(threading.Thread, deque)]
+_buffers = []            # [(threading.Thread, deque, drops:[int])]
+#: drop counts inherited from PRUNED (dead-thread) buffers, so
+#: ``dropped_spans`` stays monotonic across Pipeline.run's
+#: prune_dead_buffers calls — it is exported as a cumulative counter
+#: (Prometheus rate() breaks on a counter that decreases)
+_dropped_retired = 0
+
+#: cross-host clock correlation (docs/observability.md): bridge
+#: endpoints register the sessions they participated in — and, on the
+#: sender side, the ping-estimated span-clock offset to the peer —
+#: so the Chrome trace export can embed them for tools/trace_merge.py
+_clock_lock = threading.Lock()
+_sessions = {}           # session -> {'role', 'offset_us', 'rtt_us'}
 
 
 def now_us():
@@ -145,7 +158,7 @@ def trace_file():
 def _buf():
     old = getattr(_tls, 'buf', None)
     if old is not None and getattr(_tls, 'gen', None) == _gen:
-        return old
+        return old, _tls.drops
     # (re)build this thread's buffer at the CURRENT capacity: flight-
     # recorder-only mode needs just the recent tail, a configured
     # trace file gets the full export buffer — and a reconfigure must
@@ -154,11 +167,19 @@ def _buf():
     # newest events
     cap = _buf_cap if _trace_file else min(_buf_cap, FLIGHT_BUFFER)
     b = deque(old if old is not None else (), maxlen=cap)
+    drops = getattr(_tls, 'drops', None)
+    if drops is None:
+        # a one-int list, shared by reference with the registry so the
+        # owning thread bumps it lock-free and readers see it
+        drops = [0]
     _tls.buf = b
     _tls.gen = _gen
+    _tls.drops = drops
     t = threading.current_thread()
     with _buffers_lock:
         if old is not None:
+            # same thread's buffer migrating to a new capacity: its
+            # drops list is carried over, so no retired accumulation
             _buffers[:] = [e for e in _buffers if e[1] is not old]
         if len(_buffers) >= MAX_BUFFERS:
             # prune every dead thread's buffer so a long-lived
@@ -167,9 +188,39 @@ def _buf():
             # dropped — a process keeping > MAX_BUFFERS threads
             # simultaneously alive holds that many buffers by
             # necessity (the cap is for retirees only).
-            _buffers[:] = [e for e in _buffers if e[0].is_alive()]
-        _buffers.append((t, b))
-    return b
+            _retire_locked(lambda e: e[0].is_alive())
+        _buffers.append((t, b, drops))
+    return b, drops
+
+
+def _retire_locked(keep):
+    """Drop registry entries failing ``keep``, folding their drop
+    counts into the retired total (callers hold _buffers_lock)."""
+    global _dropped_retired
+    _dropped_retired += sum(e[2][0] for e in _buffers if not keep(e))
+    _buffers[:] = [e for e in _buffers if keep(e)]
+
+
+def _append(ev):
+    """Append one event to this thread's buffer, counting the event it
+    evicts when the ring is saturated: overflow used to be silent, and
+    a flight record / trace that quietly lost its oldest spans reads
+    as 'nothing happened before this' (the ``trace.dropped_spans``
+    counter in ``telemetry.snapshot()`` says otherwise)."""
+    b, drops = _buf()
+    if b.maxlen is not None and len(b) >= b.maxlen:
+        drops[0] += 1
+    b.append(ev)
+
+
+def dropped_spans():
+    """Total spans evicted by per-thread buffer overflow across the
+    process, INCLUDING threads whose buffers were since pruned — the
+    count is cumulative/monotonic, as a counter export requires
+    (saturation indicator: raise ``BF_SPAN_BUFFER`` or export more
+    often when this grows)."""
+    with _buffers_lock:
+        return _dropped_retired + sum(e[2][0] for e in _buffers)
 
 
 def _drain(buf):
@@ -196,7 +247,7 @@ def record(name, cat, ts_us, dur_us, args=None):
     No-op when recording is disabled."""
     if not enabled():
         return
-    _buf().append((name, cat, ts_us, dur_us, args))
+    _append((name, cat, ts_us, dur_us, args))
 
 
 def record_elapsed(name, cat, dt_s, **args):
@@ -206,7 +257,7 @@ def record_elapsed(name, cat, dt_s, **args):
     if not enabled():
         return
     dur = dt_s * 1e6
-    _buf().append((name, cat, now_us() - dur, dur, args or None))
+    _append((name, cat, now_us() - dur, dur, args or None))
 
 
 def prune_dead_buffers():
@@ -215,7 +266,51 @@ def prune_dead_buffers():
     not contaminated by earlier runs' threads.  Live threads
     (including concurrently running pipelines) are untouched."""
     with _buffers_lock:
-        _buffers[:] = [e for e in _buffers if e[0].is_alive()]
+        _retire_locked(lambda e: e[0].is_alive())
+
+
+# ---------------------------------------------------------------------------
+# cross-host clock correlation (tools/trace_merge.py)
+# ---------------------------------------------------------------------------
+
+def note_peer_clock(session, role, offset_us=None, rtt_us=None):
+    """Register a bridge session this process participated in.
+
+    The SENDER side passes the ping-estimated clock offset from its
+    handshake (``offset_us`` = receiver span-clock minus sender
+    span-clock at the same instant, ``rtt_us`` the round trip the
+    estimate rode on); the RECEIVER side registers with role only.
+    The trace export embeds these under ``otherData.bf_clock`` so
+    ``tools/trace_merge.py`` can shift per-host timelines onto one
+    clock.  A re-registration keeps the LOWEST-rtt offset (the best
+    estimate wins across stripes/reconnects)."""
+    with _clock_lock:
+        cur = _sessions.get(session)
+        if cur is not None and offset_us is not None \
+                and cur.get('rtt_us') is not None \
+                and rtt_us is not None \
+                and rtt_us >= cur['rtt_us']:
+            return
+        entry = {'role': role}
+        if offset_us is not None:
+            entry['offset_us'] = round(float(offset_us), 3)
+        if rtt_us is not None:
+            entry['rtt_us'] = round(float(rtt_us), 3)
+        if cur is not None and 'offset_us' not in entry \
+                and 'offset_us' in cur:
+            return                   # never downgrade an estimate
+        _sessions[session] = entry
+
+
+def clock_info():
+    """This process's clock-correlation metadata for the trace export:
+    host/pid plus every bridge session seen (and, sender side, the
+    offset estimate)."""
+    import socket as socket_mod
+    with _clock_lock:
+        sessions = {k: dict(v) for k, v in _sessions.items()}
+    return {'host': socket_mod.gethostname(), 'pid': os.getpid(),
+            'sessions': sessions}
 
 
 class span(object):
@@ -245,8 +340,8 @@ class span(object):
     def __exit__(self, *exc):
         if self.t0 is not None:
             t1 = now_us()
-            _buf().append((self.name, self.cat, self.t0,
-                           t1 - self.t0, self.args))
+            _append((self.name, self.cat, self.t0,
+                     t1 - self.t0, self.args))
         return False
 
 
@@ -254,7 +349,7 @@ def events():
     """Snapshot of all recorded events as
     ``[(thread_name, (name, cat, ts_us, dur_us, args)), ...]``."""
     with _buffers_lock:
-        bufs = [(t.name, b) for t, b in _buffers]
+        bufs = [(t.name, b) for t, b, _d in _buffers]
     out = []
     for tname, buf in bufs:
         out.extend((tname, ev) for ev in _drain(buf))
@@ -268,32 +363,49 @@ def events():
 def export(path=None):
     """Write every buffered span as Chrome trace-event JSON (one track
     per thread; load in Perfetto or chrome://tracing).  Returns the
-    path written, or None when no path is configured."""
+    path written, or None when no path is configured.
+
+    Serialization is hand-rolled per event (one %-format through a
+    cached template instead of a dict build + json.dump walk): the
+    export runs inside ``Pipeline.run``'s teardown, so its cost is
+    part of the observability overhead the e2e gate bounds — measured
+    ~3x faster than the generic encoder at trace sizes the config-12
+    bench writes.  Only ``args`` (arbitrary user payload) goes through
+    ``json.dumps``."""
     if path is None:
         path = trace_file()
     if not path:
         return None
     with _buffers_lock:
-        bufs = [(t.ident or 0, t.name, b) for t, b in _buffers]
+        bufs = [(t.ident or 0, t.name, b) for t, b, _d in _buffers]
     pid = os.getpid()
-    trace_events = []
+    dumps = json.dumps
+    chunks = ['{"traceEvents":[']
+    first = True
     for tid, tname, buf in bufs:
-        trace_events.append({'ph': 'M', 'name': 'thread_name',
-                             'pid': pid, 'tid': tid,
-                             'args': {'name': tname}})
+        chunks.append('%s{"ph":"M","name":"thread_name","pid":%d,'
+                      '"tid":%d,"args":{"name":%s}}'
+                      % ('' if first else ',', pid, tid, dumps(tname)))
+        first = False
+        head = ',{"name":%s,"cat":%s,"ph":"X","pid":' + str(pid) + \
+            ',"tid":' + str(tid) + ',"ts":%.3f,"dur":%.3f'
         for name, cat, ts, dur, args in _drain(buf):
-            ev = {'name': name, 'cat': cat or 'bf', 'ph': 'X',
-                  'pid': pid, 'tid': tid,
-                  'ts': round(ts, 3), 'dur': round(dur, 3)}
+            chunks.append(head % (dumps(name), dumps(cat or 'bf'),
+                                  ts, dur))
             if args:
-                ev['args'] = dict(args)
-            trace_events.append(ev)
+                chunks.append(',"args":%s}' % dumps(args))
+            else:
+                chunks.append('}')
+    chunks.append('],"displayTimeUnit":"ms","otherData":%s}'
+                  # clock-correlation metadata: lets trace_merge.py
+                  # join this host's timeline with its bridge peers'
+                  % dumps({'bf_clock': clock_info(),
+                           'bf_dropped_spans': dropped_spans()}))
     # pid AND thread ident: two pipelines' teardown exports in one
     # process must not truncate each other's tmp file mid-write
     tmp = '%s.tmp%d.%d' % (path, pid, threading.get_ident())
     with open(tmp, 'w') as f:
-        json.dump({'traceEvents': trace_events,
-                   'displayTimeUnit': 'ms'}, f)
+        f.write(''.join(chunks))
     os.replace(tmp, path)
     return path
 
@@ -325,7 +437,7 @@ def flight_record(per_thread=32):
     stall dump so a stall comes with the events LEADING UP to it."""
     merged = []
     with _buffers_lock:
-        bufs = [(t.name, b) for t, b in _buffers]
+        bufs = [(t.name, b) for t, b, _d in _buffers]
     for tname, buf in bufs:
         for ev in _drain(buf)[-per_thread:]:
             merged.append((ev[2], tname, ev))
@@ -335,6 +447,14 @@ def flight_record(per_thread=32):
     merged.sort(key=lambda e: e[0])
     lines = ['=== flight recorder: last %d span(s)/thread, '
              'oldest first ===' % per_thread]
+    dropped = dropped_spans()
+    if dropped:
+        # saturation disclosure: the timeline below is missing its
+        # oldest events — without this line a saturated recorder reads
+        # as 'nothing happened before this'
+        lines.append('  NOTE: %d span(s) dropped to buffer overflow '
+                     '(BF_SPAN_BUFFER saturation) — the oldest '
+                     'history below is incomplete' % dropped)
     for ts, tname, (name, cat, _ts, dur, args) in merged:
         extra = ' %r' % (args,) if args else ''
         lines.append('  t=%12.3fms +%10.3fms  [%-7s] %-24s %s%s'
@@ -345,8 +465,12 @@ def flight_record(per_thread=32):
 
 
 def reset():
-    """Drop all buffered events and thread registrations (tests)."""
-    global _tls
+    """Drop all buffered events, drop counts, clock-correlation
+    registrations, and thread registrations (tests)."""
+    global _tls, _dropped_retired
     with _buffers_lock:
         del _buffers[:]
+        _dropped_retired = 0
+    with _clock_lock:
+        _sessions.clear()
     _tls = threading.local()

@@ -31,14 +31,16 @@ def reset():
     """Forget the cached ``BF_TRACE`` state so the next
     :func:`tracing_enabled` re-reads the environment, and re-read the
     gulp-span configuration (``BF_TRACE_FILE`` / ``BF_SPAN_BUFFER`` —
-    :mod:`bifrost_tpu.telemetry.spans`).  Lets tests and long-lived
-    operator processes toggle tracing without a restart; ``Pipeline.run``
-    re-reads the span config on every run anyway."""
+    :mod:`bifrost_tpu.telemetry.spans`) plus the ``BF_SLO_MS`` latency
+    budget (:mod:`bifrost_tpu.telemetry.slo`).  Lets tests and
+    long-lived operator processes toggle tracing without a restart;
+    ``Pipeline.run`` re-reads the span config on every run anyway."""
     global _enabled
     _enabled = None
     try:
-        from .telemetry import spans
+        from .telemetry import spans, slo
         spans.reconfigure()
+        slo.reset_budget()
     except Exception:
         pass
 

@@ -706,7 +706,14 @@ class TransferEngine(object):
         dt = time.perf_counter() - t0
         hist.observe('xfer.h2d_s', dt)
         hist.observe('xfer.h2d_nbytes', int(arr.nbytes))
-        spans.record_elapsed('h2d', 'xfer', dt, bytes=int(arr.nbytes))
+        try:
+            ndev = len(sharding.device_set)
+        except Exception:
+            ndev = 1
+        # the shard count distinguishes mesh placements from
+        # single-device ships in the trace (mesh observability)
+        spans.record_elapsed('h2d', 'xfer', dt, bytes=int(arr.nbytes),
+                             shards=ndev)
         return out
 
     def _ship_sharded_real(self, arr, sharding):

@@ -422,6 +422,74 @@ def test_bridge_k1_default_roundtrips_macro_stream():
     np.testing.assert_array_equal(out['k1'], raw)
 
 
+def test_bridge_span_identity_survives_sender_gulp_override(
+        monkeypatch, tmp_path):
+    """The (trace, seq, gulp) identity joining tx and rx spans across
+    hosts must come from the SHIPPED header's gulp_nframe on both
+    sides: a sender reading the ring in bigger batches
+    (gulp_nframe override) must not skew the tx-side gulp index."""
+    from bifrost_tpu.header_standard import ensure_trace_context
+    from bifrost_tpu.telemetry import spans
+    monkeypatch.setenv('BF_TRACE_FILE', str(tmp_path / 'ids.json'))
+    spans.reconfigure()
+    spans.reset()
+    try:
+        src = Ring(space='system', name='bsrc_gmix')
+        dst = Ring(space='system', name='bdst_gmix')
+        lst = BridgeListener('127.0.0.1', 0)
+        data = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+        hdr = simple_header([-1, 4], 'f32', name='gmix',
+                            gulp_nframe=8)
+        tid = ensure_trace_context(hdr)['id']
+
+        def writer():
+            with src.begin_writing() as wr:
+                with wr.begin_sequence(hdr, gulp_nframe=8,
+                                       buf_nframe=40) as seq:
+                    for k in range(4):
+                        with seq.reserve(8) as span:
+                            span.data.as_numpy()[...] = \
+                                data[k * 8:(k + 1) * 8]
+                            span.commit(8)
+
+        def sender():
+            conn = socket.create_connection(('127.0.0.1', lst.port))
+            # reads the ring 16 frames at a time — TWICE the header's
+            # logical gulp
+            s = RingSender(src, [conn], gulp_nframe=16)
+            s.run()
+            s.close()
+
+        def receiver():
+            RingReceiver(lst, dst).run()
+
+        threads = [threading.Thread(target=f, daemon=True)
+                   for f in (receiver, writer, sender)]
+        for t in threads:
+            t.start()
+        out = _gather(dst, 8)
+        for t in threads:
+            t.join(30)
+        lst.close()
+        np.testing.assert_array_equal(out['gmix'], data)
+
+        evs = [ev for _t, ev in spans.events()
+               if ev[0].startswith('bridge.')]
+        tx = {(ev[4]['trace'], ev[4]['seq'], ev[4]['gulp'])
+              for ev in evs if ev[0].startswith('bridge.tx.')}
+        rx = {(ev[4]['trace'], ev[4]['seq'], ev[4]['gulp'])
+              for ev in evs if ev[0].startswith('bridge.rx.')}
+        # 32 frames in two 16-frame wire spans: header-logical gulp
+        # indices 0 and 2 on BOTH timelines
+        assert {i[2] for i in tx} == {0, 2}
+        assert tx == rx
+        assert all(i[0] == tid for i in tx)
+    finally:
+        monkeypatch.delenv('BF_TRACE_FILE', raising=False)
+        spans.reconfigure()
+        spans.reset()
+
+
 def test_header_numpy_values_roundtrip():
     """serialize_header coerces numpy scalars/arrays; a header
     transform that injects them must bridge cleanly."""

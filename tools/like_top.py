@@ -12,6 +12,9 @@ Panes (matching the reference's information set):
     that core, total/acquire/process/reserve perf times, gulp-latency
     p50/p99 and ring-wait p99 (ms, from the telemetry histograms each
     block publishes into its perf ProcLog — docs/observability.md),
+    Age99 = capture-to-commit age p99 (ms; how OLD the data is when
+    this block commits/exits it — the SLO column, telemetry.slo,
+    needs a trace-context origin in the stream),
     G/D = logical gulps per dispatch (1.0 unbatched; ~K when
     macro-gulp execution is amortizing dispatch — docs/perf.md),
     Shd = mesh width of the executing plan (1 single-device; N when
@@ -20,9 +23,10 @@ Panes (matching the reference's information set):
 
 Interactive curses UI with the reference's sort keys (i=pid, b=name,
 c=core, t=total, a=acquire, p=process, r=reserve, plus l=p99 gulp
-latency, w=p99 ring wait, g=gulps-per-dispatch, and s=shards; pressing
-the active key again reverses; q quits).  ``--once`` prints one
-plain-text snapshot instead (usable in pipes/tests).
+latency, w=p99 ring wait, e=age99, g=gulps-per-dispatch, and
+s=shards; pressing the active key again reverses; q quits).
+``--once`` prints one plain-text snapshot instead (usable in
+pipes/tests).
 """
 
 import argparse
@@ -191,6 +195,10 @@ def collect_blocks(pids=None):
                 # macro-gulp amortization: logical gulps per dispatch
                 # (1.0 unbatched; K when macro-gulp execution engaged)
                 'gpd': max(0.0, _num(perf.get('gulps_per_dispatch'))),
+                # capture-to-commit age p99 (seconds; rendered as ms):
+                # the SLO column — how OLD the data is when this block
+                # commits/exits it (telemetry.slo; needs trace context)
+                'age99': max(0.0, _num(perf.get('commit_age_p99'))),
                 # mesh width of the executing plan (docs/parallel.md;
                 # 1 = single device, N = sharded over N chips)
                 'shards': max(1.0, _num(perf.get('shards')) or 1.0)}
@@ -233,10 +241,10 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
                       dev['devCount']))
     out.append('')
     hdr = '%6s  %-24s  %4s  %5s  %8s  %8s  %8s  %8s  %8s  %8s  %8s' \
-          '  %5s  %3s  Cmd' \
+          '  %8s  %5s  %3s  Cmd' \
         % ('PID', 'Block', 'Core', '%CPU', 'Total', 'Acquire',
-           'Process', 'Reserve', 'p50(ms)', 'p99(ms)', 'Wait99', 'G/D',
-           'Shd')
+           'Process', 'Reserve', 'p50(ms)', 'p99(ms)', 'Wait99',
+           'Age99', 'G/D', 'Shd')
     out.append(hdr)
     order = sorted(rows, key=lambda k: rows[k][sort_key],
                    reverse=sort_rev)
@@ -248,18 +256,20 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
             pct = '%5s' % ' '
         name = d['name'].split('/')[-1][:24]
         out.append('%6i  %-24s  %4s  %5s  %8.3f  %8.3f  %8.3f  %8.3f'
-                   '  %8.2f  %8.2f  %8.2f  %5.1f  %3i  %s'
+                   '  %8.2f  %8.2f  %8.2f  %8.2f  %5.1f  %3i  %s'
                    % (d['pid'], name, d['core'], pct, d['total'],
                       d['acquire'], d['process'], d['reserve'],
                       d['p50'] * 1e3, d['p99'] * 1e3,
-                      d['wait99'] * 1e3, d['gpd'], int(d['shards']),
-                      d['cmd'][:max(width - 138, 0)]))
+                      d['wait99'] * 1e3, d['age99'] * 1e3, d['gpd'],
+                      int(d['shards']),
+                      d['cmd'][:max(width - 148, 0)]))
     return out
 
 
 _SORT_KEYS = {'i': 'pid', 'b': 'name', 'c': 'core', 't': 'total',
               'a': 'acquire', 'p': 'process', 'r': 'reserve',
-              'l': 'p99', 'w': 'wait99', 'g': 'gpd', 's': 'shards'}
+              'l': 'p99', 'w': 'wait99', 'g': 'gpd', 's': 'shards',
+              'e': 'age99'}
 
 
 def run_curses(args):

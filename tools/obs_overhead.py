@@ -35,19 +35,29 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 METRIC = 'async_ms_per_gulp'
 
 
-def run_config8(trace_file=None, timeout=1800):
+def run_config8(trace_file=None, timeout=1800, full_stack=False):
     """One bench_suite --config 8 subprocess; returns its result dict.
-    ``trace_file`` set -> span recording on (plus the export cost)."""
+    ``trace_file`` set -> span recording on (plus the export cost);
+    ``full_stack`` additionally arms trace-context stamping and
+    BF_SLO_MS budget tracking on the traced arm (and explicitly
+    disables the context on the baseline arm, since stamping defaults
+    on) — the ``--stack full`` mode."""
     env = dict(os.environ)
     # strip EVERY knob that toggles span recording or adds publisher
     # work, so the baseline arm is genuinely instrumentation-off (an
     # inherited BF_WATCHDOG_SECS would arm the flight recorder and
     # make the gate compare on-vs-on)
     for knob in ('BF_TRACE_FILE', 'BF_TRACE', 'BF_WATCHDOG_SECS',
-                 'BF_WATCHDOG_ESCALATE', 'BF_METRICS_FILE'):
+                 'BF_WATCHDOG_ESCALATE', 'BF_METRICS_FILE',
+                 'BF_SLO_MS', 'BF_TRACE_CONTEXT', 'BF_JAX_PROFILE'):
         env.pop(knob, None)
     if trace_file is not None:
         env['BF_TRACE_FILE'] = trace_file
+        if full_stack:
+            env['BF_TRACE_CONTEXT'] = '1'
+            env['BF_SLO_MS'] = '10000'
+    elif full_stack:
+        env['BF_TRACE_CONTEXT'] = '0'
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
          '--config', '8'],
@@ -77,10 +87,21 @@ def main():
                          '(minima are compared; order alternates)')
     ap.add_argument('--timeout', type=float, default=1800.0,
                     help='per-run bench timeout in seconds')
+    ap.add_argument('--stack', choices=('spans', 'full'),
+                    default='spans',
+                    help="what the traced arm enables: 'spans' (the "
+                         "classic PR-3 gate) or 'full' (spans + "
+                         "trace-context stamping + BF_SLO_MS "
+                         "tracking; baseline arm runs "
+                         "BF_TRACE_CONTEXT=0).  The chain-level "
+                         "full-stack bar lives in tools/e2e_gate.py; "
+                         "this mode bounds the same knobs on the "
+                         "config-8 transfer loop.")
     args = ap.parse_args()
 
     trace_tmp = os.path.join(tempfile.mkdtemp(prefix='bf_obs_gate_'),
                              'trace.json')
+    full = args.stack == 'full'
     base_runs, traced_runs = [], []
     try:
         for rep in range(max(args.reps, 1)):
@@ -88,7 +109,8 @@ def main():
             if rep % 2:
                 order.reverse()
             for runs, tf in order:
-                runs.append(run_config8(tf, timeout=args.timeout))
+                runs.append(run_config8(tf, timeout=args.timeout,
+                                        full_stack=full))
     except (RuntimeError, subprocess.TimeoutExpired) as exc:
         print('obs_overhead: bench arm failed: %s' % exc,
               file=sys.stderr)
@@ -100,6 +122,7 @@ def main():
     ok = overhead_pct < args.threshold
     artifact = {
         'metric': METRIC,
+        'stack': args.stack,
         'reps': len(base_runs),
         'spans_disabled_ms': [float(r[METRIC]) for r in base_runs],
         'spans_enabled_ms': [float(r[METRIC]) for r in traced_runs],

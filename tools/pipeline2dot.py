@@ -15,6 +15,12 @@ Annotations matching the reference's information set:
     (docs/observability.md), so the graph doubles as a bottleneck map
     (a full ring ahead of a slow block shows up immediately); ring
     wait p99 is appended when the exporter recorded one
+  * BridgeSink/BridgeSource rendered as CROSS-HOST boundary nodes
+    (cds shape, gold fill, labeled with role + peer address) annotated
+    with the live bridge tx/rx byte totals, rates, and reconnect
+    counts from the ``<block>_bridge_transmit|capture/stats`` entries
+    the transport publishes (docs/networking.md) — the inter-host hop
+    is visible in the graph, not disguised as an ordinary block
   * dotted bidirectional association edges between blocks bound to the
     same core (reference: pipeline2dot.py:188-219)
 """
@@ -103,6 +109,66 @@ def core_associations(contents):
     return pairs
 
 
+#: suffixes of the transport's stats ProcLog directories — these are
+#: per-endpoint telemetry attachments, not pipeline blocks
+_BRIDGE_STAT_SUFFIXES = ('_bridge_transmit', '_bridge_capture')
+
+
+def bridge_info(contents):
+    """{block: {'role': 'sink'|'source', 'peer': 'addr:port'}} from
+    the ``<block>/bridge`` ProcLogs the bridge blocks publish."""
+    out = {}
+    for block, logs in contents.items():
+        if _is_ring_entry(block):
+            continue
+        b = logs.get('bridge')
+        if isinstance(b, dict) and b.get('role'):
+            out[block] = {'role': str(b['role']),
+                          'peer': str(b.get('peer', '?'))}
+    return out
+
+
+def bridge_stats(contents, block):
+    """The transport's live stats for a bridge block: tx or rx bytes,
+    rate, and reconnect/dup counts from its ``*_bridge_transmit`` /
+    ``*_bridge_capture`` stats entry (whichever exists)."""
+    for suffix, kind in (('_bridge_transmit', 'tx'),
+                         ('_bridge_capture', 'rx')):
+        logs = contents.get(block + suffix)
+        if not logs:
+            continue
+        stats = logs.get('stats', {})
+        if not stats:
+            continue
+        nbytes = stats.get('nbytes', stats.get('ngood_bytes', 0))
+        out = {'kind': kind, 'nbytes': int(float(nbytes or 0)),
+               'rate_MBps': float(stats.get('rate_MBps', 0) or 0)}
+        if kind == 'tx':
+            out['reconnects'] = int(float(stats.get('reconnects', 0)
+                                          or 0))
+            out['nspans'] = int(float(stats.get('nspans', 0) or 0))
+        else:
+            out['dups'] = int(float(stats.get('nignored', 0) or 0))
+        return out
+    return None
+
+
+def bridge_label(info, stats):
+    """Boundary-node label lines under the block name."""
+    parts = ['bridge %s <-> %s' % (info['role'], info['peer'])]
+    if stats:
+        sz, un = get_best_size(stats['nbytes'])
+        line = '%s %.1f %s' % (stats['kind'], sz, un)
+        if stats.get('rate_MBps'):
+            line += ' @ %.1f MB/s' % stats['rate_MBps']
+        parts.append(line)
+        if stats.get('reconnects'):
+            parts.append('%d reconnect(s)' % stats['reconnects'])
+        if stats.get('dups'):
+            parts.append('%d dup(s) dropped' % stats['dups'])
+    return '\\n'.join(parts)
+
+
 def ring_flow(contents):
     """rings_flow/<name> ProcLogs -> {ring_name: fields} (published by
     telemetry.exporter.MetricsPublisher)."""
@@ -139,6 +205,7 @@ def to_dot(pid, contents, associations=True):
     flows, sources, sinks = get_data_flows(contents)
     geometry = ring_geometry(contents)
     ring_flows = ring_flow(contents)
+    bridges = bridge_info(contents)
     cmd = get_command_line(pid)
     if cmd.startswith('python'):
         cmd = cmd.split(None, 1)[-1]
@@ -150,14 +217,28 @@ def to_dot(pid, contents, associations=True):
              '  label="Pipeline: %s\\n ";' % cmd]
     rings = set()
     for block, (ins, outs) in sorted(flows.items()):
+        # the transport's per-endpoint stats directories are telemetry
+        # attachments of a bridge block, not pipeline blocks
+        if block.endswith(_BRIDGE_STAT_SUFFIXES):
+            continue
         logs = contents[block]
         core = logs.get('bind', {}).get('core0', None)
         cpu = 'Unbound' if core in (None, -1) else 'CPU%s' % core
-        shape = 'ellipse' if block in sources else \
-            'diamond' if block in sinks else 'box'
-        lines.append('  "%s" [label="%s\\n%s" shape="%s" style=filled '
-                     'fillcolor=lightsteelblue];'
-                     % (block, block, cpu, shape))
+        if block in bridges:
+            # cross-host boundary node: the stream leaves/enters this
+            # process here — annotate with the live transport figures
+            info = bridges[block]
+            stats = bridge_stats(contents, block)
+            lines.append('  "%s" [label="%s\\n%s\\n%s" shape="cds" '
+                         'style=filled fillcolor=lightgoldenrod];'
+                         % (block, block, cpu,
+                            bridge_label(info, stats)))
+        else:
+            shape = 'ellipse' if block in sources else \
+                'diamond' if block in sinks else 'box'
+            lines.append('  "%s" [label="%s\\n%s" shape="%s" '
+                         'style=filled fillcolor=lightsteelblue];'
+                         % (block, block, cpu, shape))
         # sequence proclogs record the block's INPUT header
         # (pipeline.py MultiTransformBlock.main), so the dtype label
         # belongs on the input edges only

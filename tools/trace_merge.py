@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""Merge per-host Chrome traces into ONE cross-host timeline.
+
+Each bifrost_tpu process exports its spans on its OWN clock
+(``time.perf_counter`` since process start — see telemetry/spans.py),
+so two hosts' trace files cannot be overlaid directly.  The bridge
+handshake solves this: every HELLO/HELLO_ACK exchange doubles as a
+clock PING (io/bridge.py), and the sender embeds the estimated
+peer-clock offset (accurate to ~RTT/2) into its trace export under
+``otherData.bf_clock.sessions``.  This tool walks those session links
+to put every input trace onto the FIRST input's clock and writes one
+merged Chrome trace JSON:
+
+    python tools/trace_merge.py -o merged.json host_a.json host_b.json
+
+- Files are joined by bridge SESSION id: a file whose sessions entry
+  carries an ``offset_us`` (the tx side) anchors its rx peer (the file
+  registering the same session without an offset).  Chains work too
+  (A->B->C shifts C by both hops' offsets).
+- Unlinked files merge with zero shift and a warning (their relative
+  position is then meaningless — but their spans are preserved).
+- pids are renumbered per input file, with ``process_name`` metadata
+  ``host=... pid=... (file)`` so Perfetto shows which host each track
+  came from.
+
+A gulp is then followable ACROSS hosts: compute spans carry
+``args.trace`` (the stream-unique trace id from the header trace
+context) plus ``(seq, gulp)``, and the bridge's ``bridge.tx.* /
+bridge.rx.*`` spans carry the same triple, so selecting a trace id in
+the merged view shows capture, transport, and remote commit on one
+timeline.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load(path):
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or 'traceEvents' not in data:
+        raise ValueError('%s is not a Chrome trace JSON' % path)
+    return data
+
+
+def clock_sessions(data):
+    """{session: entry} from a trace file's bf_clock metadata."""
+    other = data.get('otherData') or {}
+    clock = other.get('bf_clock') or {}
+    sessions = clock.get('sessions') or {}
+    return {str(k): dict(v) for k, v in sessions.items()
+            if isinstance(v, dict)}
+
+
+def resolve_shifts(traces):
+    """Per-file shift (us to ADD to its timestamps) onto file 0's
+    clock, via BFS over shared bridge sessions.
+
+    The tx side measured ``offset_us = rx_clock - tx_clock``; a
+    timestamp from the rx file converts to the tx clock as
+    ``t - offset_us``."""
+    links = []                       # (tx_idx, rx_idx, offset_us)
+    by_session = {}
+    for idx, data in enumerate(traces):
+        for session, entry in clock_sessions(data).items():
+            by_session.setdefault(session, []).append((idx, entry))
+    for session, members in by_session.items():
+        txs = [(i, e) for i, e in members
+               if e.get('offset_us') is not None]
+        rxs = [(i, e) for i, e in members
+               if e.get('offset_us') is None]
+        for ti, te in txs:
+            for ri, _re in rxs:
+                if ti != ri:
+                    links.append((ti, ri, float(te['offset_us'])))
+    shifts = {0: 0.0}
+    frontier = [0]
+    while frontier:
+        cur = frontier.pop()
+        for ti, ri, off in links:
+            if ti == cur and ri not in shifts:
+                # rx file's clock -> tx file's clock: t - off, then
+                # onto file 0's clock with the tx file's own shift
+                shifts[ri] = shifts[ti] - off
+                frontier.append(ri)
+            elif ri == cur and ti not in shifts:
+                shifts[ti] = shifts[ri] + off
+                frontier.append(ti)
+    return shifts
+
+
+def merge(paths):
+    traces = [load(p) for p in paths]
+    shifts = resolve_shifts(traces)
+    events = []
+    clocks = {}
+    for idx, (path, data) in enumerate(zip(paths, traces)):
+        shift = shifts.get(idx)
+        if shift is None:
+            print('trace_merge: WARNING: %s shares no bridge session '
+                  'with the reference trace — merged with zero shift '
+                  '(relative timing meaningless)' % path,
+                  file=sys.stderr)
+            shift = 0.0
+        other = (data.get('otherData') or {}).get('bf_clock') or {}
+        host = other.get('host', '?')
+        pid = idx + 1                # renumber: same-pid files collide
+        clocks[path] = {'shift_us': round(shift, 3), 'host': host,
+                        'orig_pid': other.get('pid')}
+        events.append({'ph': 'M', 'name': 'process_name', 'pid': pid,
+                       'tid': 0,
+                       'args': {'name': 'host=%s pid=%s (%s)'
+                                % (host, other.get('pid', '?'),
+                                   path)}})
+        for ev in data['traceEvents']:
+            ev = dict(ev)
+            ev['pid'] = pid
+            if 'ts' in ev and ev.get('ph') != 'M':
+                ev['ts'] = round(ev['ts'] + shift, 3)
+            events.append(ev)
+    return {'traceEvents': events, 'displayTimeUnit': 'ms',
+            'otherData': {'bf_merged_from': clocks}}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('inputs', nargs='+',
+                    help='per-host Chrome trace JSONs (BF_TRACE_FILE '
+                         'exports); the first is the clock reference')
+    ap.add_argument('-o', '--out', required=True,
+                    help='merged Chrome trace output path')
+    args = ap.parse_args()
+    merged = merge(args.inputs)
+    with open(args.out, 'w') as f:
+        json.dump(merged, f)
+    n = sum(1 for e in merged['traceEvents'] if e.get('ph') != 'M')
+    print('trace_merge: %d event(s) from %d file(s) -> %s'
+          % (n, len(args.inputs), args.out))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

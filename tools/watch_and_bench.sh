@@ -15,6 +15,10 @@
 #   BENCH_SUITE_${ROUND}.json - per-config detail written by run_suite_into
 #   BENCH_OBS_${ROUND}.json   - observability overhead gate (config 8 with
 #                               spans on vs off; tools/obs_overhead.py)
+#   BENCH_E2E_${ROUND}.json   - end-to-end observability gate (config 12:
+#                               full-stack overhead on the config-8 chain +
+#                               two-pipeline loopback SLO/trace-merge run;
+#                               tools/e2e_gate.py)
 #   BENCH_BATCH_${ROUND}.json - macro-gulp batch gate (config 9 on CPU:
 #                               K=16 >= K=1 min-of-N, alternating arm
 #                               order; tools/batch_gate.py)
@@ -77,6 +81,41 @@ for i in $(seq 1 400); do
         if [ "$orc" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) observability overhead gate FAILED" >> "$LOG"
           exit "$orc"
+        fi
+      fi
+      # End-to-end observability gate: config 12 on the CPU backend —
+      # the FULL stack (trace context + spans + SLO tracking) must stay
+      # under the 5% overhead bar on the config-8 chain, the two-
+      # pipeline loopback run must produce one MERGED cross-host trace,
+      # and the sink pipeline must report a capture-to-commit p99.
+      # Writes BENCH_E2E_${ROUND}.json.  A failure exits nonzero.
+      if [ "${BF_SKIP_E2E_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) e2e observability gate (config 12)" >> "$LOG"
+        E2E_OUT="BENCH_E2E_${ROUND}.json"
+        # keep the previous round's artifact for the regression sentinel
+        E2E_PREV=""
+        if [ -f "$E2E_OUT" ]; then
+          E2E_PREV="${E2E_OUT}.prev"
+          cp "$E2E_OUT" "$E2E_PREV"
+        elif [ -f "BENCH_E2E_cpu.json" ]; then
+          E2E_PREV="BENCH_E2E_cpu.json"
+        fi
+        python tools/e2e_gate.py --out "$E2E_OUT" >> "$LOG" 2>&1
+        erc=$?
+        echo "$(date -u +%FT%TZ) e2e gate rc=$erc" >> "$LOG"
+        if [ "$erc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) e2e observability gate FAILED" >> "$LOG"
+          exit "$erc"
+        fi
+        # Regression sentinel (ADVISORY): diff the fresh artifact
+        # against the previous round's and log drifts beyond the
+        # watchlist thresholds — the verdict is informational here
+        # (tools/telemetry_diff.py --strict exists for CI that wants
+        # a hard gate).
+        if [ -n "$E2E_PREV" ]; then
+          echo "$(date -u +%FT%TZ) telemetry drift sentinel vs $E2E_PREV (advisory)" >> "$LOG"
+          python tools/telemetry_diff.py "$E2E_PREV" "$E2E_OUT" >> "$LOG" 2>&1 || true
+          rm -f "${E2E_OUT}.prev"
         fi
       fi
       # Macro-gulp batch gate: config 9 on the CPU backend — K=16 must
