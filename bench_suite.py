@@ -1681,5 +1681,114 @@ def main(argv=None):
     return 0
 
 
+# ---------------------------------------------------------------------------
+# static-verification topology registry (tools/bf_lint.py --topology,
+# tools/verify_gate.py): build-only replicas of every PIPELINE-shaped
+# bench config's block/ring graph, so the static verifier can prove the
+# shipped topologies clean without paying a bench run.  Configs 1-7 are
+# op-level rooflines with no pipeline and have nothing to verify.
+# ---------------------------------------------------------------------------
+
+def _verify_chain(tmp_kwargs=None, **pipe_kwargs):
+    """The config-8 fused Guppi chain (host src -> copy h2d -> fused
+    FFT->detect->reduce -> copy d2h -> sink) as a build-only Pipeline —
+    the exact topology _timed_config8_chain / bench_gulp_batch /
+    bench_e2e_observability run."""
+    import sys as _sys
+    import os as _os
+    _tests = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests')
+    if _tests not in _sys.path:
+        _sys.path.insert(0, _tests)
+    import bifrost_tpu as bf
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    NT, NP, NF, RF = 64, 2, 256, 4
+    raw = np.zeros((NT, NP, NF), dtype=np.dtype([('re', 'i1'),
+                                                 ('im', 'i1')]))
+    hdr = simple_header([-1, NP, NF], 'ci8',
+                        labels=['time', 'pol', 'fine_time'])
+    with bf.Pipeline(sync_depth=4, **pipe_kwargs) as p:
+        src = NumpySourceBlock([raw.copy()], hdr, gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='tpu')
+        fb = bf.blocks.fused(
+            b, [FftStage('fine_time', axis_labels='freq'),
+                DetectStage('stokes', axis='pol'),
+                ReduceStage('freq', RF)])
+        b2 = bf.blocks.copy(fb, space='system')
+        GatherSink(b2)
+    return p
+
+
+def _verify_config8():
+    return _verify_chain()
+
+
+def _verify_config9():
+    # the macro-gulp batch gate's K=16 arm (bench_gulp_batch)
+    return _verify_chain(gulp_batch=16)
+
+
+def _verify_config10():
+    """The bridge pump as the block-level two-pipeline topology
+    (sender: src -> BridgeSink; receiver: BridgeSource -> sink) —
+    bench_bridge drives the same transport at the io layer, and
+    config 12's two-host run uses exactly these blocks."""
+    import sys as _sys
+    import os as _os
+    _tests = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests')
+    if _tests not in _sys.path:
+        _sys.path.insert(0, _tests)
+    import bifrost_tpu as bf
+    from bifrost_tpu.blocks.bridge import bridge_sink, bridge_source
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    NT, NC = 64, 256
+    raw = np.zeros((NT, NC), np.float32)
+    hdr = simple_header([-1, NC], 'f32')
+    with bf.Pipeline() as prx:
+        src_rx = bridge_source('127.0.0.1', 0)
+        GatherSink(src_rx)
+    with bf.Pipeline() as ptx:
+        src = NumpySourceBlock([raw.copy()], hdr, gulp_nframe=NT)
+        bridge_sink(src, '127.0.0.1', src_rx.port)
+    return [ptx, prx]
+
+
+def _verify_config11():
+    # the mesh pipeline gate's sharded arm (bench_mesh_pipeline):
+    # config-8 chain + macro K=4 under an N-device mesh
+    import jax
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    from jax.sharding import Mesh
+    n = 8 if len(devs) >= 8 else len(devs)
+    mesh = Mesh(np.array(devs[:n]), ('sp',))
+    return _verify_chain(gulp_batch=4, mesh=mesh)
+
+
+def _verify_config12():
+    # the e2e observability gate: the config-8 overhead chain plus the
+    # two-pipeline loopback bridge run (_e2e_two_host_run)
+    return [_verify_chain()] + _verify_config10()
+
+
+def build_verify_topologies():
+    """{name: builder} over every pipeline-shaped bench config.  Each
+    builder returns a Pipeline, a list of Pipelines, or None when the
+    topology is unavailable on this host (mesh without devices).  The
+    pipelines are BUILT but never run — callers validate() them."""
+    return {
+        'config8_chain': _verify_config8,
+        'config9_macro': _verify_config9,
+        'config10_bridge': _verify_config10,
+        'config11_mesh': _verify_config11,
+        'config12_e2e': _verify_config12,
+    }
+
+
 if __name__ == '__main__':
     sys.exit(main())

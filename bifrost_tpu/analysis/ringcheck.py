@@ -1,0 +1,437 @@
+"""Dynamic ring-protocol checker (``BF_RINGCHECK=1`` — docs/analysis.md).
+
+Five PRs of concurrency surgery (async deferred fills, poisoning
+wakeups, multi-gulp macro crediting, the bridge's multi-open-span
+guarantee pinning) rest on a handful of ring-protocol invariants that
+nothing machine-checked until now.  This module is a **shadow state
+machine** hooked into the span lifecycle seams shared by BOTH ring
+cores — the same ``WriteSpan`` / ``ReadSpan`` / ``ReadSequence`` /
+``Ring.poison`` wrappers the PR 3/7 telemetry rides — that replays
+every reserve/commit/acquire/release/poison event against its own
+model of what a correct ring may do, and raises
+:class:`RingProtocolError` carrying a span-history trace the moment
+the stream of events becomes impossible.
+
+Invariants asserted (the checker's catalog; docs/analysis.md maps each
+to the PR that introduced it):
+
+- **commit ordering** — a span may be committed exactly once, and a
+  PARTIAL commit (``commit_nbyte < reserved``) is only legal on the
+  newest outstanding span (the in-order commit barrier's truncation
+  rule).
+- **guarantee pinned at the oldest open span** — no reservation may
+  overwrite bytes at or after a guaranteed reader's pin (the minimum
+  over its open spans' begins, or its released high-water mark).  This
+  is checked end-to-end: the shadow derives the pin from the event
+  stream and validates every reserve's implied tail against it, so a
+  core whose guarantee bookkeeping jumps forward past a held span (the
+  pre-PR-5 watermark bug) is caught at the first overwriting reserve.
+- **no acquire of uncommitted frames** — an acquired span must lie
+  entirely within the committed head derived from the commit events.
+- **no double release / double commit** — set-membership on the shadow
+  state.
+- **poison must wake every blocked span** — ``poison()`` snapshots the
+  seam operations currently blocked inside the core; a watchdog timer
+  (``BF_RINGCHECK_WAKE_SECS``, default 2s) flags any of them still
+  blocked after the grace window.
+
+Violations raise in the thread that performed the illegal operation
+(or, for deferred wake-violations, at the next seam touch on that
+ring) and are additionally recorded on the module-level
+:func:`violations` list and the ``ringcheck.violations`` telemetry
+counter, so tests and operators can observe them even when the raising
+thread's block absorbs the exception.
+
+``BF_RINGCHECK=0`` (the default) reduces every seam to one module-bool
+test — runs are bit-identical in behavior to a build without the
+checker.  The fault harness (:mod:`bifrost_tpu.testing.faults`) grows
+``ring.corrupt.*`` seams that deliberately violate each invariant so
+``tests/test_analysis.py`` proves the checker catches every class in
+both cores.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ['RingProtocolError', 'enabled', 'reconfigure', 'set_enabled',
+           'hook', 'violations', 'reset']
+
+
+class RingProtocolError(RuntimeError):
+    """A ring-protocol invariant was violated (BF_RINGCHECK=1).
+
+    ``ring_name`` is the offending ring, ``invariant`` a stable slug of
+    the violated rule (``commit_order``, ``double_commit``,
+    ``double_release``, ``acquire_uncommitted``, ``guarantee_pin``,
+    ``poison_wake``), and the message embeds the ring's recent
+    span-history trace."""
+
+    def __init__(self, ring_name, invariant, detail, history=''):
+        self.ring_name = ring_name
+        self.invariant = invariant
+        msg = ("BF-RINGCHECK: invariant %r violated on ring %r: %s"
+               % (invariant, ring_name, detail))
+        if history:
+            msg += "\nrecent span history (oldest first):\n" + history
+        super(RingProtocolError, self).__init__(msg)
+
+
+def _env_enabled():
+    return os.environ.get('BF_RINGCHECK', '0').strip() == '1'
+
+
+def _env_wake_secs():
+    try:
+        return float(os.environ.get('BF_RINGCHECK_WAKE_SECS', '2.0'))
+    except ValueError:
+        return 2.0
+
+
+_enabled = _env_enabled()
+_viol_lock = threading.Lock()
+_violations = []                  # RingProtocolError instances
+
+
+def enabled():
+    """Whether the checker is armed (one bool test on the hot seams)."""
+    return _enabled
+
+
+def reconfigure():
+    """Re-read ``BF_RINGCHECK`` (Pipeline.run calls this so a long-lived
+    process can toggle the checker between runs)."""
+    global _enabled
+    _enabled = _env_enabled()
+
+
+def set_enabled(on):
+    """Programmatic toggle (tests)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def violations():
+    """Every violation recorded so far (raised or deferred)."""
+    with _viol_lock:
+        return list(_violations)
+
+
+def reset():
+    """Clear the recorded-violation list (tests call this between
+    cases; per-ring shadow state lives on the rings themselves and
+    dies with them)."""
+    with _viol_lock:
+        del _violations[:]
+
+
+def _record(exc):
+    with _viol_lock:
+        _violations.append(exc)
+    try:
+        from ..telemetry import counters
+        counters.inc('ringcheck.violations')
+    except Exception:
+        pass
+
+
+class _Reader(object):
+    """Shadow state of one ReadSequence on one ring."""
+
+    __slots__ = ('guarantee', 'opens', 'pin', 'release_high')
+
+    def __init__(self, guarantee):
+        self.guarantee = bool(guarantee)
+        self.opens = []          # begins of OPEN read spans
+        #: shadow of the reader's guarantee pin in absolute bytes;
+        #: None until the first acquire makes it exact (the core seeds
+        #: its pin with a tail clamp the shadow cannot see, so an
+        #: earlier value could only be conservative and false-positive)
+        self.pin = None
+        self.release_high = None
+
+
+class _Shadow(object):
+    """Per-ring shadow state machine.  Holds NO reference to the ring
+    (the ring owns the shadow); everything it needs arrives through the
+    seam calls."""
+
+    HISTORY = 128
+
+    def __init__(self, ring_name):
+        self.name = ring_name
+        self.lock = threading.Lock()
+        self.history = deque(maxlen=self.HISTORY)
+        self.t0 = time.monotonic()
+        #: open write spans in reserve order: [id -> dict] as a list of
+        #: dicts {id, begin, nbyte, closed, commit}
+        self.wspans = []
+        #: committed head in absolute bytes (advanced by the in-order
+        #: prefix of closed spans, mirroring the core's barrier)
+        self.head = 0
+        self.head_known = False   # becomes True at the first commit
+        self.readers = {}         # id(rseq) -> _Reader
+        self.poisoned = False
+        #: blocked seam operations: token -> (op, thread, t_enter)
+        self.pending = {}
+        self._tok = 0
+        #: violations detected asynchronously (poison-wake timer);
+        #: raised at the next seam touch
+        self.deferred = []
+
+    # -- history -----------------------------------------------------------
+    def _note(self, op, detail):
+        self.history.append((time.monotonic() - self.t0,
+                             threading.current_thread().name, op,
+                             detail))
+
+    def format_history(self, last=24):
+        out = []
+        for t, thr, op, detail in list(self.history)[-last:]:
+            out.append("  t+%8.3fs [%s] %-14s %s" % (t, thr, op, detail))
+        return '\n'.join(out)
+
+    def _raise(self, invariant, detail):
+        exc = RingProtocolError(self.name, invariant, detail,
+                                self.format_history())
+        self._note('VIOLATION', '%s: %s' % (invariant, detail))
+        _record(exc)
+        raise exc
+
+    def _check_deferred(self):
+        if self.deferred:
+            exc = self.deferred.pop(0)
+            raise exc
+
+    # -- pending-op bookkeeping (poison-wake invariant) --------------------
+    def _enter(self, op, detail):
+        self._tok += 1
+        tok = self._tok
+        self.pending[tok] = (op, threading.current_thread().name,
+                             time.monotonic())
+        self._note(op + '.enter', detail)
+        return tok
+
+    def _exit(self, tok):
+        self.pending.pop(tok, None)
+
+    # -- writer side -------------------------------------------------------
+    def reserve_enter(self, nbyte):
+        with self.lock:
+            self._check_deferred()
+            return self._enter('reserve', 'nbyte=%d' % nbyte)
+
+    def reserve_abort(self, tok):
+        with self.lock:
+            self._exit(tok)
+            self._note('reserve.abort', '')
+
+    def reserve_done(self, tok, span, begin, nbyte, ring_size):
+        with self.lock:
+            self._exit(tok)
+            self._note('reserve', 'begin=%d nbyte=%d' % (begin, nbyte))
+            self.wspans.append({'id': id(span), 'begin': begin,
+                                'nbyte': nbyte, 'closed': False,
+                                'commit': None})
+            if self.poisoned or not ring_size:
+                return
+            # guarantee-pin invariant, end to end: the bytes this
+            # reservation will overwrite (everything below its implied
+            # new tail) must lie strictly before every guaranteed
+            # reader's pin.  A core whose guarantee jumped forward past
+            # a held span admits a reserve that lands here.
+            new_tail = begin + nbyte - ring_size
+            for rd in self.readers.values():
+                if not rd.guarantee or rd.pin is None:
+                    continue
+                pin = min(rd.opens) if rd.opens else rd.pin
+                if new_tail > pin:
+                    self._raise(
+                        'guarantee_pin',
+                        'reserve [%d, %d) implies tail %d past a '
+                        'guaranteed reader pinned at %d (open spans: '
+                        '%s) — the writer is overwriting bytes a held '
+                        'span still exports'
+                        % (begin, begin + nbyte, new_tail, pin,
+                           rd.opens or '[]'))
+
+    def commit(self, span, commit_nbyte):
+        with self.lock:
+            self._check_deferred()
+            sid = id(span)
+            rec = None
+            for r in self.wspans:
+                if r['id'] == sid and not r['closed']:
+                    rec = r
+                    break
+            if rec is None:
+                self._raise(
+                    'double_commit',
+                    'commit of %d bytes for a span that is not an '
+                    'open reservation (begin=%s) — double commit or '
+                    'commit of a foreign span'
+                    % (commit_nbyte,
+                       getattr(span, '_begin', '?')))
+            if commit_nbyte < rec['nbyte']:
+                # partial commits truncate the reserve head: only the
+                # newest outstanding reservation may do that
+                newest = self.wspans[-1]
+                if newest is not rec:
+                    self._raise(
+                        'commit_order',
+                        'partial commit (%d < %d) of span begin=%d '
+                        'while a later reservation (begin=%d) is '
+                        'outstanding' % (commit_nbyte, rec['nbyte'],
+                                         rec['begin'],
+                                         newest['begin']))
+            rec['closed'] = True
+            rec['commit'] = commit_nbyte
+            # apply the in-order prefix, mirroring the core's barrier
+            while self.wspans and self.wspans[0]['closed']:
+                r = self.wspans.pop(0)
+                self.head = r['begin'] + r['commit']
+                self.head_known = True
+                if r['commit'] < r['nbyte']:
+                    # truncation rolls later offsets back; drop stale
+                    # shadow spans (there are none per the check above)
+                    break
+            self._note('commit', 'begin=%d nbyte=%d'
+                       % (rec['begin'], commit_nbyte))
+
+    # -- reader side -------------------------------------------------------
+    def reader_opened(self, rseq):
+        with self.lock:
+            self.readers[id(rseq)] = _Reader(
+                getattr(rseq, 'guarantee', True))
+            self._note('reader.open', 'guarantee=%s'
+                       % getattr(rseq, 'guarantee', True))
+
+    def reader_moved(self, rseq, new_begin):
+        with self.lock:
+            rd = self.readers.get(id(rseq))
+            if rd is None:
+                return
+            self._note('reader.moved', 'begin=%d' % new_begin)
+            if not rd.guarantee:
+                return
+            if rd.opens:
+                rd.pin = min(rd.opens)
+            elif rd.pin is not None:
+                rd.pin = max(rd.pin, new_begin)
+
+    def reader_closed(self, rseq):
+        with self.lock:
+            self.readers.pop(id(rseq), None)
+            self._note('reader.close', '')
+
+    def acquire_enter(self, rseq, want_begin):
+        with self.lock:
+            self._check_deferred()
+            rd = self.readers.get(id(rseq))
+            if rd is not None and rd.guarantee and not rd.opens:
+                # mirror the core's pre-wait guarantee bump: with no
+                # span open the pin may advance to the requested begin
+                # (bounded by the committed head)
+                bump = min(want_begin, self.head) if self.head_known \
+                    else want_begin
+                if rd.pin is not None:
+                    rd.pin = max(rd.pin, bump)
+            return self._enter('acquire', 'want=%d' % want_begin)
+
+    def acquire_abort(self, tok):
+        with self.lock:
+            self._exit(tok)
+            self._note('acquire.abort', '')
+
+    def acquire_done(self, tok, rseq, begin, nbyte):
+        with self.lock:
+            self._exit(tok)
+            self._note('acquire', 'begin=%d nbyte=%d' % (begin, nbyte))
+            if nbyte and self.head_known and not self.poisoned \
+                    and begin + nbyte > self.head:
+                self._raise(
+                    'acquire_uncommitted',
+                    'acquired span [%d, %d) extends past the committed '
+                    'head %d — the reader was handed frames no commit '
+                    'ever published' % (begin, begin + nbyte, self.head))
+            rd = self.readers.get(id(rseq))
+            if rd is None:
+                rd = self.readers[id(rseq)] = _Reader(
+                    getattr(rseq, 'guarantee', True))
+            rd.opens.append(begin)
+            if rd.guarantee:
+                rd.pin = min(rd.opens)
+
+    def release(self, rseq, begin):
+        with self.lock:
+            self._check_deferred()
+            rd = self.readers.get(id(rseq))
+            if rd is None or begin not in rd.opens:
+                self._raise(
+                    'double_release',
+                    'release of span begin=%d that this reader does '
+                    'not hold (open spans: %s) — double release or '
+                    'release of a foreign span'
+                    % (begin, rd.opens if rd is not None else None))
+            rd.opens.remove(begin)
+            rd.release_high = begin if rd.release_high is None \
+                else max(rd.release_high, begin)
+            if rd.guarantee and rd.pin is not None:
+                rd.pin = min(rd.opens) if rd.opens \
+                    else max(rd.pin, rd.release_high)
+            self._note('release', 'begin=%d' % begin)
+
+    # -- poison ------------------------------------------------------------
+    def poisoned_now(self):
+        with self.lock:
+            if self.poisoned:
+                return
+            self.poisoned = True
+            blocked = dict(self.pending)
+            self._note('poison', 'pending=%d' % len(blocked))
+        if not blocked:
+            return
+        wake = _env_wake_secs()
+
+        def check():
+            with self.lock:
+                stuck = [(tok, info) for tok, info in blocked.items()
+                         if tok in self.pending]
+                if not stuck:
+                    return
+                detail = ', '.join(
+                    '%s in thread %s (blocked %.1fs)'
+                    % (op, thr, time.monotonic() - t)
+                    for _tok, (op, thr, t) in stuck)
+                exc = RingProtocolError(
+                    self.name, 'poison_wake',
+                    'poison did not wake every blocked span within '
+                    '%.1fs: %s' % (wake, detail),
+                    self.format_history())
+                self._note('VIOLATION', 'poison_wake: %s' % detail)
+                _record(exc)
+                # raise at the next seam touch on this ring (the
+                # blocked thread itself cannot be interrupted from
+                # here)
+                self.deferred.append(exc)
+
+        t = threading.Timer(wake, check)
+        t.daemon = True
+        t.start()
+
+
+def hook(ring):
+    """The ring's shadow checker, or None when BF_RINGCHECK is off.
+    The shadow is created lazily and stored on the ring instance, so
+    both cores (NativeRing extends Ring) share one code path and a
+    disabled checker costs one bool test."""
+    if not _enabled:
+        return None
+    shadow = ring.__dict__.get('_rc_shadow')
+    if shadow is None:
+        shadow = _Shadow(getattr(ring, 'name', '?'))
+        shadow = ring.__dict__.setdefault('_rc_shadow', shadow)
+    return shadow

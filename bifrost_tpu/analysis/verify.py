@@ -1,0 +1,794 @@
+"""Static pipeline verifier (docs/analysis.md).
+
+Walks a Pipeline's block/ring graph BEFORE ``run()`` and emits
+stable-coded diagnostics for misconfigurations that would otherwise
+surface as runtime stalls, gulp-0 exceptions, or silently degraded
+performance.  Exposed three ways:
+
+- ``Pipeline.validate()`` returns the diagnostic list;
+- ``BF_VALIDATE={off,warn,strict}`` gates ``Pipeline.run()`` (default
+  ``warn``: diagnostics print to stderr and publish to the
+  ``analysis/verify`` ProcLog so ``tools/pipeline2dot.py`` can overlay
+  them on the graph; ``strict`` refuses to start on any ``BF-E``);
+- ``tools/bf_lint.py`` / ``tools/verify_gate.py`` drive it standalone
+  (``BF_LINT=1`` makes ``Pipeline.run()`` validate-and-return without
+  launching threads).
+
+Diagnostic codes are STABLE API (tests assert them; operators grep
+them).  The catalog lives in :data:`CODES`; docs/analysis.md documents
+each with its remedy.
+
+Everything here is best-effort by construction: the verifier derives
+what it can from statically-known scope tunables, source-advertised
+headers (:meth:`SourceBlock.static_oheaders`), and the pure
+header-transform halves of device blocks (``verify_header``).  Where
+propagation stops it says so (``BF-I1xx`` info) instead of guessing,
+and ``gate_run`` never lets a verifier-internal failure take down a
+pipeline start in ``warn`` mode.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+from copy import deepcopy
+
+__all__ = ['Diagnostic', 'PipelineValidationError', 'CODES',
+           'verify_pipeline', 'errors', 'warnings_', 'format_report',
+           'gate_run', 'lint_intercept', 'validate_mode']
+
+#: stable diagnostic-code catalog: code -> one-line title.
+#: BF-Exxx = error (strict mode refuses to run), BF-Wxxx = warning,
+#: BF-Ixxx = info.  See docs/analysis.md for the full entry per code.
+CODES = {
+    'BF-E101': 'ring sized below the deadlock-freedom bound',
+    'BF-W102': 'buffer_factor below the deadlock-freedom bound',
+    'BF-W110': 'bridge credit window exceeds source-ring capacity',
+    'BF-E120': 'invalid _tensor header (frame layout unresolvable)',
+    'BF-E121': 'shape/dtype contract break across a block edge',
+    'BF-E130': 'donation requested on a multi-reader ring',
+    'BF-W131': 'donation requested with an unguaranteed consumer',
+    'BF-W140': 'mesh boundary forces a per-gulp reshard',
+    'BF-W141': 'mesh scope cannot shard the gulp geometry',
+    'BF-E150': 'bridge credit window < 1',
+    'BF-W151': 'bridge CRC requested on the v1 wire (no CRC field)',
+    'BF-W152': 'bridge window > 1 on the v1 wire (no credit flow)',
+    'BF-W160': 'macro-gulp batch requested but statically ineligible',
+    'BF-I161': 'macro-gulp batch falls back on a host/compute block',
+    'BF-I170': 'header propagation stops at this block',
+    'BF-I171': 'gulp geometry unknown; ring sizing not proven',
+    'BF-I199': 'verifier check failed internally (diagnostic only)',
+}
+
+_SEVERITY = {'E': 'error', 'W': 'warning', 'I': 'info'}
+
+
+class Diagnostic(object):
+    """One verifier finding, anchored to a block and/or ring."""
+
+    __slots__ = ('code', 'message', 'block', 'ring')
+
+    def __init__(self, code, message, block=None, ring=None):
+        assert code in CODES, 'unknown diagnostic code %r' % code
+        self.code = code
+        self.message = message
+        self.block = block
+        self.ring = ring
+
+    @property
+    def severity(self):
+        return _SEVERITY[self.code[3]]
+
+    @property
+    def is_error(self):
+        return self.code[3] == 'E'
+
+    def as_dict(self):
+        return {'code': self.code, 'severity': self.severity,
+                'message': self.message, 'block': self.block,
+                'ring': self.ring}
+
+    def __repr__(self):
+        where = self.block or self.ring or '?'
+        return '%s [%s] %s' % (self.code, where, self.message)
+
+
+class PipelineValidationError(RuntimeError):
+    """Raised by ``Pipeline.run()`` under ``BF_VALIDATE=strict`` when
+    the verifier reports any ``BF-E`` diagnostic."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        errs = [d for d in self.diagnostics if d.is_error]
+        super(PipelineValidationError, self).__init__(
+            'pipeline validation failed (BF_VALIDATE=strict): '
+            '%d error(s)\n%s' % (len(errs), format_report(errs)))
+
+
+def errors(diags):
+    return [d for d in diags if d.severity == 'error']
+
+
+def warnings_(diags):
+    return [d for d in diags if d.severity == 'warning']
+
+
+def format_report(diags):
+    """Human-readable multi-line report (bf_lint output format)."""
+    lines = []
+    order = {'error': 0, 'warning': 1, 'info': 2}
+    for d in sorted(diags, key=lambda d: (order[d.severity], d.code)):
+        where = d.block or ''
+        if d.ring:
+            where += ('@' if where else '') + 'ring:%s' % d.ring
+        lines.append('%s %-9s %-38s %s'
+                     % (d.code, d.severity, where, d.message))
+    return '\n'.join(lines)
+
+
+def validate_mode():
+    """Effective BF_VALIDATE mode: 'off' | 'warn' | 'strict'
+    (default 'warn'; unrecognized values mean 'warn' so a typo never
+    silently disables validation)."""
+    mode = os.environ.get('BF_VALIDATE', 'warn').strip().lower()
+    if mode in ('off', '0', 'none', ''):
+        return 'off'
+    if mode == 'strict':
+        return 'strict'
+    return 'warn'
+
+
+# ---------------------------------------------------------------------------
+# graph model
+# ---------------------------------------------------------------------------
+
+class _Stream(object):
+    """Statically-derived knowledge about one ring's stream: the
+    advertised logical gulp (frames) and, when propagation succeeded,
+    the sequence header a consumer will see."""
+
+    __slots__ = ('gulp', 'header', 'src')
+
+    def __init__(self, gulp=None, header=None, src=None):
+        self.gulp = gulp
+        self.header = header
+        self.src = src
+
+
+class _FakeSeq(object):
+    """Minimal ReadSequence stand-in for pure overlap negotiation."""
+
+    def __init__(self, header):
+        self.header = header if header is not None else {}
+
+
+def _base(ring):
+    return getattr(ring, '_base_ring', ring)
+
+
+def _ring_name(ring):
+    return getattr(ring, 'name', '?')
+
+
+class _Graph(object):
+    def __init__(self, pipeline):
+        self.pipeline = pipeline
+        self.blocks = list(pipeline.blocks)
+        self.consumers = {}       # id(base ring) -> [block]
+        self.producers = {}       # id(base ring) -> block
+        self.rings = {}           # id(base ring) -> ring
+        for b in self.blocks:
+            for r in getattr(b, 'irings', ()) or ():
+                br = _base(r)
+                self.rings.setdefault(id(br), br)
+                self.consumers.setdefault(id(br), []).append(b)
+            for r in getattr(b, 'orings', ()) or ():
+                br = _base(r)
+                self.rings.setdefault(id(br), br)
+                self.producers[id(br)] = b
+        self.streams = {}         # id(base ring) -> _Stream
+
+
+# ---------------------------------------------------------------------------
+# macro-batch / donation resolution shared with the runtime
+# ---------------------------------------------------------------------------
+
+def _macro_static_k(block, overlap=None, igulp=None):
+    """Effective macro-gulp K for ``block`` derivable statically: the
+    requested K when no static fallback applies (the same conditions
+    ``MultiTransformBlock._resolve_macro_batch`` tests at run time —
+    block safety, topology, guarantee, plus overlap and nframe
+    linearity when the verifier knows them), else 1.  Returns
+    ``(k, reason)``; reason is None when batching engages."""
+    from ..macro import resolve_gulp_batch
+    from ..pipeline import MultiTransformBlock
+    try:
+        k = resolve_gulp_batch(block)
+    except Exception:
+        return 1, None
+    if k <= 1:
+        return 1, None
+    if not isinstance(block, MultiTransformBlock):
+        return 1, 'block'
+    reason = block._macro_static_reason()
+    if reason is None and overlap:
+        reason = 'overlap'
+    if reason is None and igulp:
+        try:
+            per = block._define_output_nframes([igulp])
+            mac = block._define_output_nframes([igulp * k])
+            if mac != [o * k for o in per]:
+                reason = 'nonlinear'
+        except Exception:
+            reason = 'nonlinear'
+    if reason is not None:
+        return 1, reason
+    return k, None
+
+
+# ---------------------------------------------------------------------------
+# header / gulp propagation
+# ---------------------------------------------------------------------------
+
+def _propagate(g, diags):
+    from ..pipeline import SourceBlock
+    # seed at sources (blocks with no input rings)
+    for b in g.blocks:
+        if getattr(b, 'irings', None):
+            continue
+        orings = getattr(b, 'orings', ()) or ()
+        headers = None
+        if isinstance(b, SourceBlock):
+            try:
+                headers = b.static_oheaders()
+            except Exception:
+                headers = None
+        gulp = getattr(b, 'gulp_nframe', None)
+        for i, r in enumerate(orings):
+            hdr = None
+            if headers:
+                try:
+                    hdr = deepcopy(headers[i])
+                except Exception:
+                    hdr = None
+            g.streams[id(_base(r))] = _Stream(gulp=gulp, header=hdr,
+                                              src=b)
+        if orings and gulp is None:
+            diags.append(Diagnostic(
+                'BF-I171',
+                'source %r advertises no static gulp geometry; '
+                'downstream ring sizing cannot be proven' % b.name,
+                block=b.name))
+
+    # propagate through transforms to a fixpoint
+    remaining = [b for b in g.blocks if getattr(b, 'irings', None)]
+    progress = True
+    while progress and remaining:
+        progress = False
+        for b in list(remaining):
+            ins = [g.streams.get(id(_base(r))) for r in b.irings]
+            if any(s is None for s in ins):
+                continue
+            remaining.remove(b)
+            progress = True
+            _propagate_block(g, b, ins, diags)
+    # blocks fed by rings with no in-pipeline producer never resolve
+    for b in remaining:
+        for r in getattr(b, 'orings', ()) or ():
+            g.streams.setdefault(id(_base(r)), _Stream())
+
+
+def _propagate_block(g, b, ins, diags):
+    orings = getattr(b, 'orings', ()) or ()
+    # logical input gulps: the block's own tunable, else the
+    # producer-advertised gulp
+    igulps = [b.gulp_nframe or s.gulp for s in ins]
+    ogulps = [None] * len(orings)
+    if all(gulp is not None for gulp in igulps):
+        try:
+            ogulps = list(b._define_output_nframes(list(igulps)))
+        except Exception:
+            ogulps = [None] * len(orings)
+    # header propagation through the pure transform half, when the
+    # block exposes one (verify_header)
+    ohdr = None
+    ihdr = ins[0].header if ins else None
+    vh = getattr(b, 'verify_header', None)
+    if ihdr is not None and vh is not None:
+        try:
+            ohdr = vh(deepcopy(ihdr))
+        except Exception as exc:
+            diags.append(Diagnostic(
+                'BF-E121',
+                'block %r rejects the upstream stream contract '
+                '(%s: %s) — this would raise in on_sequence at '
+                'gulp 0' % (b.name, type(exc).__name__, exc),
+                block=b.name,
+                ring=_ring_name(_base(b.irings[0]))))
+            ohdr = None
+    elif ihdr is not None and vh is None and orings:
+        diags.append(Diagnostic(
+            'BF-I170',
+            'block %r has no static header transform; shape/dtype '
+            'verification stops here' % b.name, block=b.name))
+    if ohdr is not None and len(orings) > 1:
+        # verify_header derives one output header; secondary output
+        # streams get none — say so instead of silently skipping
+        # their downstream contract checks
+        diags.append(Diagnostic(
+            'BF-I170',
+            'block %r has %d output rings but its header transform '
+            'covers only the first; shape/dtype verification stops '
+            'at outputs 2..%d' % (b.name, len(orings), len(orings)),
+            block=b.name))
+    for i, r in enumerate(orings):
+        hdr_i = ohdr if i == 0 else None
+        g.streams[id(_base(r))] = _Stream(gulp=ogulps[i] if
+                                          i < len(ogulps) else None,
+                                          header=hdr_i, src=b)
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+def _check_tensor_contracts(g, diags):
+    from ..ring import _tensor_info
+    for rid, stream in g.streams.items():
+        if stream.header is None:
+            continue
+        try:
+            _tensor_info(stream.header)
+        except Exception as exc:
+            src = stream.src.name if stream.src is not None else None
+            diags.append(Diagnostic(
+                'BF-E120',
+                'sequence header on ring %r has an unresolvable '
+                '_tensor frame layout (%s: %s)'
+                % (_ring_name(g.rings[rid]), type(exc).__name__, exc),
+                block=src, ring=_ring_name(g.rings[rid])))
+
+
+def _consumer_geometry(g, b, ring, stream, diags):
+    """(span_frames, hold_frames, overlap) of consumer ``b`` on
+    ``ring``, or (None, None, None) when the gulp is unknown.  span =
+    one acquired span (incl. overlap and macro K); hold = frames this
+    consumer's guarantee can pin at once (bridge windows hold several
+    spans)."""
+    gin = b.gulp_nframe or stream.gulp
+    if gin is None:
+        return None, None, None
+    overlap = 0
+    try:
+        idx = [id(_base(r)) for r in b.irings].index(id(ring))
+        seqs = [_FakeSeq(g.streams.get(id(_base(r)),
+                                       _Stream()).header)
+                for r in b.irings]
+        overlap = list(b._define_input_overlap_nframe(seqs))[idx]
+    except Exception:
+        overlap = 0
+    k, _reason = _macro_static_k(b, overlap=overlap, igulp=gin)
+    span = k * (gin + overlap)
+    hold = span
+    from ..blocks.bridge import BridgeSink
+    if isinstance(b, BridgeSink):
+        hold = span * max(int(getattr(b, 'window', 1)), 1)
+    return span, hold, overlap
+
+
+def _check_ring_sizing(g, diags):
+    """Certain-deadlock / capacity checks: writer-resident span depth
+    (macro K·G, doubled per the begin_sequences writer-depth rule) plus
+    the largest guaranteed-reader pin must fit in what the sizing
+    negotiation will provide (``Ring.resize`` takes the MAX over all
+    requests, including a bridge sender's own ``window+2``).  When the
+    negotiated capacity falls short, an explicit ``buffer_nframe``
+    below the bound is an ERROR (the declared capacity deadlocks the
+    writer) and an explicit ``buffer_factor`` below it is a warning; a
+    bridge window that cannot fit alongside the writer's resident span
+    is a warning (the window self-caps, silently losing pipelining)."""
+    from ..blocks.bridge import BridgeSink
+    for rid, stream in g.streams.items():
+        producer = g.producers.get(rid)
+        if producer is None or stream.gulp is None:
+            continue
+        ring = g.rings[rid]
+        g_out = stream.gulp
+        kw, _r = _macro_static_k(producer)
+        writer_span = kw * g_out
+        writer_request = (2 if kw > 1 else 1) * writer_span
+        pins = []
+        requests = [writer_request]
+        cons = []
+        for b in g.consumers.get(rid, ()):
+            span, hold, _o = _consumer_geometry(g, b, ring, stream,
+                                                diags)
+            if span is None:
+                diags.append(Diagnostic(
+                    'BF-I171',
+                    'consumer %r of ring %r has unknown gulp '
+                    'geometry; its sizing is not proven'
+                    % (b.name, _ring_name(ring)),
+                    block=b.name, ring=_ring_name(ring)))
+                continue
+            guaranteed = bool(getattr(b, 'guarantee', True))
+            if guaranteed:
+                pins.append((b, hold))
+            bf = getattr(b, 'buffer_factor', None)
+            bnf = getattr(b, 'buffer_nframe', None)
+            req = bnf if bnf is not None \
+                else int(math.ceil((bf if bf is not None else 3)
+                                   * span))
+            if isinstance(b, BridgeSink):
+                # RingSender resizes the source ring itself at run
+                # time (io/bridge.py: buffer_factor=window+2), so the
+                # negotiated capacity is never below that
+                req = max(req, (getattr(b, 'window', 1) + 2) * span)
+            requests.append(req)
+            cons.append((b, span, hold, bnf, bf, req))
+        if not pins:
+            continue
+        max_pin_block, max_pin = max(pins, key=lambda p: p[1])
+        required = writer_span + max_pin
+        # the runtime negotiation takes the MAX over all sizing
+        # requests (Ring.resize), so one generous reader covers an
+        # undersized declaration elsewhere — only flag declarations
+        # when the ring's actual negotiated capacity falls short
+        provided = max(requests)
+        for b, span, hold, bnf, bf, req in (
+                cons if provided < required else ()):
+            if bnf is not None and bnf < required:
+                diags.append(Diagnostic(
+                    'BF-E101',
+                    'ring %r is explicitly sized to buffer_nframe=%d '
+                    'frames but needs >= %d (writer-resident span '
+                    '%d%s + guaranteed reader %r pinning %d): the '
+                    'declared capacity deadlocks the writer against '
+                    'the pinned read guarantee'
+                    % (_ring_name(ring), bnf, required, writer_span,
+                       ' [macro K=%d]' % kw if kw > 1 else '',
+                       max_pin_block.name, max_pin),
+                    block=b.name, ring=_ring_name(ring)))
+            elif bf is not None and req < required:
+                diags.append(Diagnostic(
+                    'BF-W102',
+                    'ring %r: explicit buffer_factor=%s provides %d '
+                    'frames, below the deadlock-freedom bound of %d '
+                    '(writer span %d + largest guaranteed pin %d)'
+                    % (_ring_name(ring), bf, req, required,
+                       writer_span, max_pin),
+                    block=b.name, ring=_ring_name(ring)))
+        # bridge window vs source-ring spans (docs/networking.md): the
+        # sender pins `window` spans un-acked; a ring that cannot hold
+        # window+1 spans silently caps the credit pipeline
+        for b, span, hold, bnf, bf, req in cons:
+            if isinstance(b, BridgeSink) and \
+                    getattr(b, 'window', 1) > 1 and \
+                    provided < hold + writer_span:
+                diags.append(Diagnostic(
+                    'BF-W110',
+                    'bridge sink %r holds a window of %d spans '
+                    '(%d frames) but ring %r provides only %d '
+                    'frames: the credit window is capped at ~%d '
+                    'span(s), losing pipelining — raise the ring '
+                    'buffering or lower BF_BRIDGE_WINDOW'
+                    % (b.name, b.window, hold, _ring_name(ring),
+                       provided,
+                       max((provided - writer_span) // max(span, 1),
+                           1)),
+                    block=b.name, ring=_ring_name(ring)))
+
+
+def _check_donation(g, diags):
+    from ..pipeline import TransformBlock, resolve_donate
+    for b in g.blocks:
+        if not isinstance(b, TransformBlock):
+            continue
+        irings = getattr(b, 'irings', ()) or ()
+        if not irings or _base(irings[0]).space != 'tpu':
+            continue
+        try:
+            if not resolve_donate(b):
+                continue
+        except Exception:
+            continue
+        rid = id(_base(irings[0]))
+        readers = g.consumers.get(rid, [])
+        ring = _ring_name(g.rings.get(rid, irings[0]))
+        if len(readers) > 1:
+            diags.append(Diagnostic(
+                'BF-E130',
+                'block %r requests buffer donation but its input ring '
+                '%r has %d readers (%s): exclusivity is disprovable — '
+                'a donated chunk would zero-fill under the other '
+                'reader(s).  Drop donate= on this scope or give the '
+                'taps their own copy'
+                % (b.name, ring, len(readers),
+                   ', '.join(x.name for x in readers)),
+                block=b.name, ring=ring))
+        elif not getattr(b, 'guarantee', True):
+            diags.append(Diagnostic(
+                'BF-W131',
+                'block %r requests buffer donation but reads '
+                'unguaranteed: an overwrite can race the exclusivity '
+                'claim, so donation will mostly miss (and the claim '
+                'is only point-in-time safe)' % b.name,
+                block=b.name, ring=ring))
+
+
+def _device_mesh(block):
+    """The mesh a device block will execute its plans under, or None.
+    Only blocks that build device plans count (FusedBlock, the jitted
+    stage blocks, CopyBlock device movers)."""
+    from ..blocks.fused import FusedBlock
+    from ..blocks.fft import _StageBlock
+    from ..blocks.copy import CopyBlock
+    if isinstance(block, (FusedBlock, _StageBlock)):
+        return block.mesh, True
+    if isinstance(block, CopyBlock):
+        spaces = (_base(block.irings[0]).space,
+                  _base(block.orings[0]).space) \
+            if block.irings and block.orings else ()
+        return block.mesh, 'tpu' in spaces
+    return None, False
+
+
+def _check_mesh(g, diags):
+    from ..parallel.scope import meshes_equivalent, time_axis_size
+    for rid, stream in g.streams.items():
+        ring = g.rings[rid]
+        if getattr(ring, 'space', None) != 'tpu':
+            continue
+        producer = g.producers.get(rid)
+        if producer is None:
+            continue
+        pmesh, p_is_dev = _device_mesh(producer)
+        for b in g.consumers.get(rid, ()):
+            cmesh, c_is_dev = _device_mesh(b)
+            if not c_is_dev:
+                continue
+            if cmesh is not None and stream.gulp is not None:
+                try:
+                    nsh = time_axis_size(cmesh)
+                except Exception:
+                    nsh = 1
+                gin = b.gulp_nframe or stream.gulp
+                if nsh > 1 and gin % nsh:
+                    diags.append(Diagnostic(
+                        'BF-W141',
+                        'block %r runs under a %d-way mesh but its '
+                        'gulp of %d frames does not divide it: every '
+                        'gulp falls back to single-device plans and '
+                        'the mesh never engages'
+                        % (b.name, nsh, gin),
+                        block=b.name, ring=_ring_name(ring)))
+                    continue
+            if not p_is_dev:
+                continue
+            if cmesh is None and pmesh is None:
+                continue
+            try:
+                ok = meshes_equivalent(pmesh, cmesh)
+            except Exception:
+                ok = True
+            if not ok:
+                diags.append(Diagnostic(
+                    'BF-W140',
+                    'ring %r crosses a mesh boundary: producer %r '
+                    'commits spans laid out for %s but consumer %r '
+                    'expects %s — every gulp of the sequence will pay '
+                    'a reshard (mesh.reshards > 0 predicted).  Put '
+                    'both blocks under one mesh scope or insert an '
+                    'explicit repartition point'
+                    % (_ring_name(ring), producer.name,
+                       _mesh_desc(pmesh), b.name, _mesh_desc(cmesh)),
+                    block=b.name, ring=_ring_name(ring)))
+
+
+def _mesh_desc(mesh):
+    if mesh is None:
+        return 'a single device (no mesh)'
+    try:
+        axes = ','.join('%s=%d' % (n, s)
+                        for n, s in zip(mesh.axis_names,
+                                        mesh.devices.shape))
+        return 'mesh[%s]' % axes
+    except Exception:
+        return 'a different mesh'
+
+
+def _check_bridge(g, diags):
+    from ..blocks.bridge import BridgeSink
+    for b in g.blocks:
+        if not isinstance(b, BridgeSink):
+            continue
+        req_w = getattr(b, 'requested_window', None)
+        if req_w is not None and int(req_w) < 1:
+            diags.append(Diagnostic(
+                'BF-E150',
+                'bridge sink %r configured with window=%s: the credit '
+                'window must be >= 1 span (1 = fully synchronous '
+                'v1-pump semantics); 0 would never grant the first '
+                'span credit' % (b.name, req_w),
+                block=b.name))
+        if getattr(b, 'protocol', None) == 1:
+            if getattr(b, 'crc', False):
+                diags.append(Diagnostic(
+                    'BF-W151',
+                    'bridge sink %r requests CRC on the v1 wire, '
+                    'which has no integrity field: the stream will '
+                    'ship unchecked' % b.name, block=b.name))
+            if getattr(b, 'window', 1) > 1:
+                diags.append(Diagnostic(
+                    'BF-W152',
+                    'bridge sink %r requests a %d-span credit window '
+                    'on the v1 wire, which is strictly '
+                    'send-and-wait: the window setting is ignored'
+                    % (b.name, b.window), block=b.name))
+
+
+def _check_macro(g, diags):
+    from ..pipeline import MultiTransformBlock
+    from ..macro import resolve_gulp_batch
+    for b in g.blocks:
+        if not isinstance(b, MultiTransformBlock):
+            continue
+        try:
+            if resolve_gulp_batch(b) <= 1:
+                continue
+        except Exception:
+            continue
+        irings = getattr(b, 'irings', ()) or ()
+        stream = g.streams.get(id(_base(irings[0]))) if irings \
+            else None
+        gin = None
+        overlap = 0
+        if stream is not None:
+            gin = b.gulp_nframe or stream.gulp
+            if gin is not None:
+                try:
+                    seqs = [_FakeSeq(g.streams.get(
+                        id(_base(r)), _Stream()).header)
+                        for r in b.irings]
+                    overlap = max(
+                        list(b._define_input_overlap_nframe(seqs)))
+                except Exception:
+                    overlap = 0
+        _k, reason = _macro_static_k(b, overlap=overlap, igulp=gin)
+        if reason is None:
+            continue
+        if reason == 'block':
+            diags.append(Diagnostic(
+                'BF-I161',
+                'block %r is a host/compute block: the requested '
+                'macro-gulp batch falls back to K=1 here (normal for '
+                'sources/sinks; the device blocks of the chain still '
+                'batch)' % b.name, block=b.name))
+        else:
+            diags.append(Diagnostic(
+                'BF-W160',
+                'block %r requests a macro-gulp batch but is '
+                'statically ineligible (reason: %s): it will silently '
+                'run K=1 and the configured batching buys nothing '
+                'here — today this is only visible as a '
+                'macro.fallback.%s counter' % (b.name, reason, reason),
+                block=b.name))
+
+
+_CHECKS = (_check_tensor_contracts, _check_ring_sizing,
+           _check_donation, _check_mesh, _check_bridge, _check_macro)
+
+
+def verify_pipeline(pipeline):
+    """Run every static check over ``pipeline``'s block/ring graph and
+    return the list of :class:`Diagnostic`.  Never raises: a check
+    that fails internally reports itself as ``BF-I199``."""
+    diags = []
+    g = _Graph(pipeline)
+    for b in g.blocks:
+        try:
+            b.cache_scope_hierarchy()
+        except Exception:
+            pass
+    try:
+        _propagate(g, diags)
+    except Exception as exc:
+        diags.append(Diagnostic(
+            'BF-I199', 'header/gulp propagation failed: %s: %s'
+            % (type(exc).__name__, exc)))
+    for check in _CHECKS:
+        try:
+            check(g, diags)
+        except Exception as exc:
+            diags.append(Diagnostic(
+                'BF-I199', 'check %s failed: %s: %s'
+                % (check.__name__, type(exc).__name__, exc)))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# run() integration
+# ---------------------------------------------------------------------------
+
+def publish_diagnostics(pipeline, diags):
+    """Publish diagnostics to the ``analysis/verify`` ProcLog so the
+    monitor tools (tools/pipeline2dot.py) can overlay them on the live
+    graph: red edges for BF-E, amber for BF-W, tooltip = code +
+    message."""
+    try:
+        from ..proclog import ProcLog
+        entry = {'n': len(diags),
+                 'errors': sum(1 for d in diags if
+                               d.severity == 'error'),
+                 'warnings': sum(1 for d in diags if
+                                 d.severity == 'warning'),
+                 'pipeline': pipeline.name}
+        for i, d in enumerate(diags):
+            entry['diag%d' % i] = json.dumps(d.as_dict(),
+                                             sort_keys=True)
+        ProcLog('analysis/verify').update(entry, force=True)
+    except Exception:
+        pass
+
+
+def _count(diags):
+    try:
+        from ..telemetry import counters
+        for d in diags:
+            counters.inc('analysis.diagnostics.%s' % d.severity)
+    except Exception:
+        pass
+
+
+def gate_run(pipeline, mode):
+    """The ``BF_VALIDATE`` gate ``Pipeline.run()`` calls before
+    launching threads.  ``warn``: report + publish, never block.
+    ``strict``: additionally refuse to start on any ``BF-E``."""
+    try:
+        diags = verify_pipeline(pipeline)
+    except Exception as exc:
+        if mode == 'strict':
+            raise
+        sys.stderr.write('bifrost_tpu.analysis.verify: verifier '
+                         'failed (%s); continuing\n' % exc)
+        return []
+    publish_diagnostics(pipeline, diags)
+    _count(diags)
+    visible = [d for d in diags if d.severity != 'info']
+    if visible:
+        sys.stderr.write(
+            'bifrost_tpu pipeline verifier (%s; BF_VALIDATE=%s — '
+            'see docs/analysis.md):\n%s\n'
+            % (pipeline.name, mode, format_report(visible)))
+    if mode == 'strict' and errors(diags):
+        raise PipelineValidationError(diags)
+    return diags
+
+
+def lint_intercept(pipeline):
+    """The ``BF_LINT=1`` hook: validate, report, optionally append a
+    JSON record to ``BF_LINT_OUT`` (one line per pipeline), and return
+    WITHOUT running — ``tools/bf_lint.py`` drives whole scripts this
+    way."""
+    try:
+        diags = verify_pipeline(pipeline)
+    except Exception as exc:
+        diags = [Diagnostic('BF-I199', 'verifier failed: %s' % exc)]
+    sys.stderr.write(
+        'bf_lint: pipeline %r: %d diagnostic(s)\n%s\n'
+        % (pipeline.name, len(diags),
+           format_report(diags) if diags else '  (clean)'))
+    out = os.environ.get('BF_LINT_OUT', '')
+    if out:
+        try:
+            with open(out, 'a') as f:
+                f.write(json.dumps({
+                    'pipeline': pipeline.name,
+                    'nblocks': len(pipeline.blocks),
+                    'diagnostics': [d.as_dict() for d in diags],
+                }, sort_keys=True) + '\n')
+        except OSError:
+            pass
+    return diags

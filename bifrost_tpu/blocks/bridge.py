@@ -97,6 +97,12 @@ class BridgeSink(_BridgeBlock):
         self.guarantee = guarantee
         self.address = address
         self.port = int(port)
+        # keep the REQUESTED values next to the clamped effective ones:
+        # the static verifier (bifrost_tpu.analysis.verify) flags
+        # nonsensical requests (window=0 -> BF-E150) that the clamps
+        # below would otherwise silently paper over
+        self.requested_window = window
+        self.requested_streams = nstreams
         self.nstreams = bridge_streams() if nstreams is None \
             else max(int(nstreams), 1)
         self.window = bridge_window() if window is None \

@@ -54,6 +54,15 @@ class CopyBlock(TransformBlock):
         batching and keep per-gulp granularity."""
         return 'tpu' in (self.irings[0].space, self.orings[0].space)
 
+    def verify_header(self, ihdr):
+        """Static-verification protocol (bifrost_tpu.analysis.verify):
+        a copy preserves the stream contract (the runtime on_sequence
+        additionally rewrites the ``_sharding`` advertisement, which
+        the static walk does not model)."""
+        ohdr = deepcopy(ihdr)
+        ohdr.pop('_sharding', None)
+        return ohdr
+
     def on_sequence(self, iseq):
         ohdr = deepcopy(iseq.header)
         self._h2d_taxis = None

@@ -42,6 +42,12 @@ class _StageBlock(TransformBlock):
         stage).  Non-equivariant stages fall back to K=1."""
         return bool(getattr(self._stage, 'batch_safe', False))
 
+    def verify_header(self, ihdr):
+        """Static-verification protocol (bifrost_tpu.analysis.verify):
+        run the stage's pure ``transform_header`` half so contract
+        breaks surface at submit time instead of gulp 0."""
+        return self._stage.transform_header(ihdr)
+
     def on_sequence(self, iseq):
         self._ihdr = iseq.header
         self._plans = {}

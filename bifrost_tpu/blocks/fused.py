@@ -41,6 +41,18 @@ class FusedBlock(TransformBlock):
     def define_valid_input_spaces(self):
         return ('tpu',)
 
+    def verify_header(self, ihdr):
+        """Static-verification protocol (bifrost_tpu.analysis.verify):
+        the output header this chain will advertise for ``ihdr``,
+        derived by running each stage's pure ``transform_header`` half.
+        A stage that rejects the stream contract (wrong dtype, missing
+        axis label, non-divisible shape) raises HERE at submit time
+        instead of in on_sequence at gulp 0."""
+        hdr = ihdr
+        for stage in self.stages:
+            hdr = stage.transform_header(hdr)
+        return hdr
+
     def macro_gulp_safe(self):
         """Macro-gulp eligible — including under a mesh: the K-gulp
         span shards over the mesh time axis exactly like a single gulp

@@ -26,7 +26,8 @@ import os
 __all__ = ['time_axis_name', 'station_axis_name', 'time_axis_size',
            'time_sharding', 'replicated_sharding', 'shardable_nframe',
            'shard_gulp', 'gather_local', 'sharding_descriptor',
-           'descriptor_matches', 'check_descriptor', 'frame_local_plan',
+           'descriptor_matches', 'meshes_equivalent',
+           'check_descriptor', 'frame_local_plan',
            'mesh_h2d_enabled', 'hlo_stats_enabled', 'collective_counts',
            'record_collectives']
 
@@ -98,6 +99,30 @@ def sharding_descriptor(mesh, taxis):
         'axis': time_axis_name(mesh),
         'nshards': int(time_axis_size(mesh)),
     }
+
+
+def meshes_equivalent(mesh_a, mesh_b):
+    """Whether two mesh scopes produce interchangeable ring-resident
+    gulp layouts: same axis-name/size table and the same time axis, so
+    a span committed under one is consumed by the other with zero
+    reshards.  ``None`` vs a real mesh is never equivalent (one side
+    commits single-device spans).  The static pipeline verifier
+    (bifrost_tpu.analysis.verify) uses this to predict
+    ``mesh.reshards > 0`` at submit time."""
+    if mesh_a is None or mesh_b is None:
+        return mesh_a is mesh_b
+    if mesh_a is mesh_b:
+        return True
+    try:
+        axes_a = {str(n): int(s) for n, s in zip(mesh_a.axis_names,
+                                                 mesh_a.devices.shape)}
+        axes_b = {str(n): int(s) for n, s in zip(mesh_b.axis_names,
+                                                 mesh_b.devices.shape)}
+        return (axes_a == axes_b and
+                time_axis_name(mesh_a) == time_axis_name(mesh_b) and
+                mesh_a.devices.tolist() == mesh_b.devices.tolist())
+    except Exception:
+        return False
 
 
 def descriptor_matches(desc, mesh, taxis):

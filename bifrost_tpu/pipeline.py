@@ -463,6 +463,20 @@ class Pipeline(BlockScope):
         from .supervision import Supervisor
         if self.auto_fuse:
             self._auto_fuse()
+        # lint mode (tools/bf_lint.py): validate the constructed graph,
+        # report, and return WITHOUT launching anything — scripts run
+        # end to end as pure topology builders
+        if os.environ.get('BF_LINT', '').strip() == '1':
+            from .analysis import verify as _verify
+            _verify.lint_intercept(self)
+            return
+        # static pipeline verifier (docs/analysis.md): BF_VALIDATE=warn
+        # (default) reports misconfigurations to stderr and the
+        # analysis/verify ProcLog; strict refuses to start on any BF-E
+        from .analysis import verify as _verify
+        _vmode = _verify.validate_mode()
+        if _vmode != 'off':
+            _verify.gate_run(self, _vmode)
         # device-space pipelines: create the jax backend client from
         # THIS thread first — the tunneled TPU plugin deadlocks when a
         # block (worker) thread triggers the first client init
@@ -480,6 +494,10 @@ class Pipeline(BlockScope):
         _spans.reconfigure()
         _spans.prune_dead_buffers()
         _slo.reset_budget()
+        # honor BF_RINGCHECK toggles between runs the same way
+        # (bifrost_tpu.analysis.ringcheck; docs/analysis.md)
+        from .analysis import ringcheck as _ringcheck
+        _ringcheck.reconfigure()
         self._shutting_down = False
         self.supervisor = Supervisor(self)
         self.threads = [threading.Thread(target=block.run, name=block.name)
@@ -525,6 +543,18 @@ class Pipeline(BlockScope):
             metrics.stop()               # publishes one final snapshot
             _spans.export_if_configured()
         self.supervisor.raise_if_failed()
+
+    def validate(self):
+        """Run the static pipeline verifier over the constructed
+        block/ring graph WITHOUT running anything and return the list
+        of :class:`~bifrost_tpu.analysis.verify.Diagnostic`
+        (stable-coded ``BF-Exxx``/``BF-Wxxx``/``BF-Ixxx`` findings —
+        docs/analysis.md has the catalog).  ``run()`` calls this
+        automatically per ``BF_VALIDATE={off,warn,strict}``; note that
+        auto-fusion (``auto_fuse``) rewrites the graph inside ``run``,
+        so a standalone ``validate()`` sees the pre-fusion topology."""
+        from .analysis import verify
+        return verify.verify_pipeline(self)
 
     def shutdown(self):
         self._shutting_down = True
@@ -1101,6 +1131,17 @@ class SourceBlock(Block):
 
     def define_valid_input_spaces(self):
         return []
+
+    def static_oheaders(self):
+        """Optional static-verification protocol (docs/analysis.md):
+        the output sequence headers this source WILL advertise, when
+        they are knowable without opening the source (a synthesized
+        stream, a format with a fixed layout).  Return a list with one
+        header dict per output ring, or None (the default) when the
+        headers only exist at read time — the verifier then reports
+        that propagation stops here instead of guessing.  Must have no
+        side effects; ``on_sequence`` remains the runtime authority."""
+        return None
 
     def create_reader(self, sourcename):
         raise NotImplementedError

@@ -54,6 +54,9 @@ from .header_standard import trace_context
 from .space import canonical
 from .ndarray import ndarray
 from .testing import faults
+# dynamic ring-protocol checker (BF_RINGCHECK=1; docs/analysis.md) —
+# every seam call below is one module-bool test when disarmed
+from .analysis import ringcheck as _ringcheck
 
 __all__ = ['Ring', 'RingWriter', 'WriteSequence', 'ReadSequence',
            'WriteSpan', 'ReadSpan', 'EndOfDataStop', 'WouldBlock',
@@ -638,11 +641,30 @@ class Ring(object):
             # native core's blocked readers) observe a terminal ring
             self._eod = True
             self._writing = False
+        from .telemetry import counters
+        counters.inc('ring_poisoned')
+        rc = _ringcheck.hook(self)
+        if rc is not None:
+            # snapshot the seam ops blocked in the core BEFORE waking:
+            # the checker's wake timer then proves poison released them
+            rc.poisoned_now()
+        if faults.armed('ring.corrupt.poison_nowake', self.name):
+            # deliberate protocol corruption (docs/analysis.md): leave
+            # blocked spans asleep so tests prove the checker's
+            # poison-wake invariant trips.  The test un-hangs its
+            # blocked thread afterwards by calling _wake_all directly.
+            return
+        self._wake_all()
+
+    def _wake_all(self):
+        """Wake every thread blocked on this ring's conditions (and, in
+        the native core, inside the C state machine) — the poison
+        wakeup path, split out so the poison_nowake corruption seam and
+        the tests exercising it can drive it directly."""
+        with self._lock:
             for cond in (self._read_cond, self._write_cond,
                          self._seq_cond, self._span_cond):
                 cond.notify_all()
-        from .telemetry import counters
-        counters.inc('ring_poisoned')
         self._wake_external()
 
     def _wake_external(self):
@@ -1005,6 +1027,20 @@ class Ring(object):
             return [f for f in self._pending_fills
                     if f.begin is not None and f.begin < limit]
 
+    # -- protocol-corruption hook (testing/faults.py; docs/analysis.md) ---
+    def _corrupt_guarantee_jump(self, rseq):
+        """Deliberately force ``rseq``'s guarantee forward to the head
+        while it may still hold open spans — reproducing the pre-PR-5
+        watermark bug so tests prove the ring-protocol checker
+        (BF_RINGCHECK=1) catches the overwriting reserve it admits.
+        Only ever called from the ``ring.corrupt.guarantee_jump`` fault
+        seam; overridden by NativeRing to corrupt the C core."""
+        with self._lock:
+            if id(rseq) in self._guarantees:
+                self._guarantees[id(rseq)] = self._head
+            self._open_reads.pop(id(rseq), None)
+            self._write_cond.notify_all()
+
     # -- device-chunk donation hook ---------------------------------------
     def _take_exclusive(self, begin, nbyte, allow_parts=False):
         """Claim the committed device chunk covering exactly
@@ -1182,6 +1218,9 @@ class ReadSequence(_SequenceAPI):
         self.header_transform = header_transform
         self._seq = ring._open_seq(which, name=name, time_tag=time_tag)
         ring._register_reader(self)
+        rc = _ringcheck.hook(ring)
+        if rc is not None:
+            rc.reader_opened(self)
 
     def __enter__(self):
         return self
@@ -1191,6 +1230,9 @@ class ReadSequence(_SequenceAPI):
 
     def close(self):
         self._ring._close_read_seq(self)
+        rc = _ringcheck.hook(self._ring)
+        if rc is not None:
+            rc.reader_closed(self)
 
     def increment(self):
         """Move to the next sequence (reference: ring2.py:293-298)."""
@@ -1198,6 +1240,9 @@ class ReadSequence(_SequenceAPI):
         self._seq = nxt
         self._tensor = None
         self._ring._reader_moved(self, nxt)
+        rc = _ringcheck.hook(self._ring)
+        if rc is not None:
+            rc.reader_moved(self, nxt.begin)
 
     @property
     def header(self):
@@ -1347,10 +1392,23 @@ class WriteSpan(_SpanAPI):
         # flow control (covers BOTH cores — the native reserve happens
         # inside this call)
         _, hist, spans_ = _observability()[:3]
+        # ring-protocol checker seam (both cores): track the blocking
+        # reserve and validate the granted span against the shadow
+        # guarantees (BF_RINGCHECK=1; docs/analysis.md)
+        rc = _ringcheck.hook(ring)
+        rc_tok = rc.reserve_enter(self._nbyte) if rc is not None else None
         t0 = time.perf_counter()
-        self._begin = ring._reserve_span(self._nbyte, nonblocking,
-                                         span=self)
+        try:
+            self._begin = ring._reserve_span(self._nbyte, nonblocking,
+                                             span=self)
+        except BaseException:
+            if rc is not None:
+                rc.reserve_abort(rc_tok)
+            raise
         dt = time.perf_counter() - t0
+        if rc is not None:
+            rc.reserve_done(rc_tok, self, self._begin, self._nbyte,
+                            ring.total_span)
         if ring._h_reserve is None:
             ring._h_reserve = hist.get_or_create(
                 'ring.%s.reserve_s' % ring.name, unit='s')
@@ -1443,7 +1501,19 @@ class WriteSpan(_SpanAPI):
             elif commit_nbyte:
                 self._ring._storage.commit_ghost(self._begin,
                                                  commit_nbyte)
+        # protocol checker seam BEFORE the core commit: an illegal
+        # commit (double / out-of-order partial) is caught before it
+        # can corrupt core state (BF_RINGCHECK=1)
+        rc = _ringcheck.hook(self._ring)
+        if rc is not None:
+            rc.commit(self, commit_nbyte)
         self._ring._commit_span(self, commit_nbyte)
+        if faults.armed('ring.corrupt.double_commit', self._ring.name):
+            # deliberate corruption: commit the same span AGAIN — the
+            # checker (when armed) raises before the core sees it
+            if rc is not None:
+                rc.commit(self, commit_nbyte)
+            self._ring._commit_span(self, commit_nbyte)
 
     def _finalize_storage(self, commit_nbyte):
         # called under ring lock once this commit lands in order
@@ -1472,10 +1542,38 @@ class ReadSpan(_SpanAPI):
         # ring-wait observability: reader blocked-time in flow control
         # (both cores — the native acquire happens inside this call)
         _, hist, spans_ = _observability()[:3]
+        # ring-protocol checker seam (both cores): track the blocking
+        # acquire and validate the granted span against the shadow
+        # committed head (BF_RINGCHECK=1; docs/analysis.md)
+        rc = _ringcheck.hook(self._ring)
+        rc_tok = rc.acquire_enter(
+            sequence, sequence._seq.begin + frame_offset * fb) \
+            if rc is not None else None
         t0 = time.perf_counter()
-        begin, nbyte = self._ring._acquire_span(
-            sequence, frame_offset * fb, nframe * fb, fb)
+        try:
+            begin, nbyte = self._ring._acquire_span(
+                sequence, frame_offset * fb, nframe * fb, fb)
+        except BaseException:
+            if rc is not None:
+                rc.acquire_abort(rc_tok)
+            raise
         dt = time.perf_counter() - t0
+        if rc is not None:
+            rc_nbyte = nbyte
+            if faults.armed('ring.corrupt.acquire_uncommitted',
+                            self._ring.name):
+                # deliberate corruption: report a span extending one
+                # frame past what the core returned, simulating a core
+                # that hands out frames no commit ever published
+                rc_nbyte = nbyte + fb
+            rc.acquire_done(rc_tok, sequence, begin, rc_nbyte)
+        if faults.armed('ring.corrupt.guarantee_jump',
+                        self._ring.name):
+            # deliberate corruption: jump this reader's CORE guarantee
+            # to the head while this span is still open (the pre-PR-5
+            # watermark bug) — the checker catches the overwriting
+            # reserve the core now admits
+            self._ring._corrupt_guarantee_jump(sequence)
         ring = self._ring
         if ring._h_acquire is None:
             ring._h_acquire = hist.get_or_create(
@@ -1497,6 +1595,8 @@ class ReadSpan(_SpanAPI):
                     f.wait()
                 self._ring._storage.refresh_ghost(begin, nbyte)
             except BaseException:
+                if rc is not None:
+                    rc.release(sequence, begin)
                 self._ring._release_span(sequence, begin)
                 raise
         self._data = None
@@ -1558,4 +1658,16 @@ class ReadSpan(_SpanAPI):
         self.release()
 
     def release(self):
+        # protocol checker seam BEFORE the core release: a double
+        # release is caught before it can unbalance core accounting
+        rc = _ringcheck.hook(self._ring)
+        if rc is not None:
+            rc.release(self._sequence, self._begin)
         self._ring._release_span(self._sequence, self._begin)
+        if faults.armed('ring.corrupt.double_release',
+                        self._ring.name):
+            # deliberate corruption: release the same span AGAIN — the
+            # checker (when armed) raises before the core sees it
+            if rc is not None:
+                rc.release(self._sequence, self._begin)
+            self._ring._release_span(self._sequence, self._begin)

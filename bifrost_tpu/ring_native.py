@@ -272,6 +272,26 @@ class NativeRing(Ring):
         except Exception:
             pass
 
+    # -- protocol-corruption hook (testing/faults.py; docs/analysis.md) ---
+    def _corrupt_guarantee_jump(self, rseq):
+        """Deliberately force ``rseq``'s guarantee in the C core forward
+        to the head while it may still hold open spans (mode 2 = force
+        past open spans) — the native-core arm of the
+        ``ring.corrupt.guarantee_jump`` fault seam, so tests prove the
+        ring-protocol checker catches the overwriting reserve the
+        corrupted core then admits."""
+        rid = getattr(rseq, '_native_reader_id', None)
+        if rid is None:
+            return
+        head = ctypes.c_longlong()
+        try:
+            native.check(self._lib.bft_ring_tail_head(
+                self._handle, None, ctypes.byref(head)))
+            self._lib.bft_reader_set_guarantee(self._handle, rid,
+                                               head.value, 2)
+        except Exception:
+            pass
+
     # -- writer side ------------------------------------------------------
     def _begin_writing(self):
         with self._lock:

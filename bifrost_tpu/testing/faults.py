@@ -25,6 +25,24 @@ Seams wired into the framework (site names are stable API):
 - ``xfer.result``      transfer-future completion (deferred D2H fills
                        fail HERE, exercising the ring-poison path)
 
+**Protocol-corruption seams** (consumed via :func:`armed`, which
+returns True instead of raising): these deliberately violate the ring
+protocol so tests can prove the dynamic ring-protocol checker
+(``bifrost_tpu.analysis.ringcheck``, ``BF_RINGCHECK=1``) catches each
+violation class in BOTH ring cores — see docs/analysis.md:
+
+- ``ring.corrupt.double_commit``   commit the same write span twice
+- ``ring.corrupt.double_release``  release the same read span twice
+- ``ring.corrupt.acquire_uncommitted``  report an acquired span
+                       extending past the committed head (simulates a
+                       core handing out unpublished frames)
+- ``ring.corrupt.guarantee_jump``  force a guaranteed reader's core
+                       guarantee forward to the head while it holds an
+                       open span (the pre-PR-5 watermark bug)
+- ``ring.corrupt.poison_nowake``   poison the ring WITHOUT waking
+                       blocked spans (suppresses the condition
+                       notifies / native wakeup)
+
 A fault fires ``count`` times after skipping its first ``after``
 matching calls; ``delay`` seconds of sleep are injected before the
 exception (a delay with ``exc=None`` makes a pure stall, which is how
@@ -39,7 +57,7 @@ import threading
 import time
 
 __all__ = ['FaultInjected', 'inject', 'injected', 'clear', 'fire',
-           'fired', 'arm_from_env', 'active']
+           'fired', 'arm_from_env', 'active', 'armed']
 
 
 class FaultInjected(RuntimeError):
@@ -154,16 +172,10 @@ def fired(site=None):
                    if site is None or f.site == site)
 
 
-def fire(site, name=''):
-    """Seam hook: fire the first matching armed fault.
-
-    No-op (one boolean test) when nothing is armed.  Called by the
-    framework at the sites documented in the module docstring; custom
-    blocks may call it at their own seams too.
-    """
-    if not _active:
-        return
-    hit = None
+def _consume(site, name):
+    """Consume and return the first armed fault matching (site, name),
+    or None — the one place the site/match/after/count bookkeeping
+    lives (both :func:`fire` and :func:`armed` go through it)."""
     with _lock:
         for f in _faults:
             if f.site != site or f.match not in (name or ''):
@@ -174,8 +186,20 @@ def fire(site, name=''):
             if f.fired >= f.count:
                 continue
             f.fired += 1
-            hit = f
-            break
+            return f
+    return None
+
+
+def fire(site, name=''):
+    """Seam hook: fire the first matching armed fault.
+
+    No-op (one boolean test) when nothing is armed.  Called by the
+    framework at the sites documented in the module docstring; custom
+    blocks may call it at their own seams too.
+    """
+    if not _active:
+        return
+    hit = _consume(site, name)
     if hit is None:
         return
     if hit.delay > 0:
@@ -183,6 +207,18 @@ def fire(site, name=''):
     exc = hit._make_exc(site, name)
     if exc is not None:
         raise exc
+
+
+def armed(site, name=''):
+    """Corruption-seam hook: consume the first matching armed fault and
+    return True, WITHOUT raising — the seam then performs its
+    deliberate protocol violation itself.  No-op (False, one boolean
+    test) when nothing is armed.  Count/after/match semantics are
+    identical to :func:`fire` (shared :func:`_consume`); ``delay`` and
+    ``exc`` are ignored."""
+    if not _active:
+        return False
+    return _consume(site, name) is not None
 
 
 def arm_from_env(env=None):

@@ -90,8 +90,13 @@ def main():
 
     with bf.Pipeline() as p:
         src = ToneSource()
-        b = bf.blocks.copy(src, space='tpu')
         with bf.block_scope(mesh=mesh):
+            # the H2D copy lives INSIDE the mesh scope so gulps land
+            # on the devices already sharded (sharded H2D placement,
+            # docs/parallel.md) — outside it, every gulp would commit
+            # single-device and the fused block would pay a per-gulp
+            # reshard (the static verifier flags that as BF-W140)
+            b = bf.blocks.copy(src, space='tpu')
             # every gulp of this chain runs sharded over all devices
             b = bf.blocks.fused(b, [
                 FftStage('fine_time', axis_labels='freq'),

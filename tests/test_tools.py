@@ -193,3 +193,31 @@ def test_proclog_throttling(tmp_path, monkeypatch):
     log.update({'n': 3}, force=True)
     assert 'n : 3' in open(log.path).read()
     monkeypatch.setattr(plmod.ProcLog, 'MIN_INTERVAL', None)
+
+
+def test_lint_envvars_invariant():
+    """Repo invariant: every BF_* env var read in bifrost_tpu/ is
+    documented in docs/envvars.md and every documented var is read
+    somewhere (tools/lint_envvars.py; exit 3 on violations)."""
+    res = _tool('lint_envvars.py')
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert '0 undocumented, 0 phantom' in res.stdout
+
+
+def test_bf_lint_script_mode():
+    """bf_lint lints an example script without running its pipeline
+    and exits 0 under --strict when the topology is clean."""
+    res = _tool('bf_lint.py', '--strict',
+                os.path.join(os.path.dirname(TOOLS),
+                             'examples', 'your_first_block.py'))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert 'BF-E' not in res.stdout
+
+
+def test_bf_lint_codes_catalog():
+    """--codes prints the stable diagnostic catalog used by
+    docs/analysis.md."""
+    res = _tool('bf_lint.py', '--codes')
+    assert res.returncode == 0, res.stderr
+    for code in ('BF-E101', 'BF-E121', 'BF-E130', 'BF-W140', 'BF-E150'):
+        assert code in res.stdout, code

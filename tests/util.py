@@ -41,6 +41,11 @@ class NumpySourceBlock(SourceBlock):
     def create_reader(self, sourcename):
         return _NumpyReader(self._gulps)
 
+    def static_oheaders(self):
+        # static-verification protocol (bifrost_tpu.analysis.verify):
+        # the header is fixed at construction, so advertise it
+        return [dict(self._header)]
+
     def on_sequence(self, reader, sourcename):
         return [dict(self._header)]
 

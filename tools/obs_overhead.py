@@ -34,6 +34,44 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: per-gulp metric the gate compares (bench_xfer_overlap output)
 METRIC = 'async_ms_per_gulp'
 
+#: per-gulp metric of the ringcheck arm (the timed config-8 chain —
+#: the ring-protocol checker's seams live on the ring span path, which
+#: bench_xfer_overlap's raw engine loop never touches)
+CHAIN_METRIC = 'chain_ms_per_gulp'
+
+_CHAIN_SNIPPET = (
+    "import json, sys; sys.path.insert(0, %r); "
+    "from bench_suite import _timed_config8_chain as t; "
+    "n = 48; dt = t(ngulp=n); "
+    "print(json.dumps({'chain_ms_per_gulp': dt / n * 1e3}))" % ROOT)
+
+
+def run_chain(ringcheck, timeout=1800):
+    """One timed config-8 chain run through a REAL pipeline
+    (bench_suite._timed_config8_chain) with the ring-protocol checker
+    armed or not — the measurement arm for ``--stack ringcheck``."""
+    env = dict(os.environ)
+    for knob in ('BF_TRACE_FILE', 'BF_TRACE', 'BF_WATCHDOG_SECS',
+                 'BF_WATCHDOG_ESCALATE', 'BF_METRICS_FILE',
+                 'BF_SLO_MS', 'BF_JAX_PROFILE', 'BF_RINGCHECK'):
+        env.pop(knob, None)
+    if ringcheck:
+        env['BF_RINGCHECK'] = '1'
+    out = subprocess.run([sys.executable, '-c', _CHAIN_SNIPPET],
+                         capture_output=True, text=True, env=env,
+                         cwd=ROOT, timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and CHAIN_METRIC in d:
+            return d
+    raise RuntimeError(
+        'timed chain produced no %s result (rc=%d):\n%s\n%s'
+        % (CHAIN_METRIC, out.returncode, out.stdout[-1000:],
+           out.stderr[-1000:]))
+
 
 def run_config8(trace_file=None, timeout=1800, full_stack=False):
     """One bench_suite --config 8 subprocess; returns its result dict.
@@ -80,52 +118,68 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--out', default='BENCH_OBS.json',
                     help='artifact path (all samples + verdict)')
-    ap.add_argument('--threshold', type=float, default=5.0,
-                    help='max allowed regression in percent')
+    ap.add_argument('--threshold', type=float, default=None,
+                    help='max allowed regression in percent (default '
+                         '5; --stack ringcheck defaults to 50 — a '
+                         'debug tool gets a generous, but still '
+                         'measured and recorded, bound)')
     ap.add_argument('--reps', type=int, default=4,
                     help='interleaved repetitions per arm '
                          '(minima are compared; order alternates)')
     ap.add_argument('--timeout', type=float, default=1800.0,
                     help='per-run bench timeout in seconds')
-    ap.add_argument('--stack', choices=('spans', 'full'),
+    ap.add_argument('--stack', choices=('spans', 'full', 'ringcheck'),
                     default='spans',
                     help="what the traced arm enables: 'spans' (the "
-                         "classic PR-3 gate) or 'full' (spans + "
+                         "classic PR-3 gate), 'full' (spans + "
                          "trace-context stamping + BF_SLO_MS "
                          "tracking; baseline arm runs "
-                         "BF_TRACE_CONTEXT=0).  The chain-level "
+                         "BF_TRACE_CONTEXT=0), or 'ringcheck' (the "
+                         "dynamic ring-protocol checker BF_RINGCHECK=1 "
+                         "on the timed config-8 PIPELINE chain, whose "
+                         "ring spans are where the checker's seams "
+                         "live — docs/analysis.md).  The chain-level "
                          "full-stack bar lives in tools/e2e_gate.py; "
-                         "this mode bounds the same knobs on the "
+                         "'spans'/'full' bound the same knobs on the "
                          "config-8 transfer loop.")
     args = ap.parse_args()
+    if args.threshold is None:
+        args.threshold = 50.0 if args.stack == 'ringcheck' else 5.0
 
     trace_tmp = os.path.join(tempfile.mkdtemp(prefix='bf_obs_gate_'),
                              'trace.json')
     full = args.stack == 'full'
+    ringcheck = args.stack == 'ringcheck'
+    metric = CHAIN_METRIC if ringcheck else METRIC
     base_runs, traced_runs = [], []
     try:
         for rep in range(max(args.reps, 1)):
-            order = [(base_runs, None), (traced_runs, trace_tmp)]
+            order = [(base_runs, False), (traced_runs, True)]
             if rep % 2:
                 order.reverse()
-            for runs, tf in order:
-                runs.append(run_config8(tf, timeout=args.timeout,
-                                        full_stack=full))
+            for runs, armed in order:
+                if ringcheck:
+                    runs.append(run_chain(armed,
+                                          timeout=args.timeout))
+                else:
+                    runs.append(run_config8(
+                        trace_tmp if armed else None,
+                        timeout=args.timeout, full_stack=full))
     except (RuntimeError, subprocess.TimeoutExpired) as exc:
         print('obs_overhead: bench arm failed: %s' % exc,
               file=sys.stderr)
         return 2
 
-    b = min(float(r[METRIC]) for r in base_runs)
-    t = min(float(r[METRIC]) for r in traced_runs)
+    b = min(float(r[metric]) for r in base_runs)
+    t = min(float(r[metric]) for r in traced_runs)
     overhead_pct = (t / b - 1.0) * 100.0 if b > 0 else 0.0
     ok = overhead_pct < args.threshold
     artifact = {
-        'metric': METRIC,
+        'metric': metric,
         'stack': args.stack,
         'reps': len(base_runs),
-        'spans_disabled_ms': [float(r[METRIC]) for r in base_runs],
-        'spans_enabled_ms': [float(r[METRIC]) for r in traced_runs],
+        'spans_disabled_ms': [float(r[metric]) for r in base_runs],
+        'spans_enabled_ms': [float(r[metric]) for r in traced_runs],
         'spans_disabled': base_runs[-1],
         'spans_enabled': traced_runs[-1],
         'min_disabled_ms': b,
@@ -141,7 +195,7 @@ def main():
         f.write('\n')
     print('obs_overhead: %s min-of-%d: %.3fms off / %.3fms on -> '
           '%+.2f%% (threshold %.1f%%) %s'
-          % (METRIC, len(base_runs), b, t, overhead_pct,
+          % (metric, len(base_runs), b, t, overhead_pct,
              args.threshold, 'PASS' if ok else 'FAIL'))
     return 0 if ok else 3
 

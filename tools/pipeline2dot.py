@@ -23,9 +23,15 @@ Annotations matching the reference's information set:
     is visible in the graph, not disguised as an ordinary block
   * dotted bidirectional association edges between blocks bound to the
     same core (reference: pipeline2dot.py:188-219)
+  * static-verifier diagnostics (bifrost_tpu.analysis.verify, published
+    to the ``analysis/verify`` ProcLog by BF_VALIDATE=warn|strict)
+    overlaid on the graph: rings/edges carrying a BF-E render red,
+    BF-W amber, with the code + message as the node/edge tooltip — the
+    bottleneck map doubles as a config-review map (docs/analysis.md)
 """
 
 import argparse
+import json
 import os
 import re
 import sys
@@ -201,11 +207,63 @@ def flow_label(flow):
     return '\\n'.join(parts)
 
 
+def verifier_diags(contents):
+    """Diagnostics published to the ``analysis/verify`` ProcLog
+    (bifrost_tpu.analysis.verify.publish_diagnostics): two maps,
+    {block_name: [diag]} and {ring_name: [diag]}."""
+    by_block, by_ring = {}, {}
+    for block, logs in contents.items():
+        if block.replace(os.sep, '/') != 'analysis':
+            continue
+        entry = logs.get('verify', {})
+        diag_keys = (k for k in entry
+                     if k.startswith('diag') and k[4:].isdigit())
+        for key in sorted(diag_keys, key=lambda k: int(k[4:])):
+            try:
+                d = json.loads(str(entry[key]))
+            except (ValueError, TypeError):
+                continue
+            if not isinstance(d, dict) or 'code' not in d:
+                continue
+            if d.get('block'):
+                by_block.setdefault(str(d['block']), []).append(d)
+            if d.get('ring'):
+                by_ring.setdefault(str(d['ring']), []).append(d)
+    return by_block, by_ring
+
+
+#: severity -> (edge/border color, node fill) for the diagnostic
+#: overlay; errors dominate warnings, info is not rendered
+_DIAG_STYLE = {'error': ('red', 'lightsalmon'),
+               'warning': ('orange2', 'navajowhite')}
+
+
+def _diag_overlay(diags):
+    """(color, fill, tooltip) for a node/edge carrying ``diags``, or
+    None when only info-level findings are present."""
+    worst = None
+    for d in diags:
+        sev = d.get('severity')
+        if sev == 'error':
+            worst = 'error'
+            break
+        if sev == 'warning':
+            worst = 'warning'
+    if worst is None:
+        return None
+    color, fill = _DIAG_STYLE[worst]
+    tooltip = ' | '.join(
+        '%s: %s' % (d.get('code'), d.get('message'))
+        for d in diags if d.get('severity') != 'info')
+    return color, fill, tooltip.replace('"', "'")
+
+
 def to_dot(pid, contents, associations=True):
     flows, sources, sinks = get_data_flows(contents)
     geometry = ring_geometry(contents)
     ring_flows = ring_flow(contents)
     bridges = bridge_info(contents)
+    diag_blocks, diag_rings = verifier_diags(contents)
     cmd = get_command_line(pid)
     if cmd.startswith('python'):
         cmd = cmd.split(None, 1)[-1]
@@ -236,22 +294,45 @@ def to_dot(pid, contents, associations=True):
         else:
             shape = 'ellipse' if block in sources else \
                 'diamond' if block in sinks else 'box'
-            lines.append('  "%s" [label="%s\\n%s" shape="%s" '
-                         'style=filled fillcolor=lightsteelblue];'
-                         % (block, block, cpu, shape))
+            overlay = _diag_overlay(diag_blocks.get(block, ()))
+            if overlay is not None:
+                # verifier finding on this block: tinted fill + a
+                # colored border, tooltip carries code + message
+                color, fill, tip = overlay
+                lines.append('  "%s" [label="%s\\n%s" shape="%s" '
+                             'style=filled fillcolor=%s color=%s '
+                             'penwidth=2 tooltip="%s"];'
+                             % (block, block, cpu, shape, fill,
+                                color, tip))
+            else:
+                lines.append('  "%s" [label="%s\\n%s" shape="%s" '
+                             'style=filled fillcolor=lightsteelblue];'
+                             % (block, block, cpu, shape))
         # sequence proclogs record the block's INPUT header
         # (pipeline.py MultiTransformBlock.main), so the dtype label
         # belongs on the input edges only
         dtype = stream_dtype(logs)
-        label = ' [label="%s"]' % dtype if dtype else ''
+
+        def edge_attrs(r, label):
+            attrs = []
+            if label:
+                attrs.append('label="%s"' % label)
+            overlay = _diag_overlay(diag_rings.get(str(r), ()))
+            if overlay is not None:
+                color, _fill, tip = overlay
+                attrs.append('color=%s penwidth=2 tooltip="%s"'
+                             % (color, tip))
+            return ' [%s]' % ' '.join(attrs) if attrs else ''
+
         for r in ins:
             rings.add(r)
-            lines.append('  "ring:%s" -> "%s"%s;' % (r, block, label))
+            lines.append('  "ring:%s" -> "%s"%s;'
+                         % (r, block, edge_attrs(r, dtype or '')))
         for r in outs:
             rings.add(r)
             fl = flow_label(ring_flows.get(str(r), {}))
-            flabel = ' [label="%s"]' % fl if fl else ''
-            lines.append('  "%s" -> "ring:%s"%s;' % (block, r, flabel))
+            lines.append('  "%s" -> "ring:%s"%s;'
+                         % (block, r, edge_attrs(r, fl)))
     for r in sorted(rings):
         dtl = geometry.get(str(r), {})
         if 'stride' in dtl:

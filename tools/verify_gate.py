@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""Verify gate: run the static pipeline verifier over every
+pipeline-shaped bench_suite config and every examples/ pipeline
+(docs/analysis.md), via tools/bf_lint.py.
+
+    python tools/verify_gate.py [--out VERIFY_GATE.json] [--strict]
+
+For each registered bench topology (``bench_suite.
+build_verify_topologies``: the config 8/9/10/11/12 chains) a
+subprocess lints the build-only pipeline graph; each example script
+runs under ``BF_LINT=1`` so its ``Pipeline.run()`` validates and
+returns without executing.  The mesh topology gets an 8-device host
+platform (``--xla_force_host_platform_device_count``), matching
+tools/mesh_gate.py.
+
+Verdict: PASS when every target lints with **zero BF-E errors**
+(warnings are reported but advisory — the per-code strictness belongs
+to bf_lint --strict on individual targets).  A target that cannot be
+linted at all counts as a failure.
+
+Exit codes match tools/telemetry_diff.py's convention: 0 = pass (or
+advisory mode), 3 = ``--strict`` and errors / unlintable targets, 2 =
+the gate itself could not run.  ``tools/watch_and_bench.sh`` runs the
+strict mode after a successful bench capture; ``BF_SKIP_VERIFY_GATE=1``
+opts out.
+"""
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BF_LINT = os.path.join(ROOT, 'tools', 'bf_lint.py')
+
+#: per-example extra argv (scripts that print usage and exit without
+#: arguments)
+EXAMPLE_ARGS = {'gpuspec_simple.py': ['--demo']}
+
+#: examples with no Pipeline to lint would be failures, none today —
+#: keep the hook for future scripts that are pure libraries
+EXAMPLE_SKIP = ()
+
+
+def run_lint(argv, env=None, timeout=600):
+    e = dict(os.environ)
+    e.setdefault('JAX_PLATFORMS', 'cpu')
+    if env:
+        e.update(env)
+    proc = subprocess.run([sys.executable, BF_LINT] + argv,
+                          capture_output=True, text=True, env=e,
+                          cwd=ROOT, timeout=timeout)
+    return proc
+
+
+def parse_summary(stdout):
+    """(pipelines, errors, warnings) from bf_lint's summary line."""
+    for line in stdout.splitlines():
+        if line.startswith('bf_lint:') and 'error(s)' in line:
+            words = line.split()
+            try:
+                ip = words.index('pipeline(s),')
+                return (int(words[ip - 1]), int(words[ip + 1]),
+                        int(words[ip + 3]))
+            except (ValueError, IndexError):
+                pass
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default='VERIFY_GATE.json',
+                    help='verdict artifact path')
+    ap.add_argument('--strict', action='store_true',
+                    help='exit 3 on any BF-E / unlintable target '
+                         '(default: advisory, exit 0)')
+    ap.add_argument('--timeout', type=float, default=600.0)
+    args = ap.parse_args()
+
+    if os.environ.get('BF_SKIP_VERIFY_GATE', '0') == '1':
+        print('verify_gate: skipped (BF_SKIP_VERIFY_GATE=1)')
+        return 0
+
+    targets = []
+    # bench topologies (in a subprocess each: the mesh one needs its
+    # own XLA host-platform device count, set before jax imports)
+    sys.path.insert(0, ROOT)
+    try:
+        import bench_suite
+        topo_names = sorted(bench_suite.build_verify_topologies())
+    except Exception as exc:
+        print('verify_gate: cannot enumerate bench topologies: %s'
+              % exc, file=sys.stderr)
+        return 2
+    for name in topo_names:
+        env = {}
+        if 'mesh' in name:
+            env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+        targets.append(('bench:%s' % name, ['--topology', name], env))
+    for path in sorted(glob.glob(os.path.join(ROOT, 'examples',
+                                              '*.py'))):
+        base = os.path.basename(path)
+        if base in EXAMPLE_SKIP:
+            continue
+        argv = [os.path.join('examples', base)] + \
+            EXAMPLE_ARGS.get(base, [])
+        targets.append(('example:%s' % base, argv, {}))
+
+    results = []
+    total_err = unlintable = 0
+    for label, argv, env in targets:
+        try:
+            proc = run_lint(argv, env=env, timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            results.append({'target': label, 'ok': False,
+                            'error': 'timeout'})
+            unlintable += 1
+            print('verify_gate: %-28s TIMEOUT' % label)
+            continue
+        summary = parse_summary(proc.stdout)
+        if proc.returncode != 0 or summary is None:
+            # rc 0 with no summary = an explicitly skipped topology
+            if proc.returncode == 0 and 'skipped' in proc.stdout:
+                results.append({'target': label, 'ok': True,
+                                'skipped': True})
+                print('verify_gate: %-28s skipped' % label)
+                continue
+            results.append({'target': label, 'ok': False,
+                            'error': 'unlintable (rc=%d)'
+                                     % proc.returncode,
+                            'stderr': proc.stderr[-1000:]})
+            unlintable += 1
+            print('verify_gate: %-28s UNLINTABLE (rc=%d)'
+                  % (label, proc.returncode))
+            continue
+        np_, ne, nw = summary
+        total_err += ne
+        results.append({'target': label, 'ok': ne == 0,
+                        'pipelines': np_, 'errors': ne,
+                        'warnings': nw})
+        print('verify_gate: %-28s %d pipeline(s)  %d error(s)  '
+              '%d warning(s)' % (label, np_, ne, nw))
+        if ne or nw:
+            for line in proc.stdout.splitlines():
+                if line.startswith('BF-'):
+                    print('    ' + line)
+
+    ok = total_err == 0 and unlintable == 0
+    artifact = {
+        'targets': results,
+        'total_errors': total_err,
+        'unlintable': unlintable,
+        'pass': ok,
+        'round': os.environ.get('BF_BENCH_ROUND', ''),
+    }
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write('\n')
+    print('verify_gate: %s — %d target(s), %d error(s), %d '
+          'unlintable -> %s'
+          % ('PASS' if ok else 'FAIL', len(targets), total_err,
+             unlintable, args.out))
+    if not ok and args.strict:
+        return 3
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

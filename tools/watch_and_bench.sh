@@ -26,6 +26,10 @@
 #                               8-device host mesh: sharded arm matches
 #                               single-device, zero-reshard plans;
 #                               tools/mesh_gate.py)
+#   VERIFY_GATE_${ROUND}.json - static verify gate (every pipeline-shaped
+#                               bench topology + every example linted by
+#                               the pipeline verifier; tools/verify_gate.py,
+#                               strict: any BF-E fails the round up front)
 #   bench_watch.log           - probe/attempt history (gitignored)
 cd "$(dirname "$0")/.." || exit 1
 ROUND="${BF_BENCH_ROUND:-r$(date -u +%Y%m%d)}"
@@ -54,6 +58,21 @@ if [ "${BF_SKIP_T1_GATE:-0}" != "1" ]; then
   if [ "$t1rc" -eq 124 ] || [ "$t1rc" -eq 137 ]; then
     echo "$(date -u +%FT%TZ) tier-1 HUNG past the watchdog timeout - failing fast" >> "$LOG"
     exit "$t1rc"
+  fi
+fi
+# Static verify gate: lint every pipeline-shaped bench topology and
+# every example with the pipeline verifier (tools/verify_gate.py ->
+# tools/bf_lint.py).  Purely static — runs before the TPU probe loop
+# so a misconfigured topology fails the round in seconds, not after a
+# full capture.  BF_SKIP_VERIFY_GATE=1 opts out.
+if [ "${BF_SKIP_VERIFY_GATE:-0}" != "1" ]; then
+  echo "$(date -u +%FT%TZ) static verify gate (bench topologies + examples)" >> "$LOG"
+  python tools/verify_gate.py --strict --out "VERIFY_GATE_${ROUND}.json" >> "$LOG" 2>&1
+  vrc=$?
+  echo "$(date -u +%FT%TZ) verify gate rc=$vrc" >> "$LOG"
+  if [ "$vrc" -ne 0 ]; then
+    echo "$(date -u +%FT%TZ) static verify gate FAILED" >> "$LOG"
+    exit "$vrc"
   fi
 fi
 for i in $(seq 1 400); do
