@@ -1598,6 +1598,154 @@ def bench_pipeline_vs_serial(msps_pipe=None):
     }
 
 
+# ---------------------------------------------------------------------------
+# config 13: quantized coherent-beamformer chain (the beamform engine
+# flagship — ops/beamform.py; gated by tools/beam_gate.py into
+# BENCH_BEAM_${ROUND}.json)
+# ---------------------------------------------------------------------------
+
+def bench_beamform_chain(reps=3, ngulp=12):
+    """End-to-end coherent-beamforming workload: ci8 capture source ->
+    H2D (the "unpack" is the device rep itself: int8 (re, im) planes,
+    no f32 voltages ever materialize in HBM) -> BeamformBlock ->
+    fused Stokes-detect -> time-integrate -> D2H -> sink, at a scaled
+    GPU-beamformer geometry (arXiv:1412.4907's LWA-style station
+    count): Nstand=256, Npol=2, Nbeam=128, Nchan=64, 32-frame gulps.
+
+    Arms (per-arm MINIMA over ``reps`` repetitions, arm order
+    alternating between repetitions — the config-9 noise policy):
+
+    - ``f32``   — the engine forced to the XLA complex64 baseline
+      (the exactness reference every candidate gates against);
+    - ``quant`` — ``accuracy='int8'`` with measured selection forced
+      on: the accuracy gate + race pick the fastest candidate the
+      class admits ON THIS HOST (the widened-int8 / fused Pallas
+      kernels on MXU hosts; on the CPU gate host XLA's int8 lowering
+      is slower than its f32 GEMM, so the race correctly lands on the
+      single-pass bf16 plane GEMM — measured, never asserted).
+
+    Outputs are tolerance-compared at the declared class bound
+    (BEAM_CLASSES['int8']) and the quant arm must be run-to-run
+    byte-identical; the published ops/s-per-chip row counts the
+    beamform GEMM's real ops (8 per complex MAC) over the arm's min
+    wall time (docs/perf.md "Quantized coherent beamformer").
+    """
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests'))
+    import jax
+    import bifrost_tpu as bf
+    from bifrost_tpu.ops.beamform import BEAM_CLASSES
+    from bifrost_tpu.stages import DetectStage, ReduceStage
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    bf.enable_compilation_cache()
+    NT, NF, NS, NP, NB, RF = 32, 64, 256, 2, 128, 8
+    rng = np.random.RandomState(13)
+    raw = np.zeros((NT, NF, NS, NP), dtype=np.dtype([('re', 'i1'),
+                                                     ('im', 'i1')]))
+    raw['re'] = rng.randint(-64, 64, raw.shape)
+    raw['im'] = rng.randint(-64, 64, raw.shape)
+    w = (rng.randn(NP, NB, NS) +
+         1j * rng.randn(NP, NB, NS)).astype(np.complex64) / NS
+    hdr = simple_header([-1, NF, NS, NP], 'ci8',
+                        labels=['time', 'freq', 'station', 'pol'],
+                        gulp_nframe=NT)
+
+    def run_arm(tag, **beam_kw):
+        with bf.Pipeline(sync_depth=4) as p:
+            src = NumpySourceBlock([raw.copy() for _ in range(ngulp)],
+                                   hdr, gulp_nframe=NT)
+            b = bf.blocks.copy(src, space='tpu')
+            beam = bf.blocks.beamform(b, w, name='Beam_%s' % tag,
+                                      **beam_kw)
+            fb = bf.blocks.fused(
+                beam, [DetectStage('stokes', axis='pol'),
+                       ReduceStage('time', RF)],
+                name='Detect_%s' % tag)
+            b2 = bf.blocks.copy(fb, space='system')
+            sink = GatherSink(b2)
+            t0 = time.perf_counter()
+            p.run()
+            dt = time.perf_counter() - t0
+        return dt, sink.result(), dict(beam.engine.chosen)
+
+    arms_kw = {'f32': {'accuracy': 'f32', 'impl': 'xla'},
+               'quant': {'accuracy': 'int8'}}
+    probe_prev = os.environ.get('BF_LINALG_PROBE')
+    os.environ['BF_LINALG_PROBE'] = '1'   # race even off-TPU
+    times = {a: [] for a in arms_kw}
+    outputs = {a: [] for a in arms_kw}
+    chosen = {}
+    try:
+        for rep in range(max(reps, 1)):
+            order = ['f32', 'quant'] if rep % 2 == 0 \
+                else ['quant', 'f32']
+            for a in order:
+                dt, out, ch = run_arm('%s_r%d' % (a, rep),
+                                      **arms_kw[a])
+                times[a].append(dt)
+                outputs[a].append(out)
+                if a == 'quant' and ch:
+                    chosen = ch
+    finally:
+        if probe_prev is None:
+            os.environ.pop('BF_LINALG_PROBE', None)
+        else:
+            os.environ['BF_LINALG_PROBE'] = probe_prev
+    t_f32 = min(times['f32'])
+    t_quant = min(times['quant'])
+    ref = outputs['f32'][0]
+    got = outputs['quant'][0]
+    rel = float(np.max(np.abs(got - ref)) /
+                (np.max(np.abs(ref)) or 1.0))
+    deterministic = all(np.array_equal(got, o)
+                        for o in outputs['quant'][1:])
+    winner = next(iter(chosen.values()), 'default')
+    # ops accounting: the beamform GEMM's real ops (8 per complex
+    # MAC), the unit like_top's GOP/s column and docs/perf.md publish
+    ops_total = 8 * ngulp * NT * NF * NP * NB * NS
+    ndev = 1            # single-device chain (no mesh arm here)
+    return {
+        'config': 'quantized beamform chain: ci8 capture->H2D->'
+                  'beamform->stokes->integrate, Nstand=%d Npol=%d '
+                  'Nbeam=%d Nchan=%d, %d x %d-frame gulps'
+                  % (NS, NP, NB, NF, ngulp, NT),
+        'value': round(t_f32 / t_quant, 2),
+        'unit': 'x chain speedup (quantized winner vs f32 baseline, '
+                'min-of-%d)' % len(times['f32']),
+        'arms': {
+            'f32': {'ms_min': round(t_f32 * 1e3, 1),
+                    'ms_all': [round(t * 1e3, 1)
+                               for t in times['f32']],
+                    'gops_per_s': round(ops_total / t_f32 / 1e9, 2)},
+            'quant': {'ms_min': round(t_quant * 1e3, 1),
+                      'ms_all': [round(t * 1e3, 1)
+                                 for t in times['quant']],
+                      'gops_per_s': round(ops_total / t_quant / 1e9,
+                                          2),
+                      'winner': winner},
+        },
+        'gops_per_s_per_chip': round(ops_total / t_quant / 1e9 /
+                                     ndev, 2),
+        'devices': ndev,
+        'backend': jax.default_backend(),
+        'beam_rel_err': round(rel, 6),
+        'class_rtol': BEAM_CLASSES['int8'],
+        # the acceptance triple tools/beam_gate.py checks
+        'quant_beats_f32': bool(t_quant < t_f32),
+        'within_class': bool(rel <= BEAM_CLASSES['int8']),
+        'deterministic': bool(deterministic),
+        'roofline': {
+            'bound': 'beamform GEMM candidate rate (measured race; '
+                     'ceilings table docs/perf.md — int8 ~7x f32 on '
+                     'MXU hosts, bf16 planes ~2x on the CPU gate '
+                     'host)',
+        },
+    }
+
+
 ALL = {
     1: bench_sigproc_cpu,
     2: bench_spectroscopy,
@@ -1611,13 +1759,14 @@ ALL = {
     10: bench_bridge,
     11: bench_mesh_pipeline,
     12: bench_e2e_observability,
+    13: bench_beamform_chain,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-12; 0 = all')
+                    help='config number 1-13; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -1627,7 +1776,7 @@ def main(argv=None):
                     help='flagship pipeline Msamples/s for config 7')
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
-    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12) for c in todo)
+    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13) for c in todo)
     if need_dev:
         from bench import _backend_alive
         if not _backend_alive():
@@ -1776,6 +1925,38 @@ def _verify_config12():
     return [_verify_chain()] + _verify_config10()
 
 
+def _verify_config13():
+    """The quantized beamform chain (bench_beamform_chain's quant arm)
+    as a build-only Pipeline — the verifier must prove it clean,
+    including BF-W170 (the quant arm's 'int8' class engages the int
+    candidates on the ci8 ring, so no float-on-quantized warning)."""
+    import sys as _sys
+    import os as _os
+    _tests = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests')
+    if _tests not in _sys.path:
+        _sys.path.insert(0, _tests)
+    import bifrost_tpu as bf
+    from bifrost_tpu.stages import DetectStage, ReduceStage
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    NT, NF, NS, NP, NB, RF = 32, 64, 256, 2, 128, 8
+    raw = np.zeros((NT, NF, NS, NP), dtype=np.dtype([('re', 'i1'),
+                                                     ('im', 'i1')]))
+    w = np.zeros((NP, NB, NS), np.complex64)
+    hdr = simple_header([-1, NF, NS, NP], 'ci8',
+                        labels=['time', 'freq', 'station', 'pol'],
+                        gulp_nframe=NT)
+    with bf.Pipeline(sync_depth=4) as p:
+        src = NumpySourceBlock([raw.copy()], hdr, gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='tpu')
+        beam = bf.blocks.beamform(b, w, accuracy='int8')
+        fb = bf.blocks.fused(beam, [DetectStage('stokes', axis='pol'),
+                                    ReduceStage('time', RF)])
+        GatherSink(bf.blocks.copy(fb, space='system'))
+    return p
+
+
 def build_verify_topologies():
     """{name: builder} over every pipeline-shaped bench config.  Each
     builder returns a Pipeline, a list of Pipelines, or None when the
@@ -1787,6 +1968,7 @@ def build_verify_topologies():
         'config10_bridge': _verify_config10,
         'config11_mesh': _verify_config11,
         'config12_e2e': _verify_config12,
+        'config13_beamform': _verify_config13,
     }
 
 

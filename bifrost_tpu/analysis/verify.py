@@ -57,6 +57,8 @@ CODES = {
     'BF-W152': 'bridge window > 1 on the v1 wire (no credit flow)',
     'BF-W160': 'macro-gulp batch requested but statically ineligible',
     'BF-I161': 'macro-gulp batch falls back on a host/compute block',
+    'BF-W170': 'float GEMM path on ring-declared quantized (ci8/ci4) '
+               'data',
     'BF-I170': 'header propagation stops at this block',
     'BF-I171': 'gulp geometry unknown; ring sizing not proven',
     'BF-I199': 'verifier check failed internally (diagnostic only)',
@@ -677,8 +679,69 @@ def _check_macro(g, diags):
                 block=b.name))
 
 
+def _check_quantization(g, diags):
+    """BF-W170: a beamform/correlate (GEMM-class) block consuming a
+    ring the header declares as ci8/ci4 — int8 (re, im) planes on
+    device, the MXU's ~7x fast path (docs/perf.md ceilings table) —
+    but configured so only FLOAT candidates can run: the quantization
+    win is left on the table.  Two ways to get here: the engine's
+    accuracy class excludes the int8 candidates from the race
+    ('f32'/'bf16'), or BF_BEAM_IMPL / ``impl=`` forces a float
+    candidate outright.  (CorrelateBlock engages exact-int xcorr on
+    ci8 automatically, so only engine-carrying beamform stages are
+    checked.)"""
+    from ..ops import beamform as _beam
+    for b in g.blocks:
+        irings = getattr(b, 'irings', None)
+        if not irings:
+            continue
+        stream = g.streams.get(id(_base(irings[0])))
+        hdr = stream.header if stream is not None else None
+        if hdr is None:
+            continue
+        try:
+            dtype = str(hdr['_tensor']['dtype'])
+        except Exception:
+            continue
+        if dtype not in ('ci4', 'ci8'):
+            continue
+        stages = list(getattr(b, 'stages', None) or ())
+        if getattr(b, '_stage', None) is not None:
+            stages.append(b._stage)
+        for s in stages:
+            eng = getattr(s, 'engine', None)
+            if eng is None or not hasattr(eng, 'accuracy'):
+                continue
+            forced = getattr(eng, '_force', None)
+            if forced in _beam._INT_IMPLS:
+                continue
+            if forced is not None:
+                diags.append(Diagnostic(
+                    'BF-W170',
+                    'block %r is forced to the %r float candidate on '
+                    'a ring declared %s: the int8 voltage planes will '
+                    'be promoted to float and the quantized MXU path '
+                    '(~7x f32, docs/perf.md) never engages — force an '
+                    'int candidate (int8_wide/pallas) or drop the '
+                    'override' % (b.name, forced, dtype),
+                    block=b.name, ring=_ring_name(_base(irings[0]))))
+            elif _beam.beam_class_rtol(eng.accuracy) < \
+                    _beam.BEAM_CLASSES['int8']:
+                diags.append(Diagnostic(
+                    'BF-W170',
+                    'block %r will beamform ring-declared %s data on '
+                    'a float path: its %r accuracy class excludes the '
+                    'int8 candidates from the race, so the quantized '
+                    'MXU path (~7x f32, docs/perf.md) is left on the '
+                    "table — declare accuracy='int8' (weight "
+                    'quantization ~2^-7) if the science tolerates it'
+                    % (b.name, dtype, eng.accuracy),
+                    block=b.name, ring=_ring_name(_base(irings[0]))))
+
+
 _CHECKS = (_check_tensor_contracts, _check_ring_sizing,
-           _check_donation, _check_mesh, _check_bridge, _check_macro)
+           _check_donation, _check_mesh, _check_bridge, _check_macro,
+           _check_quantization)
 
 
 def verify_pipeline(pipeline):

@@ -17,6 +17,7 @@ from .quantize import QuantizeBlock, quantize
 from .unpack import UnpackBlock, unpack
 from .print_header import PrintHeaderBlock, print_header
 from .fused import FusedBlock, fused
+from .beamform import BeamformBlock, beamform
 from .fdmt import FdmtBlock, fdmt
 from .correlate import CorrelateBlock, correlate
 from .fir import FirBlock, fir

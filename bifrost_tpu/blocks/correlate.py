@@ -84,6 +84,11 @@ class CorrelateBlock(TransformBlock):
         ohdr['gulp_nframe'] = min(ihdr['gulp_nframe'],
                                   self.nframe_per_integration)
         self._prewarm_xcorr(itensor, gulp_actual)
+        # GEMM-class ops accounting (like_top's GOP/s column): the full
+        # visibility matrix costs F * (S*P)^2 complex MACs per frame
+        # (8 real ops each)
+        _, f, s, p = itensor['shape'][:4]
+        self._gemm_ops = 8 * gulp_actual * f * (s * p) ** 2
         return ohdr
 
     def _prewarm_xcorr(self, itensor, gulp_nframe):

@@ -5,6 +5,7 @@ from .common import as_jax, as_logical_numpy, astype, logical_dtype
 from .map import map, map_compute, clear_map_cache
 from .fft import Fft, fft
 from .linalg import LinAlg, matmul
+from .beamform import Beamformer
 from .reduce import reduce
 from .transpose import transpose
 from .quantize import quantize, unpack

@@ -124,6 +124,22 @@ def _to_logical(buf, dtype):
             re = (b.astype(np.int8) >> 4).astype(np.float32)
             im = (np.left_shift(b, 4).astype(np.int8) >> 4).astype(np.float32)
             return (re + 1j * im).astype(np.complex64)
+        if dtype.is_packed:
+            # ci1/ci2: each sample is a 2*nbits field with re in the
+            # HIGH nbits (the ci4 re<<4|im convention); fields packed
+            # LSB-first within the byte (the sub-byte sample order)
+            nbits = dtype.nbits
+            width = 2 * nbits
+            per = 8 // width
+            b = buf.view(np.uint8)
+            shifts = np.arange(per, dtype=np.uint8) * width
+            fields = (b[..., None] >> shifts) & ((1 << width) - 1)
+            fields = fields.reshape(buf.shape[:-1] + (-1,))
+            sext = lambda v: ((v.astype(np.int8) << (8 - nbits))
+                              >> (8 - nbits)).astype(np.float32)
+            re = sext(fields >> nbits)
+            im = sext(fields & ((1 << nbits) - 1))
+            return (re + 1j * im).astype(np.complex64)
         re = buf['re'].astype(np.float32)
         im = buf['im'].astype(np.float32)
         return (re + 1j * im).astype(np.complex64)
@@ -154,6 +170,25 @@ def _from_logical(arr, dtype, out_buf=None):
             re = np.round(arr.real).astype(np.int64) & 0xF
             im = np.round(arr.imag).astype(np.int64) & 0xF
             packed = ((re << 4) | im).astype(np.uint8)
+            if out_buf is not None:
+                out_buf[...] = packed.view(out_buf.dtype).reshape(
+                    out_buf.shape)
+                return out_buf
+            return packed
+        if dtype.is_packed:
+            # ci1/ci2: inverse of _to_logical's packed-ci layout
+            nbits = dtype.nbits
+            width = 2 * nbits
+            per = 8 // width
+            mask = (1 << nbits) - 1
+            re = np.round(arr.real).astype(np.int64) & mask
+            im = np.round(arr.imag).astype(np.int64) & mask
+            fields = (re << nbits) | im
+            fields = fields.reshape(fields.shape[:-1] +
+                                    (fields.shape[-1] // per, per))
+            shifts = np.arange(per) * width
+            packed = np.bitwise_or.reduce(fields << shifts,
+                                          axis=-1).astype(np.uint8)
             if out_buf is not None:
                 out_buf[...] = packed.view(out_buf.dtype).reshape(
                     out_buf.shape)

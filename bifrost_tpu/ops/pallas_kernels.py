@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import os
 
-__all__ = ['available', 'stokes_detect', 'xcorr_herm', 'xcorr_cross']
+__all__ = ['available', 'stokes_detect', 'xcorr_herm', 'xcorr_cross',
+           'beamform_int8', 'beamform_bf16', 'beamform_detect_int8']
 
 _checked = None
 
@@ -203,6 +204,190 @@ def xcorr_cross(re_i, im_i, re_j, im_j, interpret=None):
         interpret=interpret,
     )(re_i, im_i, re_j, im_j)
     return vr + 1j * vi
+
+
+# ---------------------------------------------------------------------------
+# coherent-beamformer kernels (the quantized beamform/correlate engine,
+# ops/beamform.py; recipe papers: "The Tensor-Core Beamformer"
+# arXiv:2505.03269, "GPU-Powered Coherent Beamforming" arXiv:1412.4907)
+# ---------------------------------------------------------------------------
+
+#: contract the station axis (dim 1 of both operands): (T, N) x (B, N)
+#: -> (T, B)
+_BEAM_DN = (((1,), (1,)), ((), ()))
+
+
+def _dot_beam(a, b, acc):
+    import jax
+    return jax.lax.dot_general(a, b, _BEAM_DN,
+                               preferred_element_type=acc)
+
+
+def beamform_int8(wr, wi, re, im, interpret=None):
+    """Fused int8 coherent beamform, one frequency channel per program.
+
+    Per channel: the four int8 MXU dots of the complex product
+    y[t, b] = sum_n w[b, n] * x[t, n] (yr = r.wr^T - i.wi^T,
+    yi = r.wi^T + i.wr^T) accumulate in VMEM int32 and each (T, B)
+    beam block is written exactly once — the TPU expression of the
+    tensor-core beamformer's fused cgemm (arXiv:2505.03269; the
+    reference's dp4a cherk analogue, src/linalg_kernels.cu:55).  The
+    int8 voltage planes are the ci8 ring's device representation, so
+    no f32 voltages ever materialize in HBM.
+
+    wr, wi: (B, N) int8 quantized weight planes;
+    re, im: (T, F, N) int8 voltage planes
+    -> (yr, yi): (T, F, B) int32 planes (EXACT integer accumulation —
+    the caller applies the weight dequantization scale).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    T, F, N = re.shape
+    B = wr.shape[0]
+    interpret = _xcorr_interpret(interpret)
+
+    def kernel(wr_ref, wi_ref, re_ref, im_ref, or_ref, oi_ref):
+        r = re_ref[:, 0, :]
+        i = im_ref[:, 0, :]
+        wr_ = wr_ref[...]
+        wi_ = wi_ref[...]
+        or_ref[:, 0, :] = (_dot_beam(r, wr_, jnp.int32) -
+                           _dot_beam(i, wi_, jnp.int32))
+        oi_ref[:, 0, :] = (_dot_beam(r, wi_, jnp.int32) +
+                           _dot_beam(i, wr_, jnp.int32))
+
+    spec_w = pl.BlockSpec((B, N), lambda f: (0, 0))
+    spec_x = pl.BlockSpec((T, 1, N), lambda f: (0, f, 0))
+    spec_o = pl.BlockSpec((T, 1, B), lambda f: (0, f, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(F,),
+        in_specs=[spec_w, spec_w, spec_x, spec_x],
+        out_specs=[spec_o, spec_o],
+        out_shape=[jax.ShapeDtypeStruct((T, F, B), jnp.int32)] * 2,
+        interpret=interpret,
+    )(wr, wi, re, im)
+
+
+def beamform_bf16(wr, wi, re, im, interpret=None):
+    """Single-pass bf16 beamform, one channel per program: the same
+    four dots as :func:`beamform_int8` but in bf16 with f32
+    accumulation — full MXU rate, ~2^-8 input rounding.  LOSSY by
+    construction: races only under a widened accuracy class
+    (ops/beamform.py) or a forced BF_BEAM_IMPL.
+
+    wr, wi: (B, N) float32 weight planes (cast to bf16 in VMEM);
+    re, im: (T, F, N) int8 (or float) voltage planes
+    -> (yr, yi): (T, F, B) float32 planes.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    T, F, N = re.shape
+    B = wr.shape[0]
+    interpret = _xcorr_interpret(interpret)
+
+    def kernel(wr_ref, wi_ref, re_ref, im_ref, or_ref, oi_ref):
+        r = re_ref[:, 0, :].astype(jnp.bfloat16)
+        i = im_ref[:, 0, :].astype(jnp.bfloat16)
+        wr_ = wr_ref[...].astype(jnp.bfloat16)
+        wi_ = wi_ref[...].astype(jnp.bfloat16)
+        or_ref[:, 0, :] = (_dot_beam(r, wr_, jnp.float32) -
+                           _dot_beam(i, wi_, jnp.float32))
+        oi_ref[:, 0, :] = (_dot_beam(r, wi_, jnp.float32) +
+                           _dot_beam(i, wr_, jnp.float32))
+
+    spec_w = pl.BlockSpec((B, N), lambda f: (0, 0))
+    spec_x = pl.BlockSpec((T, 1, N), lambda f: (0, f, 0))
+    spec_o = pl.BlockSpec((T, 1, B), lambda f: (0, f, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(F,),
+        in_specs=[spec_w, spec_w, spec_x, spec_x],
+        out_specs=[spec_o, spec_o],
+        out_shape=[jax.ShapeDtypeStruct((T, F, B), jnp.float32)] * 2,
+        interpret=interpret,
+    )(wr, wi, re, im)
+
+
+def beamform_detect_int8(wxr, wxi, wyr, wyi, rex, imx, rey, imy,
+                         scale, rfactor, interpret=None):
+    """Fused int8 beamform -> Stokes detect -> time integrate, one
+    frequency channel per program.
+
+    Per channel: both polarizations' beam voltages (8 int8 MXU dots,
+    int32 accumulation) are dequantized to f32 IN VMEM, the Stokes
+    products (I, Q, U, V) form on the VPU, and the R-frame time
+    integration reduces before anything returns to HBM — beam voltages
+    never round-trip HBM, which is the point of the fused variant
+    (the Tensor-Core Beamformer's beamform+detect pipeline,
+    arXiv:2505.03269).
+
+    wxr..wyi: (B, S) int8 weight planes for the X / Y polarizations;
+    rex..imy: (T, F, S) int8 per-pol voltage planes; ``scale`` the
+    weight dequantization factor (1/w_scale); ``rfactor`` R must
+    divide T.  Returns (I, Q, U, V): four (T//R, F, B) float32 arrays
+    (stacked into the pol axis by the caller).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    T, F, S = rex.shape
+    B = wxr.shape[0]
+    if T % rfactor:
+        raise ValueError('rfactor %d does not divide T=%d'
+                         % (rfactor, T))
+    Tout = T // rfactor
+    interpret = _xcorr_interpret(interpret)
+    scale = float(scale)
+
+    def kernel(wxr_ref, wxi_ref, wyr_ref, wyi_ref,
+               rex_ref, imx_ref, rey_ref, imy_ref,
+               oi_ref, oq_ref, ou_ref, ov_ref):
+        def beam(r_ref, i_ref, wr_ref, wi_ref):
+            r = r_ref[:, 0, :]
+            i = i_ref[:, 0, :]
+            wr_ = wr_ref[...]
+            wi_ = wi_ref[...]
+            br = (_dot_beam(r, wr_, jnp.int32) -
+                  _dot_beam(i, wi_, jnp.int32)).astype(jnp.float32)
+            bi = (_dot_beam(r, wi_, jnp.int32) +
+                  _dot_beam(i, wr_, jnp.int32)).astype(jnp.float32)
+            return br * scale, bi * scale
+
+        bxr, bxi = beam(rex_ref, imx_ref, wxr_ref, wxi_ref)
+        byr, byi = beam(rey_ref, imy_ref, wyr_ref, wyi_ref)
+        xx = bxr * bxr + bxi * bxi
+        yy = byr * byr + byi * byi
+        # x * conj(y)
+        xy_r = bxr * byr + bxi * byi
+        xy_i = bxi * byr - bxr * byi
+
+        def integ(v):
+            # (T, B) -> (T//R, R, B) sum over R: minor dim stays B, so
+            # the reshape is Mosaic-legal (leading-dim split only)
+            return v.reshape(Tout, rfactor, B).sum(axis=1)
+
+        oi_ref[:, 0, :] = integ(xx + yy)
+        oq_ref[:, 0, :] = integ(xx - yy)
+        ou_ref[:, 0, :] = integ(2.0 * xy_r)
+        ov_ref[:, 0, :] = integ(-2.0 * xy_i)
+
+    spec_w = pl.BlockSpec((B, S), lambda f: (0, 0))
+    spec_x = pl.BlockSpec((T, 1, S), lambda f: (0, f, 0))
+    spec_o = pl.BlockSpec((Tout, 1, B), lambda f: (0, f, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(F,),
+        in_specs=[spec_w] * 4 + [spec_x] * 4,
+        out_specs=[spec_o] * 4,
+        out_shape=[jax.ShapeDtypeStruct((Tout, F, B), jnp.float32)] * 4,
+        interpret=interpret,
+    )(wxr, wxi, wyr, wyi, rex, imx, rey, imy)
 
 
 def fdmt_step(d1, d2, passthrough, rows_hi_max, sgn, T, interpret=False):

@@ -76,7 +76,9 @@ def quantize(src, dst, scale=1.):
 
 def _pack_into(vals, dtype, out_buf):
     """Pack logical values into (possibly sub-byte) storage."""
-    if dtype.kind == 'ci' and dtype.nbits == 4:
+    if dtype.kind == 'ci':
+        # ci4 and the packed ci1/ci2 interleaved-field layouts both
+        # live in _from_logical (shared with the map-language path)
         _from_logical(vals, dtype, out_buf=out_buf)
         return
     if dtype.is_packed:

@@ -477,6 +477,20 @@ class Pipeline(BlockScope):
         _vmode = _verify.validate_mode()
         if _vmode != 'off':
             _verify.gate_run(self, _vmode)
+        # persistent XLA compilation cache (docs/envvars.md): with
+        # BF_COMPILE_CACHE=<dir> first-gulp compile latency survives
+        # process restarts — the restarted pipeline replays compiled
+        # programs from disk instead of re-lowering them (the ROADMAP
+        # "AOT compile-cache" follow-on; bench_suite configs opt in
+        # programmatically via bf.enable_compilation_cache())
+        _cc_dir = os.environ.get('BF_COMPILE_CACHE', '').strip()
+        if _cc_dir:
+            from .utils import enable_compilation_cache
+            try:
+                enable_compilation_cache(_cc_dir)
+            except OSError as e:
+                warnings.warn('BF_COMPILE_CACHE=%s not usable: %s'
+                              % (_cc_dir, e))
         # device-space pipelines: create the jax backend client from
         # THIS thread first — the tunneled TPU plugin deadlocks when a
         # block (worker) thread triggers the first client init
@@ -662,6 +676,12 @@ class Block(BlockScope):
         #: plans set this when they publish impl info; 1 = one device).
         #: Rendered as like_top's Shd column from the perf proclog.
         self._shards_active = 1
+        #: GEMM-class ops accounting: real ops per logical gulp of the
+        #: current sequence (beamform/correlate blocks set this at
+        #: on_sequence); published as the gemm_gops_per_s perf key and
+        #: rendered as like_top's GOP/s column (docs/perf.md).  0 = not
+        #: a GEMM-class block.
+        self._gemm_ops = 0
         #: trace context of the CURRENT sequence (docs/observability.md
         #: "Distributed tracing & SLOs"): stamped by stream-origin
         #: blocks, propagated input->output by transforms/sinks, and
@@ -751,6 +771,13 @@ class Block(BlockScope):
                 self._n_gulps_logical / float(self._n_dispatches), 3)
         if self._shards_active > 1:
             stats['shards'] = int(self._shards_active)
+        # GEMM-class throughput (like_top's GOP/s column): the block's
+        # declared real-op count per logical gulp over the median gulp
+        # time — the per-chip ops/s the beamform/correlate bench rows
+        # publish, live
+        if self._gemm_ops and stats.get('gulp_p50', 0) > 0:
+            stats['gemm_gops_per_s'] = round(
+                self._gemm_ops / stats['gulp_p50'] / 1e9, 3)
         # capture-to-commit age p99 (telemetry.slo; like_top's Age99
         # column): transforms age at their output-ring commits, sinks
         # at pipeline exit

@@ -28,7 +28,7 @@ from .units import transform_units
 
 __all__ = ['Stage', 'FftStage', 'DetectStage', 'ReduceStage',
            'FftShiftStage', 'ReverseStage', 'TransposeStage',
-           'ScrunchStage', 'MapStage']
+           'ScrunchStage', 'MapStage', 'BeamformStage']
 
 
 class Stage(object):
@@ -463,6 +463,117 @@ class ScrunchStage(Stage):
         return fn
 
 
+class BeamformStage(Stage):
+    """Coherent beamform: contract the station(/pol) axes of the
+    voltage stream against a fixed weight set through the quantized
+    beamformer engine (:class:`bifrost_tpu.ops.beamform.Beamformer` —
+    candidates raced + accuracy-gated per the declared ``accuracy``
+    class; ``BF_BEAM_IMPL`` forces one).
+
+    Input tensor: ``['time', 'freq', 'station']`` or
+    ``['time', 'freq', 'station', 'pol']``, dtype ci8 (int planes ride
+    the MXU int8 path directly) or complex float.  Weight shapes select
+    the output form (see the engine docstring):
+
+    - ``(B, S)`` on pol-less input, or ``(B, S*P)`` (pol folded into
+      the contraction) -> output ``['time', 'freq', 'beam']``;
+    - ``(B, S)`` / ``(P, B, S)`` with a pol axis -> per-pol beams,
+      output ``['time', 'freq', 'pol', 'beam']`` (the dual-pol form
+      the fused beamform->Stokes-detect->integrate substitution
+      recognizes, :func:`match_beamformer`).
+
+    Time-concat equivariant (``batch_safe``): macro-gulp block mode
+    and the mesh frame-local shard_map plan both apply unchanged.
+    """
+
+    batch_safe = True
+
+    def __init__(self, weights, accuracy='f32', impl=None):
+        from .ops.beamform import Beamformer
+        self.engine = Beamformer(weights, accuracy=accuracy, impl=impl)
+        self.accuracy = self.engine.accuracy
+
+    def transform_header(self, hdr):
+        itensor = hdr['_tensor']
+        labels = itensor.get('labels')
+        if not labels or labels[:2] != ['time', 'freq']:
+            raise ValueError(
+                "beamform requires ['time', 'freq', ...] input labels, "
+                "got %r" % (labels,))
+        itype = DataType(itensor['dtype'])
+        if not itype.is_complex:
+            raise TypeError('beamform requires complex voltages, got '
+                            '%s' % itensor['dtype'])
+        shape = itensor['shape']
+        eng = self.engine
+        if labels[2:] == ['station', 'pol']:
+            s, p = shape[2], shape[3]
+            if eng.npol_w == 1 and eng.nstand == s * p:
+                self.mode = 'fold'
+            elif eng.nstand == s and eng.npol_w in (1, p):
+                self.mode = 'perpol'
+            else:
+                raise ValueError(
+                    'weights (%d pol sets, %d inputs) match neither '
+                    'per-pol station count %d nor folded %d'
+                    % (eng.npol_w, eng.nstand, s, s * p))
+            self.npol = p
+        elif labels[2:] == ['station']:
+            if eng.npol_w != 1 or eng.nstand != shape[2]:
+                raise ValueError(
+                    'weights expect %d inputs but the stream has %d '
+                    'stations' % (eng.nstand, shape[2]))
+            self.mode = 'nopol'
+            self.npol = 1
+        else:
+            raise ValueError(
+                "beamform requires trailing ['station'[, 'pol']] "
+                "axes, got %r" % (labels[2:],))
+        ohdr = deepcopy(hdr)
+        otensor = ohdr['_tensor']
+        otensor['dtype'] = 'cf32'
+        for key, fill in (('shape', eng.nbeam), ('labels', 'beam'),
+                          ('scales', [0, 1]), ('units', None)):
+            if key not in otensor:
+                continue
+            vals = otensor[key]
+            if self.mode == 'perpol':
+                # ['time', 'freq', 'pol', 'beam']: the pol entry moves
+                # up from position 3
+                vals = [deepcopy(vals[0]), deepcopy(vals[1]),
+                        deepcopy(vals[3]), deepcopy(fill)]
+            else:
+                vals = [deepcopy(vals[0]), deepcopy(vals[1]),
+                        deepcopy(fill)]
+            otensor[key] = vals
+        return ohdr
+
+    def build(self, in_meta):
+        import jax.numpy as jnp
+        reim = in_meta.get('reim', False)
+        mode = self.mode
+        engine = self.engine
+
+        def fn(x):
+            if reim and not jnp.issubdtype(x.dtype,
+                                           jnp.complexfloating):
+                re, im = x[..., 0], x[..., 1]
+            else:
+                re, im = jnp.real(x), jnp.imag(x)
+            if mode == 'nopol':
+                re, im = re[:, :, None, :], im[:, :, None, :]
+            elif mode == 'fold':
+                shp = (re.shape[0], re.shape[1], 1, -1)
+                re, im = re.reshape(shp), im.reshape(shp)
+            else:
+                # (T, F, S, P) -> canonical (T, F, P, S)
+                re = jnp.swapaxes(re, 2, 3)
+                im = jnp.swapaxes(im, 2, 3)
+            y = engine(re, im)
+            return y if mode == 'perpol' else y[:, :, 0, :]
+        return fn
+
+
 class MapStage(Stage):
     """User-defined elementwise stage via a bf.map expression operating on
     'a' (input) and 'b' (output); fusable with neighbors."""
@@ -507,6 +618,70 @@ class MapStage(Stage):
         return fn
 
 
+def match_beamformer(stages, headers, shape, dtype):
+    """Recognize the quantized beamform-and-detect pattern —
+    BeamformStage (per-pol, dual pol) -> DetectStage('stokes', pol) ->
+    ReduceStage over the frame axis, on ci8 input — and return the
+    fused Pallas kernel (ops.pallas_kernels.beamform_detect_int8) as a
+    callable plan when the engine's accuracy class and the backend
+    admit it, else None.
+
+    The fused kernel beamforms both polarizations (8 int8 MXU dots,
+    int32 accumulation), dequantizes, forms Stokes products and
+    integrates R frames all in VMEM — beam voltages never round-trip
+    HBM (the Tensor-Core Beamformer's fused pipeline, arXiv:2505.03269).
+    Substitution requires the 'int8' accuracy class (the kernel's
+    weights are quantized by construction) — see
+    ops.beamform.fused_mode for the BF_BEAM_FUSED override.
+    """
+    if len(stages) != 3:
+        return None
+    b, d, r = stages
+    if not (isinstance(b, BeamformStage) and isinstance(d, DetectStage)
+            and isinstance(r, ReduceStage)):
+        return None
+    if headers[0]['_tensor']['dtype'] != 'ci8':
+        return None
+    if str(dtype) != 'int8' or len(shape) != 5:
+        return None
+    ntime, nfreq, nstand, npol, two = shape
+    if npol != 2 or two != 2:
+        return None
+    if getattr(b, 'mode', None) != 'perpol':
+        return None
+    if d.mode != 'stokes' or d.axis_index != 2 or d.npol != 2:
+        return None
+    if r.op != 'sum' or r.axis != r.frame_axis or not r.factor:
+        return None
+    if ntime % r.factor:
+        return None
+    from .ops import beamform as _beam
+    mode = _beam.fused_mode()
+    if mode == 'off':
+        return None
+    eng = b.engine
+    if mode != 'force':
+        if _beam.beam_class_rtol(eng.accuracy) < \
+                _beam.BEAM_CLASSES['int8'] and \
+                eng._force != 'pallas':
+            return None
+        if not _beam.Beamformer._pallas_raceable():
+            return None
+    if not _beam.fused_usable(eng, ntime, nfreq, r.factor):
+        return None
+    factor = r.factor
+
+    def fn(x):
+        return _beam.fused_detect(eng, x, factor)
+    return SpectrometerPlan(fn, {
+        'impl': 'pallas-beamform-detect',
+        'rfactor': factor,
+        'nbeam': eng.nbeam,
+        'accuracy': eng.accuracy,
+        'wscale': float(eng.wscale),
+    })
+
+
 def walk_headers(stages, hdr):
     """Run ``hdr`` through every stage's transform_header; returns the
     full header list (input + one per stage output)."""
@@ -533,10 +708,12 @@ def compose_stages(stages, headers, shape, dtype, substitute=True):
     import jax
     from functools import reduce as _reduce
     if substitute:
-        # check the whole-chain substitution first: when it matches,
+        # check the whole-chain substitutions first: when one matches,
         # the per-stage functions below would be built only to be
         # discarded
         plan = match_spectrometer(stages, headers, shape, dtype)
+        if plan is None:
+            plan = match_beamformer(stages, headers, shape, dtype)
         if plan is not None:
             return plan, plan.info
     fns = []

@@ -208,6 +208,36 @@ def test_macro_ineligibility_reported():
     assert any(d.code == 'BF-I161' for d in diags)   # the host sink
 
 
+def test_float_path_on_quantized_ring_warns():
+    """Seeded misconfiguration: a BeamformBlock on a ci8 ring whose
+    'f32' accuracy class excludes the int8 candidates -> BF-W170; the
+    'int8' class (or a forced int candidate) is clean; a forced FLOAT
+    candidate on the same ring warns again."""
+    rng = np.random.RandomState(0)
+    # weights (B, S) for a ['time', 'freq', 'station', 'pol'] stream
+    S, P, B = 8, 2, 4
+    w = (rng.randn(B, S) + 1j * rng.randn(B, S)).astype(np.complex64)
+    hdr = simple_header([-1, NF, S, P], 'ci8',
+                        labels=['time', 'freq', 'station', 'pol'])
+    raw = np.zeros((NT, NF, S, P), dtype=np.dtype([('re', 'i1'),
+                                                   ('im', 'i1')]))
+
+    def build(**kw):
+        with bf.Pipeline() as p:
+            src = NumpySourceBlock([raw.copy()], hdr, gulp_nframe=NT)
+            b = bf.blocks.copy(src, space='tpu')
+            b = bf.blocks.beamform(b, w, **kw)
+            GatherSink(bf.blocks.copy(b, space='system'))
+            return p.validate()
+
+    diags = build(accuracy='f32')
+    assert 'BF-W170' in _codes(diags), _codes(diags)
+    assert build(accuracy='int8') == []
+    assert build(accuracy='f32', impl='int8_wide') == []
+    forced = build(accuracy='int8', impl='planar_bf16')
+    assert 'BF-W170' in _codes(forced), _codes(forced)
+
+
 def test_all_codes_catalogued():
     """Every diagnostic code the tests assert is in the stable
     catalog, and severities derive from the code letter."""

@@ -111,3 +111,91 @@ def test_sigproc_subbyte_read_lsb_first(tmp_path):
     with SigprocFile(path) as r:
         data = r.read(1)
     np.testing.assert_array_equal(data.reshape(-1), [0, 1, 2, 3])
+
+
+def test_packed_roundtrip_bit_exact_all_kinds():
+    """quantize -> unpack is bit-exact for every packed 1/2/4-bit kind
+    (i/u/ci) over the full representable range.  The packed-ci layout
+    (ci1/ci2) interleaves re/im as 2*nbits fields, re in the HIGH
+    nbits (the ci4 re<<4|im convention), fields LSB-first — this test
+    surfaced (and now pins) the generic packed path silently dropping
+    the imaginary part."""
+    from bifrost_tpu.dtype import DataType
+    from bifrost_tpu.ops.quantize import _clip_limits
+
+    rng = np.random.RandomState(42)
+    n = 64
+    for s in ('i1', 'i2', 'i4', 'u1', 'u2', 'u4', 'ci1', 'ci2',
+              'ci4'):
+        dt = DataType(s)
+        lo, hi = _clip_limits(dt)
+        if dt.kind == 'ci':
+            vals = (rng.randint(lo, hi + 1, n) +
+                    1j * rng.randint(lo, hi + 1, n)
+                    ).astype(np.complex64)
+            back = bf.empty((n,), 'cf32', 'system')
+        else:
+            vals = rng.randint(lo, hi + 1, n).astype(np.float32)
+            back = bf.empty((n,), 'i8' if dt.kind == 'i' else 'u8',
+                            'system')
+        dst = bf.empty((n,), s, 'system')
+        ops.quantize(bf.asarray(vals), dst, scale=1.)
+        ops.unpack(dst, back)
+        np.testing.assert_array_equal(
+            back.as_numpy().astype(vals.dtype), vals,
+            err_msg='round trip not bit-exact for %s' % s)
+
+
+def test_packed_roundtrip_range_extremes():
+    """The clip limits themselves survive the round trip — lo would be
+    the first casualty of a sign-extension or clip asymmetry (i4's -8
+    packs to 0x8 and must come back as -8, not +8)."""
+    from bifrost_tpu.dtype import DataType
+    from bifrost_tpu.ops.quantize import _clip_limits
+
+    for s in ('i1', 'i2', 'i4', 'u1', 'u2', 'u4'):
+        dt = DataType(s)
+        lo, hi = _clip_limits(dt)
+        per = 8 // dt.nbits
+        vals = np.resize([lo, hi], per).astype(np.float32)
+        dst = bf.empty((per,), s, 'system')
+        back = bf.empty((per,), 'i8' if dt.kind == 'i' else 'u8',
+                        'system')
+        ops.quantize(bf.asarray(vals), dst, scale=1.)
+        ops.unpack(dst, back)
+        np.testing.assert_array_equal(
+            back.as_numpy().astype(np.float32), vals, err_msg=s)
+    for s in ('ci1', 'ci2', 'ci4'):
+        dt = DataType(s)
+        lo, hi = _clip_limits(dt)
+        per = max(8 // (2 * dt.nbits), 1)
+        vals = np.resize([lo + 1j * hi, hi + 1j * lo],
+                         per).astype(np.complex64)
+        dst = bf.empty((per,), s, 'system')
+        back = bf.empty((per,), 'cf32', 'system')
+        ops.quantize(bf.asarray(vals), dst, scale=1.)
+        ops.unpack(dst, back)
+        np.testing.assert_array_equal(back.as_numpy(), vals,
+                                      err_msg=s)
+
+
+def test_packed_ci_field_layout():
+    """Hand-derived packed-ci bytes: ci2 sample (re=1, im=-2) is the
+    field 0b0110 (re high); two fields per byte, LSB-first."""
+    from bifrost_tpu.ops.map import _to_logical, _from_logical
+    from bifrost_tpu.dtype import DataType
+
+    # ci2: fields s0=(1, -2) -> 0b0110 = 6, s1=(-1, 1) -> 0b1101 = 13
+    # byte = s1 << 4 | s0 = 0xD6
+    vals = _to_logical(np.array([0xD6], np.uint8), DataType('ci2'))
+    np.testing.assert_array_equal(vals, [1 - 2j, -1 + 1j])
+    packed = _from_logical(np.array([1 - 2j, -1 + 1j], np.complex64),
+                           DataType('ci2'))
+    np.testing.assert_array_equal(packed, [0xD6])
+
+    # ci1: four (re, im) fields per byte, re the high bit of each pair
+    # s0=(-1, 0) -> 0b10, s1=(0, -1) -> 0b01 -> byte 0b0110 = 0x06
+    vals = _to_logical(np.array([0x06], np.uint8), DataType('ci1'))
+    np.testing.assert_array_equal(vals[:2], [-1 + 0j, 0 - 1j])
+    packed = _from_logical(np.asarray(vals), DataType('ci1'))
+    np.testing.assert_array_equal(packed, [0x06])

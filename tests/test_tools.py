@@ -221,3 +221,50 @@ def test_bf_lint_codes_catalog():
     assert res.returncode == 0, res.stderr
     for code in ('BF-E101', 'BF-E121', 'BF-E130', 'BF-W140', 'BF-E150'):
         assert code in res.stdout, code
+
+
+def test_mprobe_report_dump_and_clear(tmp_path):
+    """mprobe_report renders the disk winner cache (winner, per-
+    candidate ms, margin, coin-flip flag) and --clear drops it so the
+    next session re-measures."""
+    import json
+    cache = tmp_path / 'mp'
+    cache.mkdir()
+    (cache / 'beamform.json').write_text(json.dumps({
+        'cpu:x:v0|acc=int8 w=(1,4,8) v=(8,2,1,8) int8': {
+            'winner': 'int8_wide',
+            'ms': {'int8_wide': 1.0, 'xla': 5.0}},
+        'cpu:x:v0|acc=f32 w=(1,4,8) v=(8,2,1,8) float32': {
+            'winner': 'planar',
+            'ms': {'planar': 1.00, 'xla': 1.01}},
+    }))
+    # foreign state in the same dir (telemetry_usage.json-style list
+    # entries): must be neither rendered nor deleted by --clear
+    (cache / 'telemetry_usage.json').write_text(
+        json.dumps({'counters.inc': [12, 3, 0.5]}))
+    env = dict(os.environ, BF_CACHE_DIR=str(cache))
+    run = lambda *a: subprocess.run(
+        [sys.executable, os.path.join(TOOLS, 'mprobe_report.py')]
+        + list(a), capture_output=True, text=True, env=env, timeout=60)
+
+    res = run()
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert 'winner=int8_wide' in res.stdout
+    assert 'margin=5.000x' in res.stdout
+    assert 'COIN-FLIP' in res.stdout          # the 1.01/1.00 key
+
+    res = run('--json', '--family', 'beamform')
+    data = json.loads(res.stdout)
+    assert set(data) == {'beamform'}
+    assert len(data['beamform']) == 2
+
+    res = run('--clear', '--family', 'beamform')
+    assert res.returncode == 0
+    assert not (cache / 'beamform.json').exists()
+
+    res = run('--clear')
+    assert res.returncode == 0
+    assert (cache / 'telemetry_usage.json').exists()  # foreign: kept
+
+    res = run()
+    assert 'no winner caches' in res.stdout

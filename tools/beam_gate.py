@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Quantized-beamformer gate: the measured quantized winner must beat
+the f32 baseline on the end-to-end chain, within its accuracy class.
+
+Runs bench_suite config 13 (ci8 capture -> H2D -> beamform -> Stokes
+detect -> integrate -> sink; min-of-N with alternating arms —
+bench_suite.bench_beamform_chain) in a fresh subprocess pinned to the
+CPU backend, and asserts:
+
+- ``quant_beats_f32`` — the quantized arm's min-of-N wall time beats
+  the f32 XLA-baseline arm's (speedup >= ``--min-speedup``; measured
+  selection must find a winner on this host or the whole quantized
+  engine is a no-op here);
+- ``within_class``    — the quantized arm's output stays inside the
+  declared 'int8' accuracy-class bound (BEAM_CLASSES['int8'] rtol) of
+  the f32 arm — a lossy winner can never buy speed with unbounded
+  error;
+- ``deterministic``   — quant-arm outputs are byte-identical across
+  repetitions (same winner, same program, same stream).
+
+The ops/s-per-chip number the artifact carries is the row docs/perf.md
+publishes next to the spectrometer.  The arm interleaving / min-of-N
+noise defenses live inside config 13 itself (the config-9 policy).
+
+Exit codes: 0 pass, 3 a gate condition failed, 2 the bench arm failed
+to produce a result.  ``tools/watch_and_bench.sh`` runs this after the
+batch gate (``BF_SKIP_BEAM_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config13(timeout=1800):
+    """One bench_suite --config 13 subprocess on the CPU backend with
+    a private probe-cache dir (a stale winner frozen by an earlier
+    session must not skew the race); returns its result dict."""
+    with tempfile.TemporaryDirectory(prefix='beam_gate_') as cache:
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   BF_CACHE_DIR=cache)
+        env.pop('BF_BEAM_IMPL', None)        # a forced impl skews arms
+        env.pop('BF_BEAM_GATE_RTOL', None)
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+             '--config', '13'],
+            capture_output=True, text=True, env=env, cwd=ROOT,
+            timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'arms' in d:
+            return d
+    raise RuntimeError(
+        'config 13 produced no arms result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1000:], out.stderr[-1000:]))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default='BENCH_BEAM.json',
+                    help='artifact path (full config-13 result + '
+                         'verdict)')
+    ap.add_argument('--min-speedup', type=float, default=1.0,
+                    help='required quantized-vs-f32 chain speedup '
+                         '(min-of-N)')
+    ap.add_argument('--timeout', type=float, default=1800.0,
+                    help='bench subprocess timeout in seconds')
+    args = ap.parse_args()
+
+    try:
+        res = run_config13(timeout=args.timeout)
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        print('beam_gate: bench arm failed: %s' % exc,
+              file=sys.stderr)
+        return 2
+
+    speedup = float(res.get('value') or 0.0)
+    speed_ok = bool(res.get('quant_beats_f32')) and \
+        speedup >= args.min_speedup
+    class_ok = bool(res.get('within_class'))
+    det_ok = bool(res.get('deterministic'))
+    ok = speed_ok and class_ok and det_ok
+    artifact = dict(res,
+                    gate={'speedup': speedup,
+                          'min_speedup': args.min_speedup,
+                          'speed_ok': speed_ok,
+                          'within_class': class_ok,
+                          'deterministic': det_ok,
+                          'pass': ok,
+                          'round': os.environ.get('BF_BENCH_ROUND',
+                                                  '')})
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write('\n')
+    print('beam_gate: f32 %.1fms / quant %.1fms (winner %s) -> '
+          '%.2fx (need >= %.2fx), rel_err %.2e (class rtol %g), '
+          'deterministic=%s, %.1f Gop/s/chip %s'
+          % (res['arms']['f32']['ms_min'],
+             res['arms']['quant']['ms_min'],
+             res['arms']['quant'].get('winner'),
+             speedup, args.min_speedup,
+             res.get('beam_rel_err', float('nan')),
+             res.get('class_rtol', float('nan')),
+             det_ok, res.get('gops_per_s_per_chip', 0.0),
+             'PASS' if ok else 'FAIL'))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -19,12 +19,15 @@ Panes (matching the reference's information set):
     macro-gulp execution is amortizing dispatch — docs/perf.md),
     Shd = mesh width of the executing plan (1 single-device; N when
     the block runs sharded over an N-chip mesh — docs/parallel.md),
+    GOP/s = GEMM-class throughput (declared real ops per gulp over
+    the median gulp time; beamform/correlate blocks publish it —
+    docs/perf.md beamformer section; 0.0 for other blocks),
     command line
 
 Interactive curses UI with the reference's sort keys (i=pid, b=name,
 c=core, t=total, a=acquire, p=process, r=reserve, plus l=p99 gulp
-latency, w=p99 ring wait, e=age99, g=gulps-per-dispatch, and
-s=shards; pressing the active key again reverses; q quits).
+latency, w=p99 ring wait, e=age99, g=gulps-per-dispatch, s=shards,
+and o=GOP/s; pressing the active key again reverses; q quits).
 ``--once`` prints one plain-text snapshot instead (usable in
 pipes/tests).
 """
@@ -201,7 +204,11 @@ def collect_blocks(pids=None):
                 'age99': max(0.0, _num(perf.get('commit_age_p99'))),
                 # mesh width of the executing plan (docs/parallel.md;
                 # 1 = single device, N = sharded over N chips)
-                'shards': max(1.0, _num(perf.get('shards')) or 1.0)}
+                'shards': max(1.0, _num(perf.get('shards')) or 1.0),
+                # GEMM-class throughput (docs/perf.md beamformer
+                # section): declared real ops per gulp over the median
+                # gulp time, in Gop/s (0 = not a GEMM-class block)
+                'gops': max(0.0, _num(perf.get('gemm_gops_per_s')))}
     return rows
 
 
@@ -241,10 +248,10 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
                       dev['devCount']))
     out.append('')
     hdr = '%6s  %-24s  %4s  %5s  %8s  %8s  %8s  %8s  %8s  %8s  %8s' \
-          '  %8s  %5s  %3s  Cmd' \
+          '  %8s  %5s  %3s  %7s  Cmd' \
         % ('PID', 'Block', 'Core', '%CPU', 'Total', 'Acquire',
            'Process', 'Reserve', 'p50(ms)', 'p99(ms)', 'Wait99',
-           'Age99', 'G/D', 'Shd')
+           'Age99', 'G/D', 'Shd', 'GOP/s')
     out.append(hdr)
     order = sorted(rows, key=lambda k: rows[k][sort_key],
                    reverse=sort_rev)
@@ -256,20 +263,21 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
             pct = '%5s' % ' '
         name = d['name'].split('/')[-1][:24]
         out.append('%6i  %-24s  %4s  %5s  %8.3f  %8.3f  %8.3f  %8.3f'
-                   '  %8.2f  %8.2f  %8.2f  %8.2f  %5.1f  %3i  %s'
+                   '  %8.2f  %8.2f  %8.2f  %8.2f  %5.1f  %3i  %7.1f'
+                   '  %s'
                    % (d['pid'], name, d['core'], pct, d['total'],
                       d['acquire'], d['process'], d['reserve'],
                       d['p50'] * 1e3, d['p99'] * 1e3,
                       d['wait99'] * 1e3, d['age99'] * 1e3, d['gpd'],
-                      int(d['shards']),
-                      d['cmd'][:max(width - 148, 0)]))
+                      int(d['shards']), d['gops'],
+                      d['cmd'][:max(width - 157, 0)]))
     return out
 
 
 _SORT_KEYS = {'i': 'pid', 'b': 'name', 'c': 'core', 't': 'total',
               'a': 'acquire', 'p': 'process', 'r': 'reserve',
               'l': 'p99', 'w': 'wait99', 'g': 'gpd', 's': 'shards',
-              'e': 'age99'}
+              'e': 'age99', 'o': 'gops'}
 
 
 def run_curses(args):

@@ -22,6 +22,10 @@
 #   BENCH_BATCH_${ROUND}.json - macro-gulp batch gate (config 9 on CPU:
 #                               K=16 >= K=1 min-of-N, alternating arm
 #                               order; tools/batch_gate.py)
+#   BENCH_BEAM_${ROUND}.json  - quantized beamformer gate (config 13 on
+#                               CPU: quantized winner beats the f32
+#                               baseline arm, within accuracy class,
+#                               deterministic; tools/beam_gate.py)
 #   MULTICHIP_${ROUND}.json   - mesh pipeline gate (config 11 on an
 #                               8-device host mesh: sharded arm matches
 #                               single-device, zero-reshard plans;
@@ -149,6 +153,22 @@ for i in $(seq 1 400); do
         if [ "$grc" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) macro-gulp batch gate FAILED" >> "$LOG"
           exit "$grc"
+        fi
+      fi
+      # Quantized-beamformer gate: config 13 on the CPU backend — the
+      # measured quantized winner must beat the f32 baseline arm on
+      # the end-to-end chain (min-of-N, alternating arms), stay inside
+      # the declared accuracy class, and be run-to-run deterministic.
+      # A failure exits nonzero (the capture artifacts above are
+      # already in place).
+      if [ "${BF_SKIP_BEAM_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) quantized beamformer gate (config 13, CPU)" >> "$LOG"
+        python tools/beam_gate.py --out "BENCH_BEAM_${ROUND}.json" >> "$LOG" 2>&1
+        bmrc=$?
+        echo "$(date -u +%FT%TZ) beam gate rc=$bmrc" >> "$LOG"
+        if [ "$bmrc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) quantized beamformer gate FAILED" >> "$LOG"
+          exit "$bmrc"
         fi
       fi
       # Ring-bridge wire gate: config 10 on the CPU backend — wire v2
