@@ -1746,6 +1746,231 @@ def bench_beamform_chain(reps=3, ngulp=12):
     }
 
 
+# ---------------------------------------------------------------------------
+# config 14: closed-loop auto-tuning convergence (bifrost_tpu.autotune
+# — docs/autotune.md); gated by tools/autotune_gate.py into
+# BENCH_TUNE_${ROUND}.json
+# ---------------------------------------------------------------------------
+
+def bench_autotune(reps=5, nseq=2, gulp_per_seq=64, rounds=7):
+    """The convergence gate: from a deliberately DE-TUNED cold start
+    (K=1, sync_depth=1) the closed-loop controller must tune the
+    config-9 chain (host src -> copy h2d -> fused FFT->detect->reduce
+    -> copy d2h -> sink) to within ~5% of the hand-tuned optimum
+    (gulp_batch=16, sync_depth=4 — the config-9 winner), with outputs
+    byte-identical to the untuned arm.
+
+    The source emits ``nseq`` sequences so per-sequence tunables
+    (macro K) re-resolve MID-RUN — the controller's K steps land at
+    sequence boundaries, ``sync_depth`` per gulp.  ``rounds`` untimed
+    freeze-mode warm-up runs share one profile file: each run warm-
+    starts at the previous run's dumped knob state and climbs further
+    (the restart-and-resume deployment pattern docs/autotune.md
+    describes), so convergence does not depend on a single run being
+    long enough to climb K four doublings.
+
+    Arms (per-arm MINIMA over ``reps`` interleaved repetitions, arm
+    order alternating — the config-9 noise policy; outputs
+    byte-compared across ALL arms):
+
+    - ``detuned``  — K=1, sync_depth=1, no controller (cold start);
+    - ``tuned``    — the same cold start + the controller warm-started
+      at the converged profile (what the operator gets);
+    - ``hand``     — gulp_batch=16, sync_depth=4, no controller;
+    - ``hand_ctl`` — the hand-tuned arm with the controller running
+      but every knob ceiling pinned at its current value, so every
+      step() returns None and each knob converges WITHOUT a retune:
+      the pure converged-controller overhead the <2% criterion bounds.
+    """
+    import sys as _sys
+    import os as _os
+    import tempfile
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests'))
+    import bifrost_tpu as bf
+    from bifrost_tpu.autotune import load_profile
+    from bifrost_tpu.telemetry import counters, histograms
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    from util import (NumpySourceBlock, GatherSink, simple_header,
+                      _NumpyReader)
+
+    bf.enable_compilation_cache()
+    NT, NP, NF, RF = 64, 2, 256, 4
+    rng = np.random.RandomState(14)
+    raw = np.zeros((NT, NP, NF), dtype=np.dtype([('re', 'i1'),
+                                                 ('im', 'i1')]))
+    raw['re'] = rng.randint(-64, 64, raw.shape)
+    raw['im'] = rng.randint(-64, 64, raw.shape)
+    hdr = simple_header([-1, NP, NF], 'ci8',
+                        labels=['time', 'pol', 'fine_time'])
+
+    class _MultiSeqSource(NumpySourceBlock):
+        """nseq sequences of the same gulp list: per-sequence
+        tunables (macro K) re-resolve mid-run."""
+        def __init__(self, gulps, header, gulp_nframe, n, **kw):
+            NumpySourceBlock.__init__(self, gulps, header,
+                                      gulp_nframe, **kw)
+            self.sourcenames = ['seq%d' % i for i in range(n)]
+
+        def create_reader(self, sourcename):
+            return _NumpyReader(list(self._gulps))
+
+    gulps = [raw.copy() for _ in range(gulp_per_seq)]
+
+    def run_arm(tag, gulp_batch, sync_depth, autotune=False,
+                env=None):
+        save = {}
+        for k, v in (env or {}).items():
+            save[k] = _os.environ.get(k)
+            _os.environ[k] = v
+        counters.reset()
+        # histograms too: every arm builds freshly-named blocks, so
+        # keys accumulate across the ~30 in-process runs and the
+        # controller's telemetry.snapshot() would get linearly more
+        # expensive by the time the overhead pairs run — a cost a
+        # real single-pipeline deployment never pays
+        histograms.reset()
+        try:
+            with bf.Pipeline(gulp_batch=gulp_batch,
+                             sync_depth=sync_depth) as p:
+                src = _MultiSeqSource(gulps, hdr, NT, nseq)
+                b = bf.blocks.copy(src, space='tpu')
+                fb = bf.blocks.fused(
+                    b, [FftStage('fine_time', axis_labels='freq'),
+                        DetectStage('stokes', axis='pol'),
+                        ReduceStage('freq', RF)],
+                    name='TuneChain_%s' % tag)
+                b2 = bf.blocks.copy(fb, space='system')
+                sink = GatherSink(b2)
+                t0 = time.perf_counter()
+                p.run(autotune=autotune)
+                dt = time.perf_counter() - t0
+        finally:
+            for k, v in save.items():
+                if v is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = v
+        snap = counters.snapshot()
+        return dt, sink.result(), snap
+
+    with tempfile.TemporaryDirectory() as tdir:
+        profile_path = _os.path.join(tdir, 'tune_profile.json')
+        # fast cadence for the warm-up climb only; the MEASURED
+        # controller arms run at the deployment-default tick
+        # interval.  The raised min-gain makes each warm-up round
+        # ratchet AT LEAST one doubling per knob (a kept step pins
+        # unless it improves >15%; a revert needs a >15% regression —
+        # the per-doubling amortization gain on CPU is ~3%, inside
+        # run-to-run noise, so judging at the default 2% would let
+        # noise randomly revert good steps mid-climb); convergence
+        # across restart rounds is then deterministic while the
+        # revert guard still catches genuinely bad steps
+        warm_env = {'BF_AUTOTUNE_PROFILE': profile_path,
+                    'BF_AUTOTUNE_INTERVAL': '0.04',
+                    'BF_AUTOTUNE_COOLDOWN': '1',
+                    'BF_AUTOTUNE_MIN_GAIN': '0.15'}
+        tune_env = {'BF_AUTOTUNE_PROFILE': profile_path}
+        # ceilings pinned at the hand-tuned values: the controller
+        # runs its full read-telemetry/evaluate loop but no step is
+        # possible — pure converged overhead
+        pin_env = {'BF_AUTOTUNE_PROFILE':
+                   _os.path.join(tdir, 'unused_profile.json'),
+                   'BF_AUTOTUNE_MAX_BATCH': '16',
+                   'BF_AUTOTUNE_MAX_DEPTH': '4',
+                   'BF_AUTOTUNE_MAX_RING_BYTES': '1'}
+        # -- warm-up: let the controller climb, carrying the profile
+        retunes = 0
+        for _ in range(max(rounds, 1)):
+            _dt, _out, snap = run_arm('warm', 1, 1, autotune='freeze',
+                                      env=warm_env)
+            retunes += snap.get('autotune.retunes', 0)
+        prof = load_profile(profile_path) or {'knobs': {}}
+        # -- measured arms, interleaved with alternating order
+        arms = {
+            'detuned': dict(gulp_batch=1, sync_depth=1),
+            'tuned': dict(gulp_batch=1, sync_depth=1,
+                          autotune=True, env=tune_env),
+            'hand': dict(gulp_batch=16, sync_depth=4),
+            'hand_ctl': dict(gulp_batch=16, sync_depth=4,
+                             autotune=True, env=pin_env),
+        }
+        times = {a: [] for a in arms}
+        outputs = {}
+        ctl_retunes = 0
+        # one untimed pre-warm pass per arm: the first run of a fresh
+        # (K, sync_depth) configuration pays plan compile /
+        # persistent-cache deserialization that would otherwise
+        # pollute rep 0 (the same first-rep policy as the _bench_fn
+        # micro harness)
+        for a in arms:
+            kw = dict(arms[a])
+            run_arm('%s_warm' % a, kw.pop('gulp_batch'),
+                    kw.pop('sync_depth'), **kw)
+        for rep in range(max(reps, 1)):
+            order = list(arms) if rep % 2 == 0 \
+                else list(reversed(list(arms)))
+            for a in order:
+                kw = dict(arms[a])
+                dt, out, snap = run_arm(
+                    '%s_r%d' % (a, rep), kw.pop('gulp_batch'),
+                    kw.pop('sync_depth'), **kw)
+                times[a].append(dt)
+                outputs.setdefault(a, out)
+                if a == 'hand_ctl':
+                    ctl_retunes += snap.get('autotune.retunes', 0)
+    t_detuned = min(times['detuned'])
+    t_tuned = min(times['tuned'])
+    t_hand = min(times['hand'])
+    same = all(np.array_equal(outputs['detuned'], outputs[a])
+               for a in ('tuned', 'hand', 'hand_ctl'))
+    # INFORMATIONAL converged-overhead reading from the interleaved
+    # reps (paired per-rep median — hand_ctl and hand run adjacently
+    # in every sweep).  These ~250ms arms cannot resolve the 2%
+    # acceptance bound on a small CI host (single-run spread is
+    # +-20% and the controller's fixed per-run cost does not
+    # amortize); the BINDING overhead criterion is measured by
+    # tools/obs_overhead.py --stack autotune on the config-8 chain
+    # in fresh subprocesses (tools/autotune_gate.py runs it)
+    pairs = sorted(c / h for c, h in zip(times['hand_ctl'],
+                                         times['hand']))
+    overhead = pairs[len(pairs) // 2] - 1.0
+    gap = t_tuned / t_hand - 1.0
+    return {
+        'config': 'closed-loop auto-tune: de-tuned cold start '
+                  '(K=1,sync=1) vs hand-tuned (K=16,sync=4), '
+                  '%d seqs x %d gulps, %d warm-up rounds'
+                  % (nseq, gulp_per_seq, rounds),
+        'value': round(t_detuned / t_tuned, 2),
+        'unit': 'x speedup of the tuned arm over the de-tuned cold '
+                'start (min-of-%d)' % len(times['tuned']),
+        'arms': {a: {'ms_min': round(min(ts) * 1e3, 1),
+                     'ms_all': [round(t * 1e3, 1) for t in ts]}
+                 for a, ts in times.items()},
+        'converged_knobs': prof.get('knobs', {}),
+        'warmup_retunes': int(retunes),
+        'outputs_identical': bool(same),
+        'gap_to_hand_tuned_pct': round(gap * 100.0, 2),
+        # informational (see comment above): the binding <2% bound is
+        # judged on config 8 by tools/obs_overhead.py --stack autotune
+        'converged_overhead_pct_informational':
+            round(overhead * 100.0, 2),
+        'overhead_pairs_pct': [round((r - 1.0) * 100.0, 2)
+                               for r in pairs],
+        'converged_ctl_retunes': int(ctl_retunes),
+        # acceptance criteria tools/autotune_gate.py checks (the
+        # overhead bound is judged there, on config 8)
+        'converged_within_5pct': bool(t_tuned <= t_hand * 1.05),
+        'controller_acted': bool(retunes > 0),
+        'roofline': {
+            'bound': 'per-dispatch launch overhead + host sync '
+                     'stalls — the same ceilings the hand-tuned '
+                     'config-9 arm pays; the controller must find '
+                     'the amortized regime without an operator',
+        },
+    }
+
+
 ALL = {
     1: bench_sigproc_cpu,
     2: bench_spectroscopy,
@@ -1760,13 +1985,14 @@ ALL = {
     11: bench_mesh_pipeline,
     12: bench_e2e_observability,
     13: bench_beamform_chain,
+    14: bench_autotune,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-13; 0 = all')
+                    help='config number 1-14; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -1776,7 +2002,8 @@ def main(argv=None):
                     help='flagship pipeline Msamples/s for config 7')
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
-    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13) for c in todo)
+    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14)
+                   for c in todo)
     if need_dev:
         from bench import _backend_alive
         if not _backend_alive():
@@ -1957,6 +2184,14 @@ def _verify_config13():
     return p
 
 
+def _verify_config14():
+    """The auto-tune gate's hand-tuned endpoint (bench_autotune's
+    ``hand`` arm = the configuration the controller must converge to):
+    the verifier proving it clean is exactly the BF-E101 bound the
+    controller's retune gate enforces online (docs/autotune.md)."""
+    return _verify_chain(gulp_batch=16)
+
+
 def build_verify_topologies():
     """{name: builder} over every pipeline-shaped bench config.  Each
     builder returns a Pipeline, a list of Pipelines, or None when the
@@ -1969,6 +2204,7 @@ def build_verify_topologies():
         'config11_mesh': _verify_config11,
         'config12_e2e': _verify_config12,
         'config13_beamform': _verify_config13,
+        'config14_tune': _verify_config14,
     }
 
 

@@ -71,6 +71,7 @@ from . import io
 from . import trace
 from . import telemetry
 from . import supervision
+from . import autotune
 from . import testing
 from .utils import EnvVars, ObjectCache, enable_compilation_cache
 from .header_standard import enforce_header_standard

@@ -34,6 +34,11 @@ to the PR that introduced it):
   seam operations currently blocked inside the core; a watchdog timer
   (``BF_RINGCHECK_WAKE_SECS``, default 2s) flags any of them still
   blocked after the grace window.
+- **resize only at quiescence** — a storage re-layout (blocking
+  ``resize`` or a deferred ``request_resize`` application, the
+  auto-tuner's retune protocol — docs/autotune.md) must happen with NO
+  span open in the shadow state: applying one under a live span would
+  dangle its zero-copy view.
 
 Violations raise in the thread that performed the illegal operation
 (or, for deferred wake-violations, at the next seam touch on that
@@ -67,8 +72,8 @@ class RingProtocolError(RuntimeError):
     ``ring_name`` is the offending ring, ``invariant`` a stable slug of
     the violated rule (``commit_order``, ``double_commit``,
     ``double_release``, ``acquire_uncommitted``, ``guarantee_pin``,
-    ``poison_wake``), and the message embeds the ring's recent
-    span-history trace."""
+    ``poison_wake``, ``resize_quiescence``), and the message embeds the
+    ring's recent span-history trace."""
 
     def __init__(self, ring_name, invariant, detail, history=''):
         self.ring_name = ring_name
@@ -383,6 +388,34 @@ class _Shadow(object):
                 rd.pin = min(rd.opens) if rd.opens \
                     else max(rd.pin, rd.release_high)
             self._note('release', 'begin=%d' % begin)
+
+    # -- resize (deferred retune protocol; docs/autotune.md) ---------------
+    def resize_requested(self, contig, total):
+        with self.lock:
+            self._check_deferred()
+            self._note('resize.request', 'contig=%d total=%d'
+                       % (contig, total))
+
+    def resize_applied(self, nwrite_open, nread_open, size):
+        """A storage re-layout is about to happen: assert the shadow
+        state agrees the ring is quiescent (no open write reservation,
+        no open read span) — a core applying a resize under a live
+        span is handing out views that are about to dangle."""
+        with self.lock:
+            self._check_deferred()
+            open_reads = sum(len(rd.opens)
+                             for rd in self.readers.values())
+            if self.wspans or open_reads:
+                self._raise(
+                    'resize_quiescence',
+                    'storage re-layout to size=%d while spans are '
+                    'open (write reservations: %d shadow / %d core, '
+                    'open read spans: %d shadow / %d core) — a live '
+                    "span's zero-copy view would dangle; resizes "
+                    'must defer until the oldest open span releases'
+                    % (size, len(self.wspans), nwrite_open,
+                       open_reads, nread_open))
+            self._note('resize.apply', 'size=%d' % size)
 
     # -- poison ------------------------------------------------------------
     def poisoned_now(self):

@@ -33,11 +33,13 @@ import json
 import math
 import os
 import sys
+import threading
 from copy import deepcopy
 
 __all__ = ['Diagnostic', 'PipelineValidationError', 'CODES',
            'verify_pipeline', 'errors', 'warnings_', 'format_report',
-           'gate_run', 'lint_intercept', 'validate_mode']
+           'gate_run', 'lint_intercept', 'validate_mode',
+           'ring_capacity_floors', 'new_errors_vs', 'scope_overrides']
 
 #: stable diagnostic-code catalog: code -> one-line title.
 #: BF-Exxx = error (strict mode refuses to run), BF-Wxxx = warning,
@@ -143,6 +145,87 @@ def validate_mode():
 
 
 # ---------------------------------------------------------------------------
+# candidate-tunable overrides (the auto-tuner's retune gate)
+# ---------------------------------------------------------------------------
+
+_overrides_tl = threading.local()
+
+
+class scope_overrides(object):
+    """Thread-local candidate-tunable overrides consulted by the
+    checks' reads — how the auto-tuner's retune gate asks "what would
+    the verifier say at <candidate>?" WITHOUT mutating the live
+    pipeline while block threads concurrently resolve the same
+    tunables (docs/autotune.md).  Keys:
+
+    - ``gulp_batch``: pipeline-level macro K candidate; blocks that
+      pin their own value below the root keep it, mirroring
+      ``macro.retune_gulp_batch`` writing only the root scope.
+    - ``bridge_window``: ``{bridge sink block name: window}``.
+
+    Overrides only shape the verdict on the calling thread, so a
+    concurrent ``Pipeline.validate()`` elsewhere still sees the live
+    configuration."""
+
+    def __init__(self, overrides):
+        self.overrides = dict(overrides or {})
+
+    def __enter__(self):
+        _overrides_tl.value = self.overrides
+        return self
+
+    def __exit__(self, *exc):
+        _overrides_tl.value = None
+        return False
+
+
+def _overrides():
+    return getattr(_overrides_tl, 'value', None) or {}
+
+
+def _pins_below_root(block, attr):
+    """Whether any scope from ``block`` up to (but excluding) the root
+    pipeline sets ``attr`` itself — such a pin survives a root-level
+    retune, so a root-level override must not replace it."""
+    s = block
+    while s is not None:
+        parent = s.__dict__.get('_parent_scope')
+        if parent is None:
+            return False             # s is the root
+        if s.__dict__.get('_' + attr) is not None:
+            return True
+        s = parent
+    return False
+
+
+def _static_k_requested(block):
+    """``resolve_gulp_batch(block)`` with any ``gulp_batch`` candidate
+    from :class:`scope_overrides` applied at the root."""
+    from ..macro import resolve_gulp_batch
+    ov = _overrides()
+    if 'gulp_batch' in ov and not _pins_below_root(block,
+                                                   'gulp_batch'):
+        try:
+            return max(int(ov['gulp_batch']), 1)
+        except (TypeError, ValueError):
+            pass
+    return resolve_gulp_batch(block)
+
+
+def _bridge_window(b):
+    """Effective credit window of bridge sink ``b``, honoring any
+    ``bridge_window`` candidate from :class:`scope_overrides`."""
+    ov = _overrides().get('bridge_window') or {}
+    w = ov.get(getattr(b, 'name', None))
+    if w is None:
+        w = getattr(b, 'window', 1)
+    try:
+        return int(w)
+    except (TypeError, ValueError):
+        return 1
+
+
+# ---------------------------------------------------------------------------
 # graph model
 # ---------------------------------------------------------------------------
 
@@ -204,10 +287,9 @@ def _macro_static_k(block, overlap=None, igulp=None):
     block safety, topology, guarantee, plus overlap and nframe
     linearity when the verifier knows them), else 1.  Returns
     ``(k, reason)``; reason is None when batching engages."""
-    from ..macro import resolve_gulp_batch
     from ..pipeline import MultiTransformBlock
     try:
-        k = resolve_gulp_batch(block)
+        k = _static_k_requested(block)
     except Exception:
         return 1, None
     if k <= 1:
@@ -376,7 +458,7 @@ def _consumer_geometry(g, b, ring, stream, diags):
     hold = span
     from ..blocks.bridge import BridgeSink
     if isinstance(b, BridgeSink):
-        hold = span * max(int(getattr(b, 'window', 1)), 1)
+        hold = span * max(_bridge_window(b), 1)
     return span, hold, overlap
 
 
@@ -427,7 +509,7 @@ def _check_ring_sizing(g, diags):
                 # RingSender resizes the source ring itself at run
                 # time (io/bridge.py: buffer_factor=window+2), so the
                 # negotiated capacity is never below that
-                req = max(req, (getattr(b, 'window', 1) + 2) * span)
+                req = max(req, (_bridge_window(b) + 2) * span)
             requests.append(req)
             cons.append((b, span, hold, bnf, bf, req))
         if not pins:
@@ -467,7 +549,7 @@ def _check_ring_sizing(g, diags):
         # window+1 spans silently caps the credit pipeline
         for b, span, hold, bnf, bf, req in cons:
             if isinstance(b, BridgeSink) and \
-                    getattr(b, 'window', 1) > 1 and \
+                    _bridge_window(b) > 1 and \
                     provided < hold + writer_span:
                 diags.append(Diagnostic(
                     'BF-W110',
@@ -476,8 +558,8 @@ def _check_ring_sizing(g, diags):
                     'frames: the credit window is capped at ~%d '
                     'span(s), losing pipelining — raise the ring '
                     'buffering or lower BF_BRIDGE_WINDOW'
-                    % (b.name, b.window, hold, _ring_name(ring),
-                       provided,
+                    % (b.name, _bridge_window(b), hold,
+                       _ring_name(ring), provided,
                        max((provided - writer_span) // max(span, 1),
                            1)),
                     block=b.name, ring=_ring_name(ring)))
@@ -606,7 +688,9 @@ def _check_bridge(g, diags):
     for b in g.blocks:
         if not isinstance(b, BridgeSink):
             continue
-        req_w = getattr(b, 'requested_window', None)
+        ov_w = (_overrides().get('bridge_window') or {}).get(b.name)
+        req_w = ov_w if ov_w is not None \
+            else getattr(b, 'requested_window', None)
         if req_w is not None and int(req_w) < 1:
             diags.append(Diagnostic(
                 'BF-E150',
@@ -622,23 +706,22 @@ def _check_bridge(g, diags):
                     'bridge sink %r requests CRC on the v1 wire, '
                     'which has no integrity field: the stream will '
                     'ship unchecked' % b.name, block=b.name))
-            if getattr(b, 'window', 1) > 1:
+            if _bridge_window(b) > 1:
                 diags.append(Diagnostic(
                     'BF-W152',
                     'bridge sink %r requests a %d-span credit window '
                     'on the v1 wire, which is strictly '
                     'send-and-wait: the window setting is ignored'
-                    % (b.name, b.window), block=b.name))
+                    % (b.name, _bridge_window(b)), block=b.name))
 
 
 def _check_macro(g, diags):
     from ..pipeline import MultiTransformBlock
-    from ..macro import resolve_gulp_batch
     for b in g.blocks:
         if not isinstance(b, MultiTransformBlock):
             continue
         try:
-            if resolve_gulp_batch(b) <= 1:
+            if _static_k_requested(b) <= 1:
                 continue
         except Exception:
             continue
@@ -737,6 +820,91 @@ def _check_quantization(g, diags):
                     'quantization ~2^-7) if the science tolerates it'
                     % (b.name, dtype, eng.accuracy),
                     block=b.name, ring=_ring_name(_base(irings[0]))))
+
+
+# ---------------------------------------------------------------------------
+# runtime-facing sizing model (the auto-tuner's safety floor)
+# ---------------------------------------------------------------------------
+
+def ring_capacity_floors(pipeline):
+    """The BF-E101 deadlock-freedom bound per ring, as a runtime-facing
+    dict the closed-loop auto-tuner (``bifrost_tpu.autotune``,
+    docs/autotune.md) uses as a HARD FLOOR for online ring retunes:
+
+        {ring_name: {'frames':      required frames (writer-resident
+                                    span + largest guaranteed pin),
+                     'bytes':       the same in bytes, or None when the
+                                    frame layout could not be derived,
+                     'writer_span': frames the producer keeps resident
+                                    (macro K * G),
+                     'max_pin':     frames the largest guaranteed
+                                    reader can pin at once,
+                     'unproven':    True when some consumer's geometry
+                                    was unknowable statically (the
+                                    floor is then a lower bound)}}
+
+    Uses the SAME model as the ``BF-E101``/``BF-W102`` checks — macro
+    K resolved from the current scope tunables, bridge windows counted
+    as multi-span holds — so a controller that never sizes a ring
+    below this floor can never tune into a configuration
+    ``verify_pipeline`` would reject for sizing.  Rings whose gulp
+    geometry is entirely unknown are omitted (nothing is provable
+    there, and the controller must not touch what it cannot bound)."""
+    from ..ring import _tensor_info
+    g = _Graph(pipeline)
+    for b in g.blocks:
+        try:
+            b.cache_scope_hierarchy()
+        except Exception:
+            pass
+    diags = []
+    try:
+        _propagate(g, diags)
+    except Exception:
+        return {}
+    floors = {}
+    for rid, stream in g.streams.items():
+        producer = g.producers.get(rid)
+        if producer is None or stream.gulp is None:
+            continue
+        ring = g.rings[rid]
+        kw, _r = _macro_static_k(producer)
+        writer_span = kw * stream.gulp
+        max_pin = 0
+        unproven = False
+        for b in g.consumers.get(rid, ()):
+            span, hold, _o = _consumer_geometry(g, b, ring, stream,
+                                                diags)
+            if span is None:
+                unproven = True
+                continue
+            if bool(getattr(b, 'guarantee', True)):
+                max_pin = max(max_pin, hold)
+        required = writer_span + max_pin
+        nbyte = None
+        if stream.header is not None:
+            try:
+                nbyte = required * \
+                    _tensor_info(stream.header)['frame_nbyte']
+            except Exception:
+                nbyte = None
+        floors[_ring_name(ring)] = {
+            'frames': required, 'bytes': nbyte,
+            'writer_span': writer_span, 'max_pin': max_pin,
+            'unproven': unproven}
+    return floors
+
+
+def new_errors_vs(baseline_diags, candidate_diags):
+    """The BF-E diagnostics in ``candidate_diags`` not already present
+    (by (code, block, ring) identity) in ``baseline_diags`` — how the
+    auto-tuner asks "would this retune INTRODUCE a configuration the
+    static analyzer rejects?" without being blocked by pre-existing
+    errors the operator chose to run with (``BF_VALIDATE=warn``)."""
+    seen = {(d.code, d.block, d.ring) for d in baseline_diags
+            if d.is_error}
+    return [d for d in candidate_diags
+            if d.is_error and (d.code, d.block, d.ring) not in seen]
 
 
 _CHECKS = (_check_tensor_contracts, _check_ring_sizing,

@@ -170,6 +170,20 @@ class BridgeSink(_BridgeBlock):
     def define_output_nframes(self, input_nframes):
         return []
 
+    def retune_window(self, window):
+        """Runtime credit-window retune (the auto-tuner's knob —
+        docs/autotune.md): updates this block's ``window`` (what a
+        restarted sender would be built with) and the LIVE sender's
+        window when one is running.  A grown window requests the extra
+        source-ring depth through the deferred-resize protocol; see
+        :meth:`~bifrost_tpu.io.bridge.RingSender.retune_window`."""
+        window = max(int(window), 1)
+        self.window = window
+        sender = self._sender
+        if sender is not None:
+            sender.retune_window(window)
+        return window
+
 
 class BridgeSource(_BridgeBlock):
     """0-in/1-out block receiving a bridged stream into its output

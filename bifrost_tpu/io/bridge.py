@@ -466,6 +466,9 @@ class RingSender(object):
         #: header's trace context + local sequence ordinal)
         self._cur_trace = None
         self._cur_seq = -1
+        #: bytes of one span at the current sequence's batch geometry —
+        #: what a runtime window retune needs to grow the source ring
+        self._cur_span_nbyte = 0
 
     # -- public ------------------------------------------------------------
     def prime(self):
@@ -478,6 +481,29 @@ class RingSender(object):
         if self._seqs is None:
             self._seqs = self._iter_sequences()
         return self
+
+    def retune_window(self, window):
+        """Runtime credit-window retune (the auto-tuner's knob —
+        docs/autotune.md).  ``self.window`` is read by ``_wait_credit``
+        on every span, so the new value takes effect immediately; a
+        GROWN window additionally needs ``window + 2`` spans of source
+        ring depth (the same sizing rule the per-sequence ``resize``
+        applies), requested through the non-blocking deferred-resize
+        protocol so this never stalls the send loop.  Until the ring
+        growth lands, the wider window self-caps at the available
+        depth (docs/networking.md, BF-W110 semantics) — still safe,
+        just not yet fully pipelined."""
+        window = max(int(window), 1)
+        self.window = window
+        nbyte = self._cur_span_nbyte
+        if nbyte:
+            try:
+                self.ring.request_resize(nbyte, (window + 2) * nbyte)
+            except Exception:
+                pass
+        with self._credit:
+            self._credit.notify_all()
+        return window
 
     def run(self):
         self.prime()
@@ -1038,6 +1064,11 @@ class RingSender(object):
                     seq.resize(batch, buffer_factor=self.window + 2)
                 except Exception:
                     pass
+                try:
+                    self._cur_span_nbyte = \
+                        batch * seq.tensor['frame_nbyte']
+                except Exception:
+                    self._cur_span_nbyte = 0
                 offset = 0
                 while not self._stop_requested():
                     self._wait_credit()

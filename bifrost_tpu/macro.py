@@ -54,8 +54,8 @@ from __future__ import annotations
 
 import os
 
-__all__ = ['resolve_gulp_batch', 'chain_batch_mode',
-           'build_batched_fn', 'fallback_reason']
+__all__ = ['resolve_gulp_batch', 'retune_gulp_batch',
+           'chain_batch_mode', 'build_batched_fn', 'fallback_reason']
 
 
 def resolve_gulp_batch(scope):
@@ -73,6 +73,19 @@ def resolve_gulp_batch(scope):
     except (TypeError, ValueError):
         return 1
     return max(k, 1)
+
+
+def retune_gulp_batch(scope, k):
+    """Runtime macro-batch retune — the closed-loop auto-tuner's write
+    path (docs/autotune.md).  Sets the ``gulp_batch`` scope tunable on
+    ``scope`` (normally the Pipeline root, so blocks that pinned their
+    own value keep it) and lets the NEXT sequence's
+    ``_resolve_macro_batch`` pick it up; sequences already in flight
+    keep their active batch — a macro span's geometry cannot change
+    mid-sequence.  Returns the clamped value actually set."""
+    k = max(int(k), 1)
+    scope._gulp_batch = k
+    return k
 
 
 def chain_batch_mode(stages):

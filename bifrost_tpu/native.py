@@ -48,6 +48,10 @@ def _declare(lib):
         'bft_ring_create': ([P(c.c_void_p), c.c_char_p], c.c_int),
         'bft_ring_destroy': ([c.c_void_p], c.c_int),
         'bft_ring_resize': ([c.c_void_p, ll, ll, ll], c.c_int),
+        'bft_ring_request_resize': ([c.c_void_p, ll, ll, ll,
+                                     P(c.c_int)], c.c_int),
+        'bft_ring_resize_pending': ([c.c_void_p, P(c.c_int)], c.c_int),
+        'bft_ring_resize_hold': ([c.c_void_p, c.c_int], c.c_int),
         'bft_ring_set_core': ([c.c_void_p, c.c_int], c.c_int),
         'bft_ring_geometry': ([c.c_void_p, P(P(c.c_ubyte)), P(ll), P(ll),
                                P(ll)], c.c_int),

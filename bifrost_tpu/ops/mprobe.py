@@ -13,7 +13,13 @@ generalizes it for other ops (LinAlg GEMM paths):
 - the disk entry is written only when every candidate ran clean AND the
   winner's margin over the runner-up exceeds a noise threshold — a
   transient compile failure or a coin-flip ranking is re-measured next
-  session instead of being frozen (ADVICE r4).
+  session instead of being frozen (ADVICE r4);
+- a COIN-FLIP winner (margin inside the noise threshold — the flag
+  ``tools/mprobe_report.py`` renders) is additionally re-raced WITHIN
+  a session after ``BF_MPROBE_REPROBE`` uses (default 200; 0 disables)
+  instead of being served from the in-process cache forever — long-
+  lived pipelines whose shapes shift under the auto-tuner
+  (docs/autotune.md) keep their kernel races honest.
 
 Reference analogue: the reference hand-picks kernels per shape at
 compile time (src/linalg.cu:210-226 drops to a custom cherk below
@@ -29,6 +35,45 @@ import time
 __all__ = ['select', 'peek', 'backend_tag', 'cache_path']
 
 _cache = {}
+#: (name, full_key) -> uses served from cache for a COIN-FLIP winner
+#: (margin inside the noise threshold); when a counter reaches the
+#: BF_MPROBE_REPROBE budget the entry is evicted and re-measured
+_flip_uses = {}
+
+
+def _reprobe_budget():
+    """Cache-uses budget for coin-flip winners (``BF_MPROBE_REPROBE``,
+    default 200; 0 disables the re-race)."""
+    try:
+        return int(os.environ.get('BF_MPROBE_REPROBE', '') or 200)
+    except ValueError:
+        return 200
+
+
+def _coin_flip(ms, noise):
+    """Whether a measurement's ranking is inside the noise threshold
+    (the same margin tools/mprobe_report.py flags as COIN-FLIP)."""
+    try:
+        ranked = sorted(float(v) for v in ms.values())
+    except (TypeError, ValueError):
+        return False
+    return (len(ranked) >= 2 and ranked[0] > 0 and
+            ranked[1] < ranked[0] * noise)
+
+
+def _flip_spent(name, full_key, ms, noise):
+    """Count one cache use of a coin-flip winner; True when the
+    reprobe budget is exhausted (caller evicts and re-measures)."""
+    budget = _reprobe_budget()
+    if budget <= 0 or not _coin_flip(ms, noise):
+        return False
+    key = (name, full_key)
+    uses = _flip_uses.get(key, 0) + 1
+    if uses >= budget:
+        _flip_uses.pop(key, None)
+        return True
+    _flip_uses[key] = uses
+    return False
 
 
 def peek(name, key):
@@ -111,8 +156,13 @@ def select(name, key, candidates, make_args, n_reps=3, noise=1.10,
     """
     full_key = '%s|%s' % (backend_tag(), key)
     fam = _cache.setdefault(name, {})
+    reprobe = False
     if full_key in fam and fam[full_key][0] in candidates:
-        return fam[full_key]
+        entry = fam[full_key]
+        if not _flip_spent(name, full_key, entry[1], noise):
+            return entry
+        del fam[full_key]            # coin-flip budget spent: re-race
+        reprobe = True
     path = cache_path(name)
     disk = {}
     try:
@@ -121,10 +171,20 @@ def select(name, key, candidates, make_args, n_reps=3, noise=1.10,
     except (OSError, ValueError):
         pass
     if full_key in disk and disk[full_key].get('winner') in candidates:
-        entry = (disk[full_key]['winner'], disk[full_key].get('ms', {}),
-                 {})
-        fam[full_key] = entry
-        return entry
+        if reprobe:
+            # the spent entry usually ALSO sits on disk (persisted
+            # under an older pre-decisive policy): reloading it here
+            # would reset the budget and serve the stale winner
+            # forever — drop it and fall through to the re-race
+            disk.pop(full_key, None)
+        else:
+            entry = (disk[full_key]['winner'],
+                     disk[full_key].get('ms', {}), {})
+            # a disk coin flip is budgeted like the in-process case
+            if not _flip_spent(name, full_key, entry[1], noise):
+                fam[full_key] = entry
+                return entry
+            disk.pop(full_key, None)
 
     import jax
     args = make_args()

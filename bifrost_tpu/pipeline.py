@@ -52,7 +52,7 @@ __all__ = ['Pipeline', 'BlockScope', 'Block', 'SourceBlock',
            'get_default_pipeline', 'get_current_block_scope',
            'block_scope', 'block_view', 'get_ring', 'izip',
            'PipelineInitError', 'EndOfDataStop', 'RingPoisonedError',
-           'resolve_donate']
+           'resolve_donate', 'resolve_sync_depth']
 
 
 def izip(*iterables):
@@ -100,6 +100,29 @@ def resolve_donate(scope):
     if d is not None:
         return bool(d)
     return os.environ.get('BF_DONATE', '0') == '1'
+
+
+def resolve_sync_depth(scope):
+    """Effective dispatch-ahead depth for ``scope``: the ``sync_depth``
+    tunable when set anywhere in the scope chain, else the
+    BF_SYNC_DEPTH environment default, else
+    :data:`BlockScope.DEFAULT_SYNC_DEPTH`.  Read per gulp by
+    ``Block._sync_gulp``, which makes the knob retunable at runtime —
+    the closed-loop auto-tuner (docs/autotune.md) adjusts
+    ``pipeline._sync_depth`` online and the next drain honors it."""
+    d = scope.sync_depth
+    if d is None:
+        try:
+            d = int(os.environ.get('BF_SYNC_DEPTH', '') or
+                    BlockScope.DEFAULT_SYNC_DEPTH)
+        except ValueError:
+            d = BlockScope.DEFAULT_SYNC_DEPTH
+    try:
+        # 0 is legal: zero run-ahead, a hard drain every gulp (the
+        # tightest device-memory bound)
+        return max(int(d), 0)
+    except (TypeError, ValueError):
+        return BlockScope.DEFAULT_SYNC_DEPTH
 
 
 class BlockScope(object):
@@ -448,7 +471,7 @@ class Pipeline(BlockScope):
                 if parent is not None and blk in parent._children:
                     parent._children.remove(blk)
 
-    def run(self):
+    def run(self, autotune=None):
         """Launch every block thread and supervise them to completion.
 
         Failure semantics (docs/robustness.md): a block that raises is
@@ -459,6 +482,15 @@ class Pipeline(BlockScope):
         the original traceback.  KeyboardInterrupt triggers a clean
         ``shutdown()``.  The stall watchdog is armed when
         ``watchdog_secs`` / ``BF_WATCHDOG_SECS`` is set.
+
+        ``autotune`` starts the closed-loop auto-tuner
+        (:mod:`bifrost_tpu.autotune`, docs/autotune.md): ``True`` (or
+        ``BF_AUTOTUNE=1`` when left ``None``) retunes the hot-path
+        knobs online from live telemetry; ``'freeze'`` (or
+        ``BF_AUTOTUNE=freeze``) additionally pins the converged
+        configuration and dumps it as a reusable JSON profile
+        (``BF_AUTOTUNE_PROFILE``); ``False`` forces it off regardless
+        of the environment.
         """
         from .supervision import Supervisor
         if self.auto_fuse:
@@ -514,19 +546,39 @@ class Pipeline(BlockScope):
         _ringcheck.reconfigure()
         self._shutting_down = False
         self.supervisor = Supervisor(self)
-        self.threads = [threading.Thread(target=block.run, name=block.name)
-                        for block in self.blocks]
-        for block, thread in zip(self.blocks, self.threads):
-            block._thread = thread
-            thread.daemon = True
-            thread.start()
-        self.synchronize_block_initializations()
-        self.supervisor.start_watchdog(self.watchdog_secs)
-        # periodic metrics publisher: telemetry/metrics +
-        # rings_flow/<name> proclogs, BF_METRICS_FILE Prometheus
-        # textfile (docs/observability.md)
-        metrics = _metrics_exporter.MetricsPublisher(self)
-        metrics.start()
+        # closed-loop auto-tuner (docs/autotune.md): reads
+        # telemetry.snapshot(rates=...) and retunes gulp_batch /
+        # sync_depth / bridge windows / ring capacity online through
+        # the safe retune protocol; every decision lands on the
+        # autotune.* counters + the analysis/autotune proclog.
+        # Started BEFORE the block threads so a warm-start profile
+        # (the last converged config) is applied before the first
+        # sequence resolves its per-sequence tunables — otherwise the
+        # first sequence races the profile and can run de-tuned
+        from . import autotune as _autotune
+        tuner = _autotune.maybe_start(self, autotune)
+        try:
+            self.threads = [threading.Thread(target=block.run,
+                                             name=block.name)
+                            for block in self.blocks]
+            for block, thread in zip(self.blocks, self.threads):
+                block._thread = thread
+                thread.daemon = True
+                thread.start()
+            self.synchronize_block_initializations()
+            self.supervisor.start_watchdog(self.watchdog_secs)
+            # periodic metrics publisher: telemetry/metrics +
+            # rings_flow/<name> proclogs, BF_METRICS_FILE Prometheus
+            # textfile (docs/observability.md)
+            metrics = _metrics_exporter.MetricsPublisher(self)
+            metrics.start()
+        except BaseException:
+            # init failed before the main join/finally below: don't
+            # leave the already-started controller ticking against a
+            # pipeline that never ran
+            if tuner is not None:
+                tuner.stop(wait=False)
+            raise
         # Join in short slices (not one unbounded join): dead threads
         # are detected promptly, KeyboardInterrupt is serviced between
         # slices, and a fatal failure bounds the wind-down wait at
@@ -554,6 +606,8 @@ class Pipeline(BlockScope):
             raise
         finally:
             self.supervisor.stop_watchdog()
+            if tuner is not None:
+                tuner.stop()             # publishes the final knob state
             metrics.stop()               # publishes one final snapshot
             _spans.export_if_configured()
         self.supervisor.raise_if_failed()
@@ -995,8 +1049,7 @@ class Block(BlockScope):
         import os
         from . import xfer
         from .telemetry import counters
-        depth = self.sync_depth if self.sync_depth is not None \
-            else BlockScope.DEFAULT_SYNC_DEPTH
+        depth = resolve_sync_depth(self)
         strict = self.sync_strict
         if strict is None:
             strict = os.environ.get('BF_SYNC_STRICT', '0') == '1'

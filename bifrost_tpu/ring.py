@@ -516,6 +516,13 @@ class Ring(object):
         #: committed-but-in-flight D2H fills (xfer.HostFill): readers
         #: gate on overlapping fills before touching span data
         self._pending_fills = []
+        #: deferred geometry change (docs/autotune.md): target
+        #: (contiguous, total, nringlet) recorded by request_resize()
+        #: while spans were open, applied by the span-release path the
+        #: moment the ring goes quiescent — a runtime retune must not
+        #: block the caller NOR re-layout storage under a live span's
+        #: zero-copy view
+        self._pending_resize = None
         #: set by poison(): the exception that killed the producing /
         #: consuming side; blocking ops then raise RingPoisonedError
         self._poisoned = None
@@ -540,6 +547,15 @@ class Ring(object):
         with self._lock:
             if total_bytes is None:
                 total_bytes = contiguous_bytes * 4
+            # fold in any deferred request_resize target: the blocking
+            # path reaches quiescence anyway, so the pending geometry
+            # can land here instead of waiting for a span release
+            if self._pending_resize is not None:
+                pc, pt, pn = self._pending_resize
+                contiguous_bytes = max(contiguous_bytes, pc)
+                total_bytes = max(total_bytes, pt)
+                nringlet = max(nringlet, pn)
+                self._pending_resize = None
             ghost = max(self._ghost, contiguous_bytes)
             size = max(self._size, total_bytes)
             nringlet = max(self._nringlet, nringlet)
@@ -563,15 +579,102 @@ class Ring(object):
                         f.wait()
                 finally:
                     self._lock.acquire()
-            old = copy(self._storage)
-            old.buf = getattr(self._storage, 'buf', None)
-            self._storage.allocate(size, ghost, nringlet,
-                                   self._tail, self._head, old=old,
-                                   core=self.core)
-            self._size, self._ghost, self._nringlet = size, ghost, nringlet
-            self._write_cond.notify_all()
-            self._read_cond.notify_all()
+            self._apply_geometry_locked(size, ghost, nringlet)
         self._write_ring_proclog()
+
+    def _apply_geometry_locked(self, size, ghost, nringlet):
+        """Re-layout storage to the new geometry.  Must hold the lock
+        AND the ring must be quiescent (no open spans, no incomplete
+        fills targeting the buffer) — the protocol checker
+        (BF_RINGCHECK=1) asserts the latter against its shadow state."""
+        rc = _ringcheck.hook(self)
+        if rc is not None:
+            rc.resize_applied(self._nwrite_open, self._nread_open,
+                              size)
+        old = copy(self._storage)
+        old.buf = getattr(self._storage, 'buf', None)
+        self._storage.allocate(size, ghost, nringlet,
+                               self._tail, self._head, old=old,
+                               core=self.core)
+        self._size, self._ghost, self._nringlet = size, ghost, nringlet
+        self._write_cond.notify_all()
+        self._read_cond.notify_all()
+
+    # -- deferred (non-blocking) resize -----------------------------------
+    def request_resize(self, contiguous_bytes, total_bytes=None,
+                       nringlet=1):
+        """Non-blocking grow request (the auto-tuner's retune protocol,
+        docs/autotune.md): apply the geometry change NOW when the ring
+        is quiescent, else record it and let the span-release path
+        apply it the moment the oldest open span releases and no other
+        span remains open.  Never blocks the caller and never
+        re-layouts storage under a live span's zero-copy view.
+
+        Geometry semantics match :meth:`resize` (MAX-negotiated: the
+        ring only ever grows).  Returns True when the new geometry is
+        live on return, False while it is still pending — callers that
+        need certainty re-issue the request (idempotent) or read
+        :attr:`total_span`."""
+        with self._lock:
+            if total_bytes is None:
+                total_bytes = contiguous_bytes * 4
+            ghost = max(self._ghost, contiguous_bytes)
+            size = max(self._size, total_bytes)
+            nringlet = max(self._nringlet, nringlet)
+            if (size == self._size and ghost == self._ghost and
+                    nringlet == self._nringlet):
+                return True              # no-op: already that large
+            if self._pending_resize is not None:
+                pc, pt, pn = self._pending_resize
+                contiguous_bytes = max(contiguous_bytes, pc)
+                total_bytes = max(total_bytes, pt)
+                nringlet = max(nringlet, pn)
+            self._pending_resize = (contiguous_bytes, total_bytes,
+                                    nringlet)
+            rc = _ringcheck.hook(self)
+            if rc is not None:
+                rc.resize_requested(contiguous_bytes, total_bytes)
+                if faults.armed('ring.corrupt.resize_under_span',
+                                self.name):
+                    # simulate a buggy core re-layouting storage NOW,
+                    # under whatever spans are open
+                    rc.resize_applied(self._nwrite_open,
+                                      self._nread_open,
+                                      int(total_bytes))
+            applied = self._maybe_apply_pending_locked()
+        if applied:
+            self._write_ring_proclog()
+        return applied
+
+    @property
+    def resize_pending(self):
+        """Whether a deferred request_resize has not yet applied."""
+        return self._pending_resize is not None
+
+    def _maybe_apply_pending_locked(self):
+        """Apply a pending deferred resize if the ring is quiescent
+        RIGHT NOW (no open spans, no incomplete deferred fills whose
+        cached views would dangle).  Must hold the lock.  Returns True
+        when the pending geometry (if any) is live on return."""
+        if self._pending_resize is None:
+            return True
+        if self._nwrite_open or self._nread_open:
+            return False
+        if any(not f.done for f in self._pending_fills):
+            # a deferred D2H fill still targets the old buffer; stay
+            # pending — the next release/commit (or the fill-draining
+            # blocking resize at sequence start) retries
+            return False
+        contig, total, nringlet = self._pending_resize
+        self._pending_resize = None
+        ghost = max(self._ghost, contig)
+        size = max(self._size, total)
+        nringlet = max(self._nringlet, nringlet)
+        if (size == self._size and ghost == self._ghost and
+                nringlet == self._nringlet):
+            return True
+        self._apply_geometry_locked(size, ghost, nringlet)
+        return True
 
     def _write_ring_proclog(self):
         """Record this ring's geometry under rings/<name> for the
@@ -807,8 +910,15 @@ class Ring(object):
                 if cb > 0:
                     sp._finalize_storage(cb)
                 self._nwrite_open -= 1
+            # quiescence point: a deferred request_resize applies the
+            # moment no span remains open (docs/autotune.md)
+            resized = False
+            if self._pending_resize is not None:
+                resized = self._maybe_apply_pending_locked()
             self._read_cond.notify_all()
             self._span_cond.notify_all()
+        if resized:
+            self._write_ring_proclog()   # monitors see the new size
         if commit_nbyte:
             self._note_commit(wspan, commit_nbyte)
 
@@ -985,8 +1095,16 @@ class Ring(object):
                 self._guarantees[id(rseq)] = max(
                     self._guarantees[id(rseq)], g)
             self._nread_open -= 1
+            # quiescence point for deferred resize (docs/autotune.md):
+            # "the oldest open span releases" — apply once no span at
+            # all remains open
+            resized = False
+            if self._pending_resize is not None:
+                resized = self._maybe_apply_pending_locked()
             self._write_cond.notify_all()
             self._span_cond.notify_all()
+        if resized:
+            self._write_ring_proclog()   # monitors see the new size
 
     def _close_read_seq(self, rseq):
         with self._lock:

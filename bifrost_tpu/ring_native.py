@@ -17,6 +17,8 @@ import threading
 import numpy as np
 
 from . import native
+from .analysis import ringcheck as _ringcheck
+from .testing import faults
 from .ring import (Ring, EndOfDataStop, WouldBlock, RingPoisonedError,
                    _observability)
 
@@ -149,6 +151,10 @@ class NativeRing(Ring):
         #: live native reader ids — poison() releases their guarantees
         #: so writers blocked inside bft_ring_reserve wake up
         self._native_reader_ids = set()
+        #: deferred D2H fills holding a C-side resize hold: each one's
+        #: cached numpy view into the native buffer would dangle under
+        #: a deferred-resize re-layout (released by _prune_fill_holds)
+        self._fill_holds = []
 
     def __del__(self):
         try:
@@ -192,6 +198,81 @@ class NativeRing(Ring):
             -1 if total_bytes is None else total_bytes, nringlet),
             'resize')
         self._write_ring_proclog()
+
+    def request_resize(self, contiguous_bytes, total_bytes=None,
+                       nringlet=1):
+        """Non-blocking grow request (see :meth:`Ring.request_resize`):
+        recorded in the C core and applied by the native commit /
+        release paths the moment the ring goes quiescent.  Deferred
+        D2H fills block the apply through C-side resize holds
+        (released here and at the acquire-path fill prunes once the
+        fill completes), so a re-layout can never dangle a fill's
+        cached buffer view.  Idempotent — callers re-issue until it
+        reports True (applied)."""
+        self._prune_fill_holds()
+        rc = _ringcheck.hook(self)
+        if rc is not None:
+            total = total_bytes if total_bytes is not None \
+                else contiguous_bytes * 4
+            rc.resize_requested(contiguous_bytes, total)
+            if faults.armed('ring.corrupt.resize_under_span',
+                            self.name):
+                rc.resize_applied(self._nwrite_open,
+                                  self._nread_open, int(total))
+        applied = ctypes.c_int()
+        native.check(self._lib.bft_ring_request_resize(
+            self._handle, contiguous_bytes,
+            -1 if total_bytes is None else total_bytes, int(nringlet),
+            ctypes.byref(applied)), 'request_resize')
+        if applied.value:
+            self._write_ring_proclog()
+        else:
+            # the C core will apply at a commit/release quiescence
+            # point: watch for it there so the rings/<name> proclog
+            # reflects the new geometry when it lands
+            self._resize_proclog_watch = True
+        return bool(applied.value)
+
+    @property
+    def resize_pending(self):
+        pending = ctypes.c_int()
+        native.check(self._lib.bft_ring_resize_pending(
+            self._handle, ctypes.byref(pending)))
+        return bool(pending.value)
+
+    # -- deferred-fill resize holds ---------------------------------------
+    def _register_fill(self, fill):
+        super(NativeRing, self)._register_fill(fill)
+        # the fill writes through a numpy view of the CURRENT native
+        # buffer after its span closes: block the C core's deferred-
+        # resize apply until it completes
+        with self._lock:
+            self._fill_holds.append(fill)
+        try:
+            self._lib.bft_ring_resize_hold(self._handle, 1)
+        except Exception:
+            pass
+
+    def _prune_fill_holds(self):
+        with self._lock:
+            done = [f for f in self._fill_holds if f.done]
+            self._fill_holds = [f for f in self._fill_holds
+                                if not f.done]
+        for _ in done:
+            try:
+                self._lib.bft_ring_resize_hold(self._handle, -1)
+            except Exception:
+                pass
+
+    def _fills_overlapping(self, begin, nbyte):
+        out = super(NativeRing, self)._fills_overlapping(begin, nbyte)
+        self._prune_fill_holds()
+        return out
+
+    def _fills_before(self, limit):
+        out = super(NativeRing, self)._fills_before(limit)
+        self._prune_fill_holds()
+        return out
 
     def _write_ring_proclog(self):
         """Geometry proclog for the monitor tools; queries the native
@@ -348,6 +429,10 @@ class NativeRing(Ring):
             if wspan in self._open_wspans:
                 self._open_wspans.remove(wspan)
                 self._nwrite_open -= 1
+        if getattr(self, '_resize_proclog_watch', False) \
+                and not self.resize_pending:
+            self._resize_proclog_watch = False
+            self._write_ring_proclog()   # deferred resize landed
         if commit_nbyte:
             # shared commit telemetry (Ring._note_commit): the per-ring
             # logical-gulp throughput counter the exporter derives
@@ -419,6 +504,10 @@ class NativeRing(Ring):
     def _release_span(self, rseq, span_begin):
         native.check(self._lib.bft_reader_release(
             self._handle, rseq._native_reader_id, span_begin), 'release')
+        if getattr(self, '_resize_proclog_watch', False) \
+                and not self.resize_pending:
+            self._resize_proclog_watch = False
+            self._write_ring_proclog()   # deferred resize landed
 
     def _close_read_seq(self, rseq):
         rid = getattr(rseq, '_native_reader_id', None)

@@ -273,13 +273,17 @@ def track_method_timed(method):
     return wrapper
 
 
-def snapshot(pipeline=None):
+def snapshot(pipeline=None, rates=False):
     """Unified metrics snapshot: flat counters + histograms + live
     ring occupancy, merged into one plain dict (see
     :func:`bifrost_tpu.telemetry.exporter.snapshot`).  ``pipeline``
-    narrows the ring section to one pipeline's rings."""
+    narrows the ring section to one pipeline's rings; ``rates=True``
+    (or a :class:`~bifrost_tpu.telemetry.exporter.RateTracker`) adds
+    derived per-second rates from the counter/histogram deltas since
+    the tracker's previous snapshot — the closed-loop auto-tuner's
+    signal source (docs/autotune.md)."""
     from . import exporter
-    return exporter.snapshot(pipeline)
+    return exporter.snapshot(pipeline, rates=rates)
 
 
 #: robustness counters mirrored into the usage aggregates by flush()

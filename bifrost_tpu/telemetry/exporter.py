@@ -30,14 +30,66 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 
 from . import counters, histograms, spans
 
 __all__ = ['snapshot', 'write_prometheus', 'prometheus_text',
-           'MetricsPublisher']
+           'MetricsPublisher', 'RateTracker']
 
 DEFAULT_INTERVAL = 5.0
+
+
+class RateTracker(object):
+    """Derives per-second rates from the deltas between successive
+    snapshots (docs/autotune.md; the closed-loop auto-tuner's signal
+    source, and what the metrics publisher's ``gulps_per_s`` columns
+    are computed from instead of ad-hoc last-value bookkeeping).
+
+    Each caller that needs an independent cadence owns its own
+    tracker (``snapshot(rates=my_tracker)``); ``snapshot(rates=True)``
+    uses a shared module-level one, fine for a single consumer.  The
+    first observation has no baseline and reports empty rates.
+    Counter resets (``counters.reset()``) produce negative deltas,
+    which are clamped to 0 rather than reported as nonsense."""
+
+    def __init__(self):
+        self._last = None            # (monotonic, counts, hist_state)
+
+    def observe(self, counts, hists=None):
+        """Per-second rates since the previous observe::
+
+            {'dt': seconds_or_None,
+             'counters':   {name: per_second},
+             'histograms': {name: {'count_per_s': ..,
+                                   'sum_per_s': ..}}}
+
+        ``counts`` is a counters.snapshot() dict; ``hists`` an optional
+        histograms.snapshot() dict (count/sum deltas — e.g. the
+        send-stall seconds accrued per wall second)."""
+        import time
+        now = time.monotonic()
+        out = {'dt': None, 'counters': {}, 'histograms': {}}
+        hstate = {name: (h.get('count', 0), h.get('sum', 0.0))
+                  for name, h in (hists or {}).items()}
+        if self._last is not None:
+            t0, prev, prev_h = self._last
+            dt = now - t0
+            if dt > 0:
+                out['dt'] = dt
+                for name, v in counts.items():
+                    out['counters'][name] = \
+                        max(v - prev.get(name, 0), 0) / dt
+                for name, (cnt, tot) in hstate.items():
+                    pc, ps = prev_h.get(name, (0, 0.0))
+                    out['histograms'][name] = {
+                        'count_per_s': max(cnt - pc, 0) / dt,
+                        'sum_per_s': max(tot - ps, 0.0) / dt}
+        self._last = (now, counts, hstate)
+        return out
+
+
+#: shared tracker behind ``snapshot(rates=True)``
+_global_rates = RateTracker()
 
 
 def _ring_occupancy(pipeline=None):
@@ -119,14 +171,16 @@ def _mesh_summary(counts):
     return out
 
 
-def snapshot(pipeline=None):
+def snapshot(pipeline=None, rates=False):
     """The unified metrics snapshot::
 
         {'counters':   {name: int},
          'histograms': {name: {count,sum,min,max,p50,p90,p99,buckets}},
          'rings':      {name: {tail,head,size,...,fill}},
          'devices':    {index: {platform,bytes_in_use,bytes_limit,...}},
-         'mesh':       {reshards,sharded_commits,collectives,...}}
+         'mesh':       {reshards,sharded_commits,collectives,...},
+         'rates':      {dt, counters: {name: per_s},
+                        histograms: {name: {count_per_s, sum_per_s}}}}
 
     ``pipeline`` narrows the ring section to one pipeline's rings;
     without it every live ring in the process is reported.  The
@@ -134,19 +188,32 @@ def snapshot(pipeline=None):
     (per-thread span-buffer overflow — docs/observability.md); the SLO
     age histograms/violation counters (telemetry.slo) appear under
     their ``slo.*`` names in 'histograms'/'counters'.
+
+    ``rates`` adds derived per-second rates from the counter and
+    histogram deltas since this tracker's PREVIOUS snapshot: ``True``
+    uses a shared module tracker (one consumer), or pass your own
+    :class:`RateTracker` for an independent cadence (the closed-loop
+    auto-tuner and the metrics publisher each own one).  The first
+    snapshot has no baseline and reports empty rate dicts.
     """
     counts = counters.snapshot()
     dropped = spans.dropped_spans()
     if dropped:
         counts['trace.dropped_spans'] = \
             counts.get('trace.dropped_spans', 0) + dropped
-    return {
+    hists = histograms.snapshot()
+    snap = {
         'counters': counts,
-        'histograms': histograms.snapshot(),
+        'histograms': hists,
         'rings': _ring_occupancy(pipeline),
         'devices': _device_stats(),
         'mesh': _mesh_summary(counts),
     }
+    if rates:
+        tracker = rates if isinstance(rates, RateTracker) \
+            else _global_rates
+        snap['rates'] = tracker.observe(counts, hists)
+    return snap
 
 
 # ---------------------------------------------------------------------------
@@ -252,8 +319,9 @@ class MetricsPublisher(threading.Thread):
         self.pipeline = pipeline
         self._stop_event = threading.Event()
         self._proclogs = {}
-        self._last_gulps = {}
-        self._last_time = None
+        #: per-second rate derivation between publishes (shared
+        #: RateTracker machinery — no more ad-hoc last-value dicts)
+        self._rates = RateTracker()
         #: per-device HBM watermark: the highest bytes_in_use this
         #: publisher has SAMPLED (coarser than the allocator's own
         #: peak_bytes_in_use where available, but live on every
@@ -281,7 +349,7 @@ class MetricsPublisher(threading.Thread):
 
     def publish(self):
         try:
-            snap = snapshot(self.pipeline)
+            snap = snapshot(self.pipeline, rates=self._rates)
             self._note_watermarks(snap)
             self._publish_proclog(snap)
             path = os.environ.get('BF_METRICS_FILE')
@@ -311,16 +379,11 @@ class MetricsPublisher(threading.Thread):
             flat['h.%s.p99' % name] = '%g' % h['p99']
         self._proclog('telemetry/metrics').update(flat, force=True)
 
-        now = time.monotonic()
-        dt = (now - self._last_time) if self._last_time else None
-        self._last_time = now
+        crates = snap.get('rates', {}).get('counters', {})
         hists = snap['histograms']
         for name, d in sorted(snap['rings'].items()):
             gulps = snap['counters'].get('ring.%s.gulps' % name, 0)
-            rate = 0.0
-            if dt and dt > 0:
-                rate = max(gulps - self._last_gulps.get(name, 0), 0) / dt
-            self._last_gulps[name] = gulps
+            rate = crates.get('ring.%s.gulps' % name, 0.0)
             entry = {
                 'occupancy_pct': round(100.0 * d.get('fill', 0.0), 1),
                 'gulps': gulps,

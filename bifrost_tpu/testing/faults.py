@@ -42,6 +42,11 @@ violation class in BOTH ring cores — see docs/analysis.md:
 - ``ring.corrupt.poison_nowake``   poison the ring WITHOUT waking
                        blocked spans (suppresses the condition
                        notifies / native wakeup)
+- ``ring.corrupt.resize_under_span``  report a deferred-resize
+                       storage re-layout to the checker while spans
+                       are still open (simulates a core applying a
+                       retune under a live span's zero-copy view —
+                       the auto-tuner's resize_quiescence invariant)
 
 A fault fires ``count`` times after skipping its first ``after``
 matching calls; ``delay`` seconds of sleep are injected before the

@@ -856,6 +856,14 @@ class TransferEngine(object):
             while len(self._pending) > self.depth:
                 over.append(self._pending.popleft())
         for old in over:
+            if not old.done and not old.ready():
+                # a real hard wait: the depth bound forced a drain
+                # before the transfer finished on its own (ready()
+                # distinguishes finished-but-unharvested futures —
+                # done only flips once result() runs).  The
+                # closed-loop auto-tuner reads this rate as part of
+                # its sync-depth trigger (docs/autotune.md).
+                _counters().inc('xfer.depth_waits')
             old.result()
         return fut
 
@@ -875,6 +883,11 @@ class TransferEngine(object):
             while len(self._fills) > self.depth:
                 over.append(self._fills.popleft())
         for old in over:
+            # same finished-but-unharvested exclusion as the future
+            # drain above: HostFill.done only flips inside wait(), so
+            # poll the underlying transfer before charging a hard wait
+            if not old.done and not old.future.ready():
+                _counters().inc('xfer.depth_waits')
             old.wait()
         return fill
 

@@ -160,7 +160,61 @@ struct Ring {
     int bind_core = -1;      // NUMA-bind new allocations to this core
     std::atomic<long long> total_written{0};
 
+    // deferred resize (bft_ring_request_resize): target geometry
+    // recorded while spans were open, applied by the span-release
+    // paths the moment the ring goes quiescent.  -1 = none pending.
+    int64_t pending_ghost = -1;
+    int64_t pending_size = -1;
+    int64_t pending_nringlet = -1;
+    // external apply blockers (bft_ring_resize_hold): the Python layer
+    // holds one per registered deferred D2H fill, whose cached numpy
+    // view into THIS buffer would dangle under a re-layout
+    int resize_holds = 0;
+
     int64_t lane_nbyte() const { return size + ghost; }
+
+    bool resize_pending_locked() const { return pending_size >= 0; }
+
+    // fold the pending request into an explicit target (MAX semantics)
+    // and clear it; callers apply the returned geometry themselves.
+    // MUST NOT be called while resize_holds > 0: the holds exist
+    // precisely because a deferred fill's cached view into the
+    // current buffer would dangle under a re-layout — callers that
+    // reach quiescence on spans alone keep the target pending.
+    void fold_pending_locked(int64_t* g, int64_t* s, int64_t* n) {
+        if (resize_holds != 0) return;
+        if (pending_ghost > *g) *g = pending_ghost;
+        if (pending_size > *s) *s = pending_size;
+        if (pending_nringlet > *n) *n = pending_nringlet;
+        pending_ghost = pending_size = pending_nringlet = -1;
+    }
+
+    // apply a pending deferred resize if quiescent RIGHT NOW; returns
+    // BFT_OK whether or not anything was pending (alloc errors pass
+    // through)
+    int maybe_apply_pending_locked() {
+        if (!resize_pending_locked()) return BFT_OK;
+        if (nwrite_open != 0 || nread_open != 0 || resize_holds != 0)
+            return BFT_OK;
+        int64_t g = ghost, s = size, n = nringlet;
+        fold_pending_locked(&g, &s, &n);
+        if (g == ghost && s == size && n == nringlet) return BFT_OK;
+        int rc = realloc_locked(s, g, n);
+        if (rc != BFT_OK) {
+            // fold cleared the pending target; an allocation failure
+            // must not silently lose the requested grow (the tuner's
+            // re-issue contract relies on the target staying pending
+            // until it lands) — restore it for the next quiescence
+            if (g > ghost && g > pending_ghost) pending_ghost = g;
+            if (s > size && s > pending_size) pending_size = s;
+            if (n > nringlet && n > pending_nringlet)
+                pending_nringlet = n;
+            return rc;
+        }
+        write_cv.notify_all();
+        read_cv.notify_all();
+        return BFT_OK;
+    }
 
     int64_t min_guarantee_locked() const {
         int64_t g = NO_END;
@@ -285,6 +339,10 @@ int bft_ring_resize(void* ring_, long long contig, long long total,
     int64_t ghost = std::max<int64_t>(r->ghost, contig);
     int64_t size = std::max<int64_t>(r->size, total);
     int64_t nrl = std::max<int64_t>(r->nringlet, nringlet);
+    // fold in any deferred request_resize target: this blocking path
+    // reaches quiescence anyway, so the pending geometry lands here
+    if (r->resize_pending_locked())
+        r->fold_pending_locked(&ghost, &size, &nrl);
     if (size == r->size && ghost == r->ghost && nrl == r->nringlet)
         return BFT_OK;
     // wait for quiescence (reference: RingReallocLock)
@@ -295,6 +353,54 @@ int bft_ring_resize(void* ring_, long long contig, long long total,
     if (rc != BFT_OK) return rc;
     r->write_cv.notify_all();
     r->read_cv.notify_all();
+    return BFT_OK;
+}
+
+int bft_ring_request_resize(void* ring_, long long contig,
+                            long long total, long long nringlet,
+                            int* applied) {
+    // Non-blocking deferred resize (the auto-tuner's retune protocol):
+    // apply immediately when quiescent, else record the target and let
+    // bft_ring_commit / bft_reader_release apply it the moment the
+    // oldest open span releases and no other span remains open.
+    // *applied = 1 when the requested geometry is live on return.
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r || !applied) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    if (total < 0) total = contig * 4;
+    int64_t ghost = std::max<int64_t>(r->ghost, contig);
+    int64_t size = std::max<int64_t>(r->size, total);
+    int64_t nrl = std::max<int64_t>(r->nringlet, nringlet);
+    if (size == r->size && ghost == r->ghost && nrl == r->nringlet) {
+        *applied = 1;                 // no-op: already that large
+        return BFT_OK;
+    }
+    if (ghost > r->pending_ghost) r->pending_ghost = ghost;
+    if (size > r->pending_size) r->pending_size = size;
+    if (nrl > r->pending_nringlet) r->pending_nringlet = nrl;
+    int rc = r->maybe_apply_pending_locked();
+    if (rc != BFT_OK) return rc;
+    *applied = r->resize_pending_locked() ? 0 : 1;
+    return BFT_OK;
+}
+
+int bft_ring_resize_hold(void* ring_, int delta) {
+    // adjust the external apply-blocker count (deferred fills); a drop
+    // to zero is itself a quiescence point
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    r->resize_holds += delta;
+    if (r->resize_holds < 0) r->resize_holds = 0;
+    if (r->resize_holds == 0) return r->maybe_apply_pending_locked();
+    return BFT_OK;
+}
+
+int bft_ring_resize_pending(void* ring_, int* pending) {
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r || !pending) return BFT_ERR_INVALID;
+    std::lock_guard<std::mutex> lk(r->mtx);
+    *pending = r->resize_pending_locked() ? 1 : 0;
     return BFT_OK;
 }
 
@@ -403,13 +509,17 @@ int bft_ring_reserve(void* ring_, long long nbyte, int nonblocking,
         if (ws.commit_nbyte >= 0 && ws.commit_nbyte < ws.nbyte)
             return BFT_ERR_STATE;
     if (nbyte > r->ghost) {
-        // guaranteed-contiguous window too small; grow it
+        // guaranteed-contiguous window too small; grow it (folding in
+        // any deferred request_resize target — we are at quiescence)
         r->span_cv.wait(lk, [&] {
             return r->nwrite_open == 0 && r->nread_open == 0;
         });
-        int rc = r->realloc_locked(
-            std::max<int64_t>(r->size, nbyte * 4),
-            std::max<int64_t>(r->ghost, nbyte), r->nringlet);
+        int64_t g = std::max<int64_t>(r->ghost, nbyte);
+        int64_t s = std::max<int64_t>(r->size, nbyte * 4);
+        int64_t n = r->nringlet;
+        if (r->resize_pending_locked())
+            r->fold_pending_locked(&g, &s, &n);
+        int rc = r->realloc_locked(s, g, n);
         if (rc != BFT_OK) return rc;
     }
     int64_t begin = r->reserve_head;
@@ -474,6 +584,9 @@ int bft_ring_commit(void* ring_, long long span_id, long long commit_nbyte) {
         r->total_written += ws.commit_nbyte;
         r->nwrite_open -= 1;
     }
+    // quiescence point: a deferred request_resize applies the moment
+    // no span remains open
+    r->maybe_apply_pending_locked();
     r->read_cv.notify_all();
     r->span_cv.notify_all();
     return BFT_OK;
@@ -673,6 +786,9 @@ int bft_reader_release(void* ring_, long long reader_id,
         }
     }
     r->nread_open -= 1;
+    // quiescence point for deferred resize: "the oldest open span
+    // releases" — apply once no span at all remains open
+    r->maybe_apply_pending_locked();
     r->write_cv.notify_all();
     r->span_cv.notify_all();
     return BFT_OK;

@@ -499,3 +499,40 @@ def test_ringcheck_inside_pipeline(checker):
         p.run()
     assert sink.result() is not None
     assert not ringcheck.violations()
+
+
+def test_resize_under_span_detected(ring_core, checker):
+    """The resize_quiescence invariant (the auto-tuner's retune
+    protocol, docs/autotune.md): a core reporting a storage re-layout
+    while spans are open is caught by the shadow state machine — in
+    BOTH cores, via the ``ring.corrupt.resize_under_span`` seam that
+    simulates applying the deferred resize under a live span."""
+    ring = Ring(space='system', name='rc_rz_%s' % ring_core)
+    wr, seq = _open_seq(ring)
+    span = seq.reserve(8)
+    with faults.injected('ring.corrupt.resize_under_span',
+                         match=ring.name):
+        with pytest.raises(RingProtocolError) as ei:
+            ring.request_resize(1, ring.total_span * 2)
+    assert ei.value.invariant == 'resize_quiescence'
+    assert 'dangle' in str(ei.value)
+    assert ringcheck.violations()
+    span.data.as_numpy()[...] = 1.0
+    span.commit(8)
+    span.close()
+
+
+def test_deferred_resize_clean_under_checker(ring_core, checker):
+    """The LEGITIMATE deferred-resize protocol — request under an open
+    span, apply at quiescence — must run clean under BF_RINGCHECK=1 in
+    both cores (no false positives from the new invariant)."""
+    ring = Ring(space='system', name='rc_rzok_%s' % ring_core)
+    wr, seq = _open_seq(ring)
+    before = ring.total_span
+    span = seq.reserve(8)
+    assert not ring.request_resize(1, before * 2)
+    span.data.as_numpy()[...] = 1.0
+    span.commit(8)
+    span.close()
+    assert ring.total_span >= before * 2
+    assert not ringcheck.violations()

@@ -1,0 +1,665 @@
+"""Closed-loop auto-tuning tests (docs/autotune.md):
+
+- derived per-second rates in ``telemetry.snapshot(rates=...)`` (the
+  controller's signal source)
+- knob plumbing precedence: BlockScope > Pipeline kwarg > BF_* env for
+  every tunable the controller touches (K, sync_depth, bridge
+  window/stripes, ring buffering)
+- the knob state machine: geometric stepping, cooldown, min-gain
+  convergence, revert-on-regression, the static-verifier gate (a
+  retune can never introduce a BF-E the analyzer rejects)
+- freeze mode: profile dump + warm start
+- every retune is visible in telemetry (counter + proclog + span)
+- mprobe coin-flip staleness: COIN-FLIP winners are re-raced after
+  BF_MPROBE_REPROBE cache uses instead of being frozen forever
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import bifrost_tpu as bf
+from bifrost_tpu import autotune
+from bifrost_tpu.autotune import AutoTuner
+from bifrost_tpu.macro import resolve_gulp_batch, retune_gulp_batch
+from bifrost_tpu.pipeline import resolve_sync_depth
+from bifrost_tpu.telemetry import counters, histograms, snapshot, spans
+from bifrost_tpu.telemetry.exporter import RateTracker
+from tests.util import NumpySourceBlock, GatherSink, simple_header
+
+NT = 8
+
+
+def _hdr(nf=4):
+    return simple_header([-1, nf], 'f32', labels=['time', 'freq'])
+
+
+def _gulps(n=4, nf=4):
+    return [np.full((NT, nf), float(k), dtype=np.float32)
+            for k in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry(monkeypatch, tmp_path):
+    # never warm-start from (or dump into) a stray CWD profile
+    monkeypatch.setenv('BF_AUTOTUNE_PROFILE',
+                       str(tmp_path / 'profile.json'))
+    # keep this file's many built-but-never-run pipelines out of the
+    # process-shared proclog tree other tests walk
+    monkeypatch.setenv('BF_PROCLOG_DIR', str(tmp_path / 'proclog'))
+    counters.reset()
+    histograms.reset()
+    spans.reset()
+    yield
+    counters.reset()
+    histograms.reset()
+    spans.reset()
+
+
+# ---------------------------------------------------------------------------
+# snapshot(rates=...) — satellite 1
+# ---------------------------------------------------------------------------
+
+def test_rate_tracker_derives_per_second_rates():
+    tr = RateTracker()
+    first = tr.observe({'a': 10}, {'h': {'count': 2, 'sum': 0.5}})
+    assert first['dt'] is None and not first['counters']
+    time.sleep(0.05)
+    out = tr.observe({'a': 30}, {'h': {'count': 6, 'sum': 1.5}})
+    assert out['dt'] > 0
+    assert out['counters']['a'] == pytest.approx(20 / out['dt'],
+                                                rel=0.01)
+    h = out['histograms']['h']
+    assert h['count_per_s'] == pytest.approx(4 / out['dt'], rel=0.01)
+    assert h['sum_per_s'] == pytest.approx(1.0 / out['dt'], rel=0.01)
+
+
+def test_rate_tracker_clamps_counter_resets():
+    tr = RateTracker()
+    tr.observe({'a': 100}, {})
+    time.sleep(0.02)
+    out = tr.observe({'a': 3}, {})   # counters.reset() happened
+    assert out['counters']['a'] == 0.0
+
+
+def test_snapshot_rates_integration():
+    counters.inc('rt.test_counter', 5)
+    tr = RateTracker()
+    s1 = snapshot(rates=tr)
+    assert s1['rates']['dt'] is None
+    counters.inc('rt.test_counter', 10)
+    time.sleep(0.02)
+    s2 = snapshot(rates=tr)
+    assert s2['rates']['dt'] > 0
+    assert s2['rates']['counters']['rt.test_counter'] > 0
+    # rates=False leaves the key out entirely
+    assert 'rates' not in snapshot()
+
+
+# ---------------------------------------------------------------------------
+# knob plumbing precedence — satellite: scope > kwarg > env
+# ---------------------------------------------------------------------------
+
+def test_sync_depth_precedence(monkeypatch):
+    monkeypatch.setenv('BF_SYNC_DEPTH', '7')
+    with bf.Pipeline() as p_env:
+        assert resolve_sync_depth(p_env) == 7
+    with bf.Pipeline(sync_depth=3) as p_kw:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        with bf.block_scope(sync_depth=2):
+            b = bf.blocks.copy(src, space='system')
+        assert resolve_sync_depth(p_kw) == 3       # kwarg beats env
+        assert resolve_sync_depth(b) == 2          # scope beats kwarg
+        assert resolve_sync_depth(src) == 3        # sibling unaffected
+
+
+def test_sync_depth_runtime_retune():
+    """The controller's write path: mutating the pipeline tunable is
+    picked up by the next resolve (what makes the knob retunable at
+    runtime — resolve_sync_depth is read per gulp)."""
+    with bf.Pipeline(sync_depth=2) as p:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='system')
+        assert resolve_sync_depth(b) == 2
+        p._sync_depth = 8
+        assert resolve_sync_depth(b) == 8
+
+
+def test_gulp_batch_precedence(monkeypatch):
+    monkeypatch.setenv('BF_GULP_BATCH', '4')
+    with bf.Pipeline() as p_env:
+        assert resolve_gulp_batch(p_env) == 4
+    with bf.Pipeline(gulp_batch=2) as p_kw:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        with bf.block_scope(gulp_batch=8):
+            b = bf.blocks.copy(src, space='system')
+        assert resolve_gulp_batch(p_kw) == 2
+        assert resolve_gulp_batch(b) == 8
+        assert resolve_gulp_batch(src) == 2
+        # the retune helper writes the PIPELINE scope: the block that
+        # pinned its own value keeps it
+        retune_gulp_batch(p_kw, 16)
+        assert resolve_gulp_batch(p_kw) == 16
+        assert resolve_gulp_batch(src) == 16
+        assert resolve_gulp_batch(b) == 8
+
+
+def test_bridge_window_and_streams_precedence(monkeypatch):
+    from bifrost_tpu.io.bridge import bridge_window, bridge_streams
+    monkeypatch.setenv('BF_BRIDGE_WINDOW', '6')
+    monkeypatch.setenv('BF_BRIDGE_STREAMS', '3')
+    assert bridge_window() == 6
+    assert bridge_streams() == 3
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        from bifrost_tpu.blocks.bridge import bridge_sink
+        b_env = bridge_sink(src, '127.0.0.1', 1)
+        b_kw = bridge_sink(src, '127.0.0.1', 2, window=9, nstreams=2)
+    assert b_env.window == 6 and b_env.nstreams == 3
+    assert b_kw.window == 9 and b_kw.nstreams == 2
+    # runtime retune (the controller's write path, no live sender)
+    assert b_kw.retune_window(12) == 12
+    assert b_kw.window == 12
+
+
+def test_ring_buffering_precedence():
+    with bf.Pipeline(buffer_factor=5) as p:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        with bf.block_scope(buffer_factor=2, buffer_nframe=64):
+            b = bf.blocks.copy(src, space='system')
+        assert p.buffer_factor == 5
+        assert b.buffer_factor == 2          # scope beats kwarg
+        assert b.buffer_nframe == 64
+        assert src.buffer_factor == 5        # inherits the pipeline
+
+
+# ---------------------------------------------------------------------------
+# the knob state machine (deterministic: no controller thread)
+# ---------------------------------------------------------------------------
+
+def _pipeline():
+    with bf.Pipeline(name='tune_test_%d' % int(time.time() * 1e6)) \
+            as p:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='system')
+        GatherSink(b)
+    return p
+
+
+def _snap_for_batch(disp=10.0, gulps=10.0):
+    return {'rates': {'dt': 1.0, 'counters': {
+        'block.x.dispatches': disp, 'block.x.gulps': gulps},
+        'histograms': {}}, 'rings': {}, 'histograms': {}}
+
+
+def test_gulp_batch_knob_climbs_geometrically():
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs if k.name == 'gulp_batch')
+    assert knob.read() == 1
+    knob.tick(_snap_for_batch(), objective=100.0)
+    assert knob.read() == 2                  # doubled
+    assert knob.cooldown == tuner.cooldown_ticks
+    for _ in range(tuner.cooldown_ticks):
+        knob.tick(_snap_for_batch(), objective=100.0)
+    # improved objective: the climb continues
+    knob.tick(_snap_for_batch(gulps=20.0), objective=120.0)
+    assert knob.read() == 4
+    assert counters.snapshot()['autotune.retunes'] == 2
+
+
+def test_step_without_baseline_is_kept_not_pinned():
+    """A step taken before the objective window has a baseline
+    (objective None on the first live tick) is unjudgeable: it must
+    be KEPT without marking the knob converged — judging 'unknown' as
+    gain=0 would falsely pin every first-tick step at one doubling."""
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs if k.name == 'gulp_batch')
+    knob.tick(_snap_for_batch(), objective=None)
+    assert knob.read() == 2                  # stepped, baseline None
+    for _ in range(tuner.cooldown_ticks):
+        knob.tick(_snap_for_batch(), objective=100.0)
+    # evaluation tick: unjudgeable step is kept, knob stays live and
+    # the climb continues against the now-live baseline
+    knob.tick(_snap_for_batch(gulps=20.0), objective=100.0)
+    assert not knob.converged
+    assert knob.read() == 4
+
+
+def test_knob_reverts_when_step_hurts():
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs if k.name == 'gulp_batch')
+    knob.tick(_snap_for_batch(), objective=100.0)
+    assert knob.read() == 2
+    for _ in range(tuner.cooldown_ticks):
+        knob.tick(_snap_for_batch(), objective=100.0)
+    knob.tick(_snap_for_batch(), objective=50.0)   # regression
+    assert knob.read() == 1                  # reverted
+    assert knob.converged
+    assert counters.snapshot()['autotune.reverts'] == 1
+
+
+def test_knob_pins_when_gain_below_threshold():
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs if k.name == 'gulp_batch')
+    knob.tick(_snap_for_batch(), objective=100.0)
+    for _ in range(tuner.cooldown_ticks):
+        knob.tick(_snap_for_batch(), objective=100.0)
+    knob.tick(_snap_for_batch(), objective=100.5)  # < min_gain
+    assert knob.read() == 2                  # kept, but pinned
+    assert knob.converged
+
+
+def test_knob_holds_evaluation_through_traffic_lull():
+    """A zero/None objective (sequence boundary, compile pause) must
+    not spuriously revert a pending step — the knob holds and judges
+    at the next live tick."""
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs if k.name == 'gulp_batch')
+    knob.tick(_snap_for_batch(), objective=100.0)
+    for _ in range(tuner.cooldown_ticks):
+        knob.tick(_snap_for_batch(), objective=100.0)
+    knob.tick(_snap_for_batch(), objective=0.0)    # lull
+    assert knob.read() == 2 and not knob.converged
+    knob.tick(_snap_for_batch(), objective=None)   # still quiet
+    knob.tick(_snap_for_batch(gulps=20.0), objective=150.0)
+    assert not knob.converged                # judged against 100: keep
+
+
+def test_sync_depth_knob_uses_hard_wait_rate():
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs if k.name == 'sync_depth')
+    quiet = {'rates': {'dt': 1.0, 'counters': {
+        'pipeline.gulps_device': 100.0, 'pipeline.sync_waits': 0.0}},
+        'rings': {}, 'histograms': {}}
+    knob.tick(quiet, objective=100.0)
+    before = knob.read()
+    # the xfer depth-bound stalls count as hard waits too
+    busy = {'rates': {'dt': 1.0, 'counters': {
+        'pipeline.gulps_device': 100.0, 'pipeline.sync_waits': 4.0,
+        'xfer.depth_waits': 4.0}}, 'rings': {}, 'histograms': {}}
+    knob.tick(busy, objective=100.0)
+    assert knob.read() == before * 2
+
+
+def test_ring_knob_grows_through_deferred_resize():
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    ring_knobs = [k for k in tuner.knobs
+                  if k.name.startswith('ring_bytes.')]
+    assert ring_knobs
+    knob = ring_knobs[0]
+    knob.ring.resize(256)                    # known starting geometry
+    before = knob.read()
+    snap = {'rates': {'dt': 1.0, 'counters': {},
+                      'histograms': {
+                          'ring.%s.reserve_s' % knob.ring.name:
+                          {'count_per_s': 50.0, 'sum_per_s': 0.01}}},
+            'rings': {knob.ring.name: {'fill': 0.99}},
+            'histograms': {}}
+    knob.tick(snap, objective=100.0)
+    assert knob.read() >= before * 2         # grew (quiescent: applied)
+    assert not knob.reversible               # rings never shrink
+
+
+def test_ring_floor_clamps_to_verifier_bound():
+    """The BF-E101 deadlock bound is a hard floor: the capacity knob's
+    write path clamps every target UP to it, so the controller can
+    never tune a ring below what the static analyzer requires."""
+    from bifrost_tpu.analysis import verify
+    p = _pipeline()
+    floors = verify.ring_capacity_floors(p)
+    assert floors                            # provable on this chain
+    for name, f in floors.items():
+        assert f['frames'] >= f['writer_span']
+        assert f['frames'] == f['writer_span'] + f['max_pin']
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs
+                if k.name.startswith('ring_bytes.')
+                and tuner.ring_floor_bytes(k.ring.name))
+    floor = tuner.ring_floor_bytes(knob.ring.name)
+    knob.write(1)                            # absurdly small target
+    assert knob.ring.total_span >= floor
+
+
+def test_verifier_gate_blocks_error_introducing_step(monkeypatch):
+    from bifrost_tpu.analysis import verify
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs if k.name == 'gulp_batch')
+    baseline = verify.verify_pipeline(p)
+
+    def fake_verify(pipeline):
+        return baseline + [verify.Diagnostic(
+            'BF-E101', 'ring too small for the candidate K',
+            block='x', ring='r')]
+    monkeypatch.setattr(verify, 'verify_pipeline', fake_verify)
+    tuner._baseline_diags = baseline
+    knob.tick(_snap_for_batch(), objective=100.0)
+    assert knob.read() == 1                  # step refused
+    assert knob.converged
+    assert counters.snapshot()['autotune.rejected'] == 1
+    assert 'autotune.retunes' not in counters.snapshot()
+
+
+def test_scope_overrides_shape_verdict_without_mutation(monkeypatch):
+    """verify.scope_overrides evaluates a candidate tunable without
+    touching the live configuration: the override shapes the verdict
+    on the calling thread only, and root-level K candidates do not
+    displace a block's own pinned value (mirroring what
+    retune_gulp_batch would actually write)."""
+    from bifrost_tpu.analysis import verify
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        with bf.block_scope(gulp_batch=2):
+            pinned = bf.blocks.copy(src, space='system')
+        free = bf.blocks.copy(src, space='system')
+        from bifrost_tpu.blocks.bridge import bridge_sink
+        snk = bridge_sink(free, '127.0.0.1', 1, window=2)
+    base = verify.verify_pipeline(p)
+    assert 'BF-E150' not in [d.code for d in base]
+    with verify.scope_overrides({'bridge_window': {snk.name: 0}}):
+        cand = verify.verify_pipeline(p)
+    assert 'BF-E150' in [d.code for d in cand]
+    assert snk.window == 2                   # live config untouched
+    with verify.scope_overrides({'gulp_batch': 16}):
+        assert verify._static_k_requested(free) == 16
+        assert verify._static_k_requested(pinned) == 2   # pin wins
+        # the live resolution is untouched even inside the context
+        assert resolve_gulp_batch(free) == 1
+    assert resolve_gulp_batch(free) == 1
+
+
+def test_verifier_gate_never_mutates_live_pipeline(monkeypatch):
+    """The gate runs the verifier with the candidate supplied through
+    the thread-local scope_overrides seam: a block thread resolving
+    tunables concurrently with the gate can never observe the
+    candidate value (the retune itself happens later, through the
+    knob's write path)."""
+    from bifrost_tpu.analysis import verify
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='on')
+    tuner._baseline_diags = verify.verify_pipeline(p)
+    seen = []
+    real = verify.verify_pipeline
+
+    def spying_verify(pipeline):
+        # what a concurrently-running block thread would resolve
+        seen.append(resolve_gulp_batch(pipeline))
+        return real(pipeline)
+    monkeypatch.setattr(verify, 'verify_pipeline', spying_verify)
+    assert tuner._verifier_allows('_gulp_batch', 16)
+    assert seen == [1]                       # live value, not 16
+    assert p.__dict__.get('_gulp_batch') is None
+
+
+def test_new_errors_vs_ignores_preexisting():
+    from bifrost_tpu.analysis import verify
+    e = verify.Diagnostic('BF-E101', 'old', block='b', ring='r')
+    w = verify.Diagnostic('BF-W102', 'warn', block='b', ring='r')
+    e2 = verify.Diagnostic('BF-E101', 'new', block='b2', ring='r2')
+    assert verify.new_errors_vs([e], [e, w]) == []
+    out = verify.new_errors_vs([e], [e, e2])
+    assert len(out) == 1 and out[0].block == 'b2'
+
+
+# ---------------------------------------------------------------------------
+# retune visibility: counter + proclog + span (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_retune_published_to_counters_proclog_and_spans(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv('BF_PROCLOG_DIR', str(tmp_path / 'proclog'))
+    monkeypatch.setenv('BF_TRACE_FILE', str(tmp_path / 'trace.json'))
+    spans.reconfigure()
+    try:
+        p = _pipeline()
+        tuner = AutoTuner(p, mode='on')
+        knob = next(k for k in tuner.knobs if k.name == 'gulp_batch')
+        knob.tick(_snap_for_batch(), objective=100.0)
+        snap = counters.snapshot()
+        assert snap['autotune.retunes'] == 1
+        assert snap['autotune.gulp_batch'] == 2   # counter == value
+        evs = [ev for _t, ev in spans.events()
+               if ev[0] == 'autotune.retune']
+        assert evs and evs[0][4]['knob'] == 'gulp_batch'
+        assert evs[0][4]['to'] == 2
+        log = tmp_path / 'proclog' / str(os.getpid()) / \
+            'analysis' / 'autotune'
+        text = log.read_text()
+        assert 'knob.gulp_batch : 2' in text
+        assert 'retune gulp_batch -> 2' in text
+    finally:
+        monkeypatch.delenv('BF_TRACE_FILE')
+        spans.reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# freeze profiles: dump + warm start
+# ---------------------------------------------------------------------------
+
+def test_freeze_dumps_profile_and_warm_starts(tmp_path, monkeypatch):
+    path = tmp_path / 'frozen.json'
+    monkeypatch.setenv('BF_AUTOTUNE_PROFILE', str(path))
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='freeze')
+    knob = next(k for k in tuner.knobs if k.name == 'gulp_batch')
+    knob.tick(_snap_for_batch(), objective=100.0)
+    assert knob.read() == 2
+    tuner.stop(wait=False)                   # dumps even unconverged
+    prof = json.loads(path.read_text())
+    assert prof['knobs']['gulp_batch'] == 2
+    assert 'ring_total_bytes' in prof['knobs']
+    # a fresh pipeline + tuner warm-starts from the dumped profile
+    p2 = _pipeline()
+    assert resolve_gulp_batch(p2) == 1
+    tuner2 = AutoTuner(p2, mode='on')
+    assert tuner2._warm_started
+    assert resolve_gulp_batch(p2) == 2
+
+
+def test_warm_start_profile_is_verifier_gated(tmp_path, monkeypatch):
+    """A stale profile (another topology / shared cwd) whose knobs
+    would introduce a BF-E on THIS pipeline must not warm-start it:
+    the same new_errors_vs gate every live retune passes applies at
+    startup, and the rejection is counted."""
+    from bifrost_tpu.analysis import verify
+    prof_path = tmp_path / 'stale_profile.json'
+    prof_path.write_text(json.dumps(
+        {'version': 1, 'knobs': {'gulp_batch': 16}}))
+    monkeypatch.setenv('BF_AUTOTUNE_PROFILE', str(prof_path))
+    p = _pipeline()
+    baseline = verify.verify_pipeline(p)
+
+    def vetoing_verify(pipeline):
+        if verify._overrides():
+            return baseline + [verify.Diagnostic(
+                'BF-E101', 'stale profile K deadlocks this ring',
+                block='x', ring='r')]
+        return baseline
+    monkeypatch.setattr(verify, 'verify_pipeline', vetoing_verify)
+    tuner = AutoTuner(p, mode='on')
+    assert not tuner._warm_started
+    assert resolve_gulp_batch(p) == 1        # profile NOT applied
+    assert counters.snapshot()['autotune.rejected'] == 1
+    # a harmless profile still warm-starts
+    monkeypatch.setattr(verify, 'verify_pipeline',
+                        lambda pipeline: baseline)
+    tuner2 = AutoTuner(p, mode='on')
+    assert tuner2._warm_started
+    assert resolve_gulp_batch(p) == 16
+
+
+def test_load_profile_rejects_garbage(tmp_path, monkeypatch):
+    path = tmp_path / 'bad.json'
+    monkeypatch.setenv('BF_AUTOTUNE_PROFILE', str(path))
+    assert autotune.load_profile() is None   # absent
+    path.write_text('not json')
+    assert autotune.load_profile() is None
+    path.write_text('{"no_knobs": 1}')
+    assert autotune.load_profile() is None
+
+
+def test_resolve_mode(monkeypatch):
+    assert autotune.resolve_mode(True) == 'on'
+    assert autotune.resolve_mode(False) == 'off'
+    assert autotune.resolve_mode('freeze') == 'freeze'
+    monkeypatch.setenv('BF_AUTOTUNE', '1')
+    assert autotune.resolve_mode(None) == 'on'
+    monkeypatch.setenv('BF_AUTOTUNE', 'freeze')
+    assert autotune.resolve_mode(None) == 'freeze'
+    monkeypatch.setenv('BF_AUTOTUNE', '0')
+    assert autotune.resolve_mode(None) == 'off'
+    monkeypatch.delenv('BF_AUTOTUNE')
+    assert autotune.resolve_mode(None) == 'off'
+    # an explicit run() argument overrides the environment
+    monkeypatch.setenv('BF_AUTOTUNE', '1')
+    assert autotune.resolve_mode(False) == 'off'
+
+
+# ---------------------------------------------------------------------------
+# end to end: a real pipeline under the controller thread
+# ---------------------------------------------------------------------------
+
+def test_autotune_pipeline_end_to_end(monkeypatch):
+    monkeypatch.setenv('BF_AUTOTUNE_INTERVAL', '0.05')
+    with bf.Pipeline() as p:
+        gulps = [np.full((NT, 4), float(k), dtype=np.float32)
+                 for k in range(40)]
+        src = NumpySourceBlock(gulps, _hdr(), gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='system')
+        sink = GatherSink(b)
+        p.run(autotune=True)
+    out = sink.result()
+    assert out.shape == (40 * NT, 4)
+    np.testing.assert_array_equal(out[NT:2 * NT], 1.0)
+    snap = counters.snapshot()
+    assert snap.get('autotune.ticks', 0) >= 1
+    # the knob-value counters were published for every knob
+    assert 'autotune.gulp_batch' in snap
+    assert 'autotune.sync_depth' in snap
+
+
+def test_autotune_off_by_default():
+    with bf.Pipeline() as p:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        sink = GatherSink(bf.blocks.copy(src, space='system'))
+        p.run()
+    assert sink.result() is not None
+    assert 'autotune.ticks' not in counters.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# mprobe coin-flip staleness — satellite
+# ---------------------------------------------------------------------------
+
+def _seed_mprobe(fam, key, ms):
+    from bifrost_tpu.ops import mprobe
+    full_key = '%s|%s' % (mprobe.backend_tag(), key)
+    mprobe._cache[fam] = {full_key: ('a', dict(ms), {})}
+    mprobe._flip_uses.pop((fam, full_key), None)
+    return full_key
+
+
+def test_mprobe_coin_flip_winner_reraced(monkeypatch, tmp_path):
+    from bifrost_tpu.ops import mprobe
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    monkeypatch.setenv('BF_MPROBE_REPROBE', '3')
+    calls = {'a': 0, 'b': 0}
+
+    def make(name):
+        def fn(x):
+            calls[name] += 1
+            return x
+        return fn
+    cands = {'a': make('a'), 'b': make('b')}
+    # margin 1.05 < noise 1.10: a COIN-FLIP ranking
+    _seed_mprobe('flip_fam', 'k1', {'a': 1.0, 'b': 1.05})
+    for _ in range(2):               # uses 1-2: served from cache
+        w, ms, _e = mprobe.select('flip_fam', 'k1', cands,
+                                  lambda: (np.ones(4, np.float32),))
+        assert w == 'a' and calls['a'] == 0
+    # use 3: budget spent — the entry is evicted and RE-RACED
+    w, ms, _e = mprobe.select('flip_fam', 'k1', cands,
+                              lambda: (np.ones(4, np.float32),))
+    assert calls['a'] > 0 and calls['b'] > 0
+    assert w in ('a', 'b')
+
+
+def test_mprobe_decisive_winner_never_reraced(monkeypatch, tmp_path):
+    from bifrost_tpu.ops import mprobe
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    monkeypatch.setenv('BF_MPROBE_REPROBE', '2')
+    calls = {'n': 0}
+
+    def fn(x):
+        calls['n'] += 1
+        return x
+    cands = {'a': fn, 'b': fn}
+    _seed_mprobe('dec_fam', 'k1', {'a': 1.0, 'b': 2.0})  # decisive
+    for _ in range(10):
+        w, _ms, _e = mprobe.select('dec_fam', 'k1', cands,
+                                   lambda: (np.ones(4, np.float32),))
+        assert w == 'a'
+    assert calls['n'] == 0
+
+
+def test_mprobe_reprobe_disabled_with_zero_budget(monkeypatch,
+                                                  tmp_path):
+    from bifrost_tpu.ops import mprobe
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    monkeypatch.setenv('BF_MPROBE_REPROBE', '0')
+    calls = {'n': 0}
+
+    def fn(x):
+        calls['n'] += 1
+        return x
+    cands = {'a': fn, 'b': fn}
+    _seed_mprobe('off_fam', 'k1', {'a': 1.0, 'b': 1.05})
+    for _ in range(10):
+        w, _ms, _e = mprobe.select('off_fam', 'k1', cands,
+                                   lambda: (np.ones(4, np.float32),))
+        assert w == 'a'
+    assert calls['n'] == 0
+
+
+def test_mprobe_disk_coin_flip_reraced(monkeypatch, tmp_path):
+    """A coin-flip winner persisted on DISK (older pre-decisive
+    policy) must also hit the reprobe budget: the eviction must not
+    reload the same entry from disk with a fresh budget."""
+    from bifrost_tpu.ops import mprobe
+    monkeypatch.setenv('BF_CACHE_DIR', str(tmp_path))
+    monkeypatch.setenv('BF_MPROBE_REPROBE', '2')
+    full_key = '%s|%s' % (mprobe.backend_tag(), 'k1')
+    path = mprobe.cache_path('disk_fam')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        json.dump({full_key: {'winner': 'a',
+                              'ms': {'a': 1.0, 'b': 1.05}}}, f)
+    mprobe._cache.pop('disk_fam', None)
+    mprobe._flip_uses.pop(('disk_fam', full_key), None)
+    calls = {'a': 0, 'b': 0}
+
+    def make(name):
+        def fn(x):
+            calls[name] += 1
+            return x
+        return fn
+    cands = {'a': make('a'), 'b': make('b')}
+    # use 1: served from disk, budgeted
+    w, _ms, _e = mprobe.select('disk_fam', 'k1', cands,
+                               lambda: (np.ones(4, np.float32),))
+    assert w == 'a' and calls['a'] == 0
+    # use 2: budget spent — evicted AND the disk copy must not be
+    # reloaded; the candidates are actually re-raced
+    w, _ms, _e = mprobe.select('disk_fam', 'k1', cands,
+                               lambda: (np.ones(4, np.float32),))
+    assert calls['a'] > 0 and calls['b'] > 0

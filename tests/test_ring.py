@@ -657,3 +657,104 @@ def test_out_of_order_span_release_frees_writer():
                     span.commit(4)
                 with seq.reserve(4, nonblocking=True) as span:
                     span.commit(0)
+
+
+# ---------------------------------------------------------------------------
+# deferred (non-blocking) resize — the auto-tuner's retune protocol
+# (docs/autotune.md): a resize requested while spans are open must
+# DEFER until the oldest open span releases instead of re-layouting
+# storage under a live span's zero-copy view
+# ---------------------------------------------------------------------------
+
+def test_deferred_resize_defers_under_write_span():
+    ring = Ring(space='system')
+    hdr = _hdr()
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=8,
+                               buf_nframe=24) as seq:
+            before = ring.total_span
+            with seq.reserve(8) as span:
+                view = span.data.as_numpy()
+                view[...] = 7.0
+                assert not ring.request_resize(1, before * 2)
+                assert ring.resize_pending
+                # the live view must still be the OLD storage: writes
+                # through it land in the committed data below
+                view[...] = 9.0
+                span.commit(8)
+            # oldest (only) open span released: the growth applies
+            assert not ring.resize_pending
+            assert ring.total_span >= before * 2
+    with ring.open_earliest_sequence(guarantee=True) as rseq:
+        with rseq.acquire(0, 8) as span:
+            np.testing.assert_array_equal(span.data.as_numpy(), 9.0)
+
+
+def test_deferred_resize_defers_under_read_span():
+    ring = Ring(space='system')
+    hdr = _hdr()
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=4,
+                               buf_nframe=16) as seq:
+            for k in range(3):
+                with seq.reserve(4) as span:
+                    span.data.as_numpy()[...] = float(k)
+                    span.commit(4)
+            before = ring.total_span
+            with ring.open_earliest_sequence(guarantee=True) as rseq:
+                first = rseq.acquire(0, 4)
+                assert not ring.request_resize(1, before * 2)
+                assert ring.resize_pending
+                np.testing.assert_array_equal(
+                    first.data.as_numpy(), 0.0)
+                first.release()
+            assert not ring.resize_pending
+            assert ring.total_span >= before * 2
+            # data written before the re-layout survives it
+            with ring.open_earliest_sequence(guarantee=True) as rseq:
+                with rseq.acquire(8, 4) as span:
+                    np.testing.assert_array_equal(
+                        span.data.as_numpy(), 2.0)
+
+
+def test_deferred_resize_applies_immediately_when_quiescent():
+    ring = Ring(space='system')
+    hdr = _hdr()
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=8,
+                               buf_nframe=24) as seq:
+            with seq.reserve(8) as span:
+                span.data.as_numpy()[...] = 1.0
+                span.commit(8)
+            before = ring.total_span
+            assert ring.request_resize(1, before * 2)
+            assert not ring.resize_pending
+            assert ring.total_span >= before * 2
+            # MAX semantics: a smaller request is a no-op, not a shrink
+            assert ring.request_resize(1, before)
+            assert ring.total_span >= before * 2
+
+
+def test_deferred_resize_multiple_open_spans_wait_for_all():
+    """The growth lands only when NO span remains open — releasing the
+    oldest while a newer span is still held must keep deferring (the
+    newer span's view is just as live)."""
+    ring = Ring(space='system')
+    hdr = _hdr()
+    with ring.begin_writing() as wr:
+        with wr.begin_sequence(hdr, gulp_nframe=4,
+                               buf_nframe=16) as seq:
+            for k in range(4):
+                with seq.reserve(4) as span:
+                    span.data.as_numpy()[...] = float(k)
+                    span.commit(4)
+            before = ring.total_span
+            with ring.open_earliest_sequence(guarantee=True) as rseq:
+                first = rseq.acquire(0, 4)
+                second = rseq.acquire(4, 4)
+                assert not ring.request_resize(1, before * 2)
+                first.release()
+                assert ring.resize_pending       # second still open
+                second.release()
+                assert not ring.resize_pending
+            assert ring.total_span >= before * 2

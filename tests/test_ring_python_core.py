@@ -78,3 +78,14 @@ test_open_span_survives_later_acquires = \
     test_ring.test_open_span_survives_later_acquires
 test_out_of_order_span_release_frees_writer = \
     test_ring.test_out_of_order_span_release_frees_writer
+
+# deferred (non-blocking) resize — the auto-tuner's retune protocol
+# must defer identically in the pure-Python core (docs/autotune.md)
+test_deferred_resize_defers_under_write_span = \
+    test_ring.test_deferred_resize_defers_under_write_span
+test_deferred_resize_defers_under_read_span = \
+    test_ring.test_deferred_resize_defers_under_read_span
+test_deferred_resize_applies_immediately_when_quiescent = \
+    test_ring.test_deferred_resize_applies_immediately_when_quiescent
+test_deferred_resize_multiple_open_spans_wait_for_all = \
+    test_ring.test_deferred_resize_multiple_open_spans_wait_for_all

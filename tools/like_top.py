@@ -170,12 +170,19 @@ def get_device_memory_usage(timeout=10.0):
     return data
 
 
-def collect_blocks(pids=None):
+def collect_blocks(pids=None, autotune=None):
     """Per-block rows across pipelines: pid/name/cmd/core and the perf
-    times (reference: like_top.py:305-330)."""
+    times (reference: like_top.py:305-330).  Pass a dict as
+    ``autotune`` to collect each process's ``analysis/autotune`` knob
+    panel from the SAME proclog walk (a separate collect_autotune()
+    pass would re-parse every proclog file per refresh)."""
     rows = {}
     for pid in (pids if pids is not None else list_pipelines()):
         contents = proclog.load_by_pid(pid)
+        if autotune is not None:
+            panel = contents.get('analysis', {}).get('autotune')
+            if panel:
+                autotune[pid] = panel
         cmd = get_command_line(pid)
         for block, logs in contents.items():
             if block == 'rings':
@@ -219,8 +226,21 @@ def _num(v):
         return 0.0
 
 
-def render_text(load, cpu, mem, dev, rows, sort_key='process',
-                sort_rev=True, width=140):
+def collect_autotune(pids=None):
+    """{pid: panel dict} from each process's ``analysis/autotune``
+    ProcLog — the closed-loop auto-tuner's live knob panel
+    (docs/autotune.md).  Empty when no controller is running."""
+    out = {}
+    for pid in (pids if pids is not None else list_pipelines()):
+        log = proclog.load_by_pid(pid).get('analysis', {}) \
+            .get('autotune')
+        if log:
+            out[pid] = log
+    return out
+
+
+def render_text(load, cpu, mem, dev, rows, tuners=None,
+                sort_key='process', sort_rev=True, width=140):
     """Render the full display as text lines (shared by --once and the
     curses loop)."""
     host = socket.gethostname()
@@ -271,6 +291,24 @@ def render_text(load, cpu, mem, dev, rows, sort_key='process',
                       d['wait99'] * 1e3, d['age99'] * 1e3, d['gpd'],
                       int(d['shards']), d['gops'],
                       d['cmd'][:max(width - 157, 0)]))
+    # live auto-tuner knob panel (analysis/autotune ProcLog, fed by
+    # the autotune.* counters — docs/autotune.md)
+    for pid in sorted(tuners or {}):
+        t = tuners[pid]
+        out.append('')
+        out.append('[autotune] pid %s  mode %s  ticks %s  retunes %s'
+                   '  converged %s%s'
+                   % (pid, t.get('mode', '?'), t.get('ticks', '?'),
+                      t.get('retunes', '?'),
+                      'yes' if _num(t.get('converged')) else 'no',
+                      '  FROZEN' if _num(t.get('frozen')) else ''))
+        knobs = sorted((k[len('knob.'):], v) for k, v in t.items()
+                       if k.startswith('knob.'))
+        if knobs:
+            out.append('           ' + '  '.join(
+                '%s=%s' % kv for kv in knobs)[:max(width - 11, 0)])
+        if t.get('last'):
+            out.append('           last: %s' % t['last'])
     return out
 
 
@@ -299,11 +337,12 @@ def run_curses(args):
                 sort_key = new_key
             now = time.time()
             if now - t_last > args.interval or state is None:
+                tuners = {}
                 state = (get_load_average(), get_processor_usage(),
                          get_memory_swap_usage(),
                          get_device_memory_usage() if args.devices
                          else None,
-                         collect_blocks())
+                         collect_blocks(autotune=tuners), tuners)
                 t_last = now
             maxy, maxx = scr.getmaxyx()
             lines = render_text(*state, sort_key=sort_key,
@@ -339,11 +378,13 @@ def main():
     if args.once:
         get_processor_usage()        # prime the delta state
         time.sleep(0.05)
+        tuners = {}
         lines = render_text(
             get_load_average(), get_processor_usage(),
             get_memory_swap_usage(),
             get_device_memory_usage() if args.devices else None,
-            collect_blocks(), sort_key=args.sort)
+            collect_blocks(autotune=tuners), tuners,
+            sort_key=args.sort)
         print('\n'.join(lines))
         return 0
     run_curses(args)

@@ -155,6 +155,24 @@ for i in $(seq 1 400); do
           exit "$grc"
         fi
       fi
+      # Auto-tune convergence gate: config 14 on the CPU backend — the
+      # closed-loop controller must tune a de-tuned cold start (K=1,
+      # sync=1) to within ~5% of the hand-tuned config-9 optimum with
+      # byte-identical outputs, and the converged controller (no
+      # retunes firing) must cost <2% on the hand-tuned arm.  The
+      # converged knob values land in BENCH_TUNE_${ROUND}.json.  A
+      # failure exits nonzero (the capture artifacts above are
+      # already in place).
+      if [ "${BF_SKIP_TUNE_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) auto-tune convergence gate (config 14, CPU)" >> "$LOG"
+        python tools/autotune_gate.py --out "BENCH_TUNE_${ROUND}.json" >> "$LOG" 2>&1
+        trc=$?
+        echo "$(date -u +%FT%TZ) autotune gate rc=$trc" >> "$LOG"
+        if [ "$trc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) auto-tune convergence gate FAILED" >> "$LOG"
+          exit "$trc"
+        fi
+      fi
       # Quantized-beamformer gate: config 13 on the CPU backend — the
       # measured quantized winner must beat the f32 baseline arm on
       # the end-to-end chain (min-of-N, alternating arms), stay inside
