@@ -21,6 +21,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -1971,6 +1972,356 @@ def bench_autotune(reps=5, nseq=2, gulp_per_seq=64, rounds=7):
     }
 
 
+# ---------------------------------------------------------------------------
+# config 15: chaos/soak — overload-resilient streaming under a scripted
+# fault schedule (docs/robustness.md "Overload & degradation"); gated by
+# tools/chaos_gate.py into CHAOS_SOAK_${ROUND}.json
+# ---------------------------------------------------------------------------
+
+_CHAOS_RX_SCRIPT = r'''
+import json, os, sys
+root = sys.argv[1]
+sys.path.insert(0, root)
+sys.path.insert(0, os.path.join(root, 'tests'))
+os.environ.setdefault('BF_SLO_MS', '5000')
+import bifrost_tpu as bf
+from bifrost_tpu import telemetry
+from util import GatherSink
+with bf.Pipeline() as p:
+    bsrc = bf.blocks.bridge_source('127.0.0.1', 0)
+    sink = GatherSink(bsrc)
+print('PORT %d' % bsrc.port, flush=True)
+p.run()
+snap = telemetry.snapshot()
+h = snap['histograms'].get('slo.exit_age_s') or {}
+res = sink.result()
+stamps = [hdr.get('_overload') for hdr in sink.headers
+          if isinstance(hdr, dict) and hdr.get('_overload')]
+reconnects = sum(1 for f in p.supervisor.failures
+                 if f.kind == 'reconnected')
+print('RESULT ' + json.dumps({
+    'rx_frames': 0 if res is None else int(res.shape[0]),
+    'rx_sequences': len(sink.headers),
+    'exit_age_p99_ms': round(h.get('p99', 0.0) * 1e3, 3),
+    'exit_age_count': h.get('count', 0),
+    'slo_violations': snap['counters'].get('slo.violations', 0),
+    'overload_stamps': stamps[-1:],
+    'reconnect_records': reconnects,
+    'health': p.health()['state'],
+}), flush=True)
+'''
+
+_CHAOS_TX_SCRIPT = r'''
+import json, os, sys, threading, time
+(root, port, tick_ms, ngulp, nsrc,
+ fault_after) = (sys.argv[1], int(sys.argv[2]), float(sys.argv[3]),
+                 int(sys.argv[4]), int(sys.argv[5]), int(sys.argv[6]))
+sys.path.insert(0, root)
+sys.path.insert(0, os.path.join(root, 'tests'))
+import numpy as np
+import bifrost_tpu as bf
+from bifrost_tpu.telemetry import counters
+from bifrost_tpu.testing import faults
+from util import NumpySourceBlock, simple_header, _NumpyReader
+
+NT, NC = 4, 64                       # 4 frames x 64 ch f32 = 1 KiB/gulp
+tick_s = tick_ms * 1e-3
+hdr = simple_header([-1, NC], 'f32', name='chaos', gulp_nframe=NT)
+hdr['tsamp'] = tick_s / NT           # frame time: SLO ages extrapolate
+gulp = np.arange(NT * NC, dtype=np.float32).reshape(NT, NC)
+
+class PacedSource(NumpySourceBlock):
+    """nsrc sequences of ngulp paced gulps; counts committed frames."""
+    produced_frames = 0
+    def __init__(self, *a, **kw):
+        NumpySourceBlock.__init__(self, *a, **kw)
+        self.sourcenames = ['src%d' % i for i in range(nsrc)]
+    def create_reader(self, sourcename):
+        return _NumpyReader([gulp.copy() for _ in range(ngulp)])
+    def on_data(self, reader, ospans):
+        time.sleep(tick_s)
+        out = NumpySourceBlock.on_data(self, reader, ospans)
+        PacedSource.produced_frames += out[0]
+        return out
+
+# one mid-stream failure on a restart-policy source: the supervisor
+# re-enters the source, which re-emits the failed sequence — frames
+# counted per commit, so the loss audit stays exact
+if fault_after > 0:
+    faults.inject('block.on_data', match='PacedSource',
+                  after=fault_after, count=1)
+
+states, stop = [], threading.Event()
+with bf.Pipeline(overload_policy='drop_oldest',
+                 on_failure='restart') as p:
+    src = PacedSource([], hdr, NT)
+    ring = src.orings[0]
+    bf.blocks.bridge_sink(src, '127.0.0.1', port, window=2)
+    # deep source ring: the credit window pins 2 spans; the rest is
+    # shed room so the paced source keeps moving through an outage
+    ring.resize(NT * NC * 4, NT * NC * 4 * 32)
+    def sample():
+        while not stop.wait(0.25):
+            try:
+                states.append(p.health()['state'])
+            except Exception:
+                pass
+    t = threading.Thread(target=sample, daemon=True); t.start()
+    try:
+        p.run()
+    finally:
+        stop.set(); t.join(timeout=2)
+        states.append(p.health()['state'])
+shed = ring.shed_stats()
+snap = counters.snapshot()
+print('RESULT ' + json.dumps({
+    'produced_frames': int(PacedSource.produced_frames),
+    'frame_nbyte': NC * 4,
+    'ring_shed_bytes': shed['shed_bytes'],
+    'ring_shed_gulps': shed['shed_gulps'],
+    'bridge_shed_bytes': snap.get('bridge.tx.shed_bytes', 0),
+    'bridge_shed_gulps': snap.get('bridge.tx.shed_gulps', 0),
+    'redial_attempts': snap.get('bridge.redial_attempts', 0),
+    'reconnects': snap.get('bridge.tx.reconnects', 0),
+    'circuit_open': snap.get('bridge.circuit_open', 0),
+    'block_restarts': snap.get('block_restarts', 0),
+    'states': sorted(set(states)),
+    'final_state': states[-1] if states else None,
+}), flush=True)
+'''
+
+
+class _ChaosProxy(object):
+    """TCP chaos proxy between the bridge sender and receiver: the
+    scripted fault schedule pauses forwarding (slow-consumer /
+    overload burst: kernel buffers fill, credit stalls, shedding
+    engages) and kills live connections (receiver 'restart': the
+    sender redials with jittered backoff and retransmits, the
+    receiver re-accepts and resumes)."""
+
+    def __init__(self, target_port):
+        import socket
+        self.target_port = target_port
+        self.listener = socket.socket()
+        self.listener.setsockopt(socket.SOL_SOCKET,
+                                 socket.SO_REUSEADDR, 1)
+        self.listener.bind(('127.0.0.1', 0))
+        self.listener.listen(8)
+        self.port = self.listener.getsockname()[1]
+        self.pause_until = 0.0
+        self._conns = []
+        self._lock = threading.Lock()
+        self._done = False
+        self._accepter = threading.Thread(target=self._accept_loop,
+                                          daemon=True)
+        self._accepter.start()
+
+    def _accept_loop(self):
+        import socket
+        while not self._done:
+            try:
+                client, _ = self.listener.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(
+                    ('127.0.0.1', self.target_port), timeout=10)
+                # clear the dial timeout: it would otherwise ride
+                # along as a 10 s recv timeout on the pump, turning
+                # long-idle phases into spurious disconnects
+                upstream.settimeout(None)
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._conns.append((client, upstream))
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(target=self._pump, args=(a, b),
+                                 daemon=True).start()
+
+    def _pump(self, src, dst):
+        while True:
+            while time.monotonic() < self.pause_until:
+                time.sleep(0.02)     # paused: stop reading — TCP
+                                     # backpressure does the rest
+            try:
+                buf = src.recv(65536)
+                if not buf:
+                    break
+                dst.sendall(buf)
+            except OSError:
+                break
+        # shutdown BEFORE close: close() alone does not wake the peer
+        # pump thread blocked in recv on the same fd (the classic
+        # close-vs-recv race) — the connection would then only die by
+        # timeout, stretching the kill far past its scheduled instant
+        for s in (src, dst):
+            try:
+                s.shutdown(2)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def pause(self, secs):
+        self.pause_until = time.monotonic() + secs
+
+    def kill_connections(self):
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for client, upstream in conns:
+            for s in (client, upstream):
+                try:
+                    s.shutdown(2)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        self._done = True
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        self.kill_connections()
+
+
+def bench_chaos_soak(tick_ms=5.0, ngulp=700, nsrc=3, fault_after=450,
+                     pause_at=2.0, pause_secs=3.0, kill_at=6.5,
+                     slo_ms=5000.0, timeout=300):
+    """Chaos/soak drill (docs/robustness.md): a bridged two-process
+    pipeline — paced source -> drop_oldest ring -> BridgeSink(window=2,
+    drop_oldest at the credit window) -> chaos TCP proxy ->
+    BridgeSource -> sink — driven through a scripted fault schedule:
+
+    1. healthy streaming;
+    2. at ``pause_at`` s the proxy stops forwarding for ``pause_secs``
+       (slow consumer / overload burst: credit stalls, the source ring
+       fills, counted shedding engages, health reaches SHEDDING);
+    3. at ``kill_at`` s the proxy kills every connection (receiver
+       'restart': jittered redial + retransmit on the sender,
+       re-accept + resume on the receiver);
+    4. a deterministic fault (testing/faults.py) fails the
+       restart-policy source mid-stream (supervisor restart, new
+       sequence carrying the cumulative ``_overload`` shed stamp);
+    5. calm tail until the stream ends — health must return to OK.
+
+    Invariants asserted (the acceptance criteria of the overload
+    layer):
+
+    - **no deadlock** — both processes exit cleanly inside the
+      timeout;
+    - **no silent loss** — produced == delivered + shed, byte-exact
+      across BOTH ledgers (ring.shed_bytes + bridge.tx.shed_bytes);
+    - **health traversal** — SHEDDING observed, final state OK;
+    - **bounded latency** — the sink's capture-to-exit p99 stays
+      under ``BF_SLO_MS`` while shedding;
+    - **recovery** — the kill produced redials + a resume (sender
+      reconnects counted, receiver reconnect records, stream ran to
+      a clean MSG_END), and the injected block failure produced
+      exactly one counted supervisor restart.
+    """
+    import subprocess
+    import select as select_mod
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS='cpu', BF_TRACE_CONTEXT='1',
+               BF_SLO_MS=str(slo_ms))
+    env.pop('BF_METRICS_FILE', None)
+    env.pop('BF_OVERLOAD_POLICY', None)
+    env.pop('BF_FAULTS', None)
+    rx = subprocess.Popen([sys.executable, '-c', _CHAOS_RX_SCRIPT,
+                           root],
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.PIPE, text=True, env=env)
+    proxy = None
+    schedule = []
+    try:
+        ready, _, _ = select_mod.select([rx.stdout], [], [], timeout)
+        if not ready:
+            raise RuntimeError('chaos receiver never reported a port')
+        line = rx.stdout.readline()
+        if not line.startswith('PORT '):
+            raise RuntimeError('chaos receiver said %r' % line)
+        rx_port = int(line.split()[1])
+        proxy = _ChaosProxy(rx_port)
+
+        def run_schedule():
+            t0 = time.monotonic()
+            time.sleep(max(pause_at - (time.monotonic() - t0), 0))
+            schedule.append(('pause', round(time.monotonic() - t0, 2)))
+            proxy.pause(pause_secs)
+            time.sleep(max(kill_at - (time.monotonic() - t0), 0))
+            schedule.append(('kill', round(time.monotonic() - t0, 2)))
+            proxy.kill_connections()
+
+        sched = threading.Thread(target=run_schedule, daemon=True)
+        sched.start()
+        tx = subprocess.run(
+            [sys.executable, '-c', _CHAOS_TX_SCRIPT, root,
+             str(proxy.port), str(tick_ms), str(ngulp), str(nsrc),
+             str(fault_after)],
+            capture_output=True, text=True, env=env, timeout=timeout)
+        rx_out, rx_err = rx.communicate(timeout=60)
+        if tx.returncode or rx.returncode:
+            raise RuntimeError(
+                'chaos arms failed: tx rc=%s rx rc=%s\n%s\n%s'
+                % (tx.returncode, rx.returncode, tx.stderr[-1500:],
+                   rx_err[-1500:]))
+        tx_res = _e2e_read_result(tx, tx.stdout.splitlines())
+        rx_res = _e2e_read_result(rx, rx_out.splitlines())
+    finally:
+        if proxy is not None:
+            proxy.close()
+        if rx.poll() is None:
+            rx.kill()
+
+    fb = tx_res['frame_nbyte']
+    produced = tx_res['produced_frames'] * fb
+    delivered = rx_res['rx_frames'] * fb
+    shed = tx_res['ring_shed_bytes'] + tx_res['bridge_shed_bytes']
+    invariants = {
+        'no_deadlock': True,          # both arms exited inside timeout
+        'no_silent_loss': bool(produced == delivered + shed),
+        'shedding_engaged': bool(shed > 0),
+        'health_traversal': bool(
+            'SHEDDING' in tx_res['states']
+            and tx_res['final_state'] == 'OK'),
+        'p99_under_budget': bool(
+            0 < rx_res['exit_age_p99_ms'] < slo_ms),
+        'recovered_reconnects': bool(
+            tx_res['reconnects'] >= 1
+            and rx_res['reconnect_records'] >= 1),
+        'restart_recovered': bool(tx_res['block_restarts'] == 1),
+        'overload_stamped': bool(rx_res['overload_stamps']),
+    }
+    return {
+        'config': 'chaos/soak: bridged two-process pipeline through a '
+                  'scripted overload+kill schedule (pause %.1fs@%.1fs,'
+                  ' kill@%.1fs, fault after %d gulps)'
+                  % (pause_secs, pause_at, kill_at, fault_after),
+        'value': round(shed / max(produced, 1) * 100.0, 2),
+        'unit': '% of produced bytes shed (all counted; loss ledger '
+                'byte-exact)',
+        'invariants': invariants,
+        'ledger': {
+            'produced_bytes': produced,
+            'delivered_bytes': delivered,
+            'ring_shed_bytes': tx_res['ring_shed_bytes'],
+            'bridge_shed_bytes': tx_res['bridge_shed_bytes'],
+            'unaccounted_bytes': produced - delivered - shed,
+        },
+        'schedule': schedule,
+        'tx': tx_res,
+        'rx': rx_res,
+        'pass': all(invariants.values()),
+    }
+
+
 ALL = {
     1: bench_sigproc_cpu,
     2: bench_spectroscopy,
@@ -1986,13 +2337,14 @@ ALL = {
     12: bench_e2e_observability,
     13: bench_beamform_chain,
     14: bench_autotune,
+    15: bench_chaos_soak,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-14; 0 = all')
+                    help='config number 1-15; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -2192,6 +2544,34 @@ def _verify_config14():
     return _verify_chain(gulp_batch=16)
 
 
+def _verify_config15():
+    """The chaos-soak topology (bench_chaos_soak's TX/RX pair) at the
+    block level: a drop_oldest source ring feeding a BridgeSink (which
+    declares its own shed tolerance, so the drop policy is BF-E180
+    clean by construction) plus the receiving pipeline."""
+    import sys as _sys
+    import os as _os
+    _tests = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests')
+    if _tests not in _sys.path:
+        _sys.path.insert(0, _tests)
+    import bifrost_tpu as bf
+    from bifrost_tpu.blocks.bridge import bridge_sink, bridge_source
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    NT, NC = 4, 64
+    raw = np.zeros((NT, NC), np.float32)
+    hdr = simple_header([-1, NC], 'f32', gulp_nframe=NT)
+    with bf.Pipeline() as prx:
+        src_rx = bridge_source('127.0.0.1', 0)
+        GatherSink(src_rx)
+    with bf.Pipeline(overload_policy='drop_oldest',
+                     on_failure='restart') as ptx:
+        src = NumpySourceBlock([raw.copy()], hdr, gulp_nframe=NT)
+        bridge_sink(src, '127.0.0.1', src_rx.port, window=2)
+    return [ptx, prx]
+
+
 def build_verify_topologies():
     """{name: builder} over every pipeline-shaped bench config.  Each
     builder returns a Pipeline, a list of Pipelines, or None when the
@@ -2205,6 +2585,7 @@ def build_verify_topologies():
         'config12_e2e': _verify_config12,
         'config13_beamform': _verify_config13,
         'config14_tune': _verify_config14,
+        'config15_chaos': _verify_config15,
     }
 
 

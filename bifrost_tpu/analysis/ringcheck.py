@@ -234,6 +234,22 @@ class _Shadow(object):
             self._exit(tok)
             self._note('reserve.abort', '')
 
+    def shed_advance(self, new_tail):
+        """A ``drop_oldest`` overload shed (docs/robustness.md)
+        forcibly advanced guaranteed readers' CORE guarantees up to
+        ``new_tail`` — clamped at each reader's oldest open span.
+        Mirror that in the shadow pins so the legitimately-admitted
+        overwriting reserve is not flagged as a guarantee_pin
+        violation (readers holding open spans keep their pin: the
+        core clamped there too, so the reserve stays bounded by
+        them)."""
+        with self.lock:
+            self._note('shed', 'new_tail=%d' % new_tail)
+            for rd in self.readers.values():
+                if rd.guarantee and rd.pin is not None \
+                        and not rd.opens:
+                    rd.pin = max(rd.pin, new_tail)
+
     def reserve_done(self, tok, span, begin, nbyte, ring_size):
         with self.lock:
             self._exit(tok)

@@ -59,6 +59,10 @@ CODES = {
     'BF-W152': 'bridge window > 1 on the v1 wire (no credit flow)',
     'BF-W160': 'macro-gulp batch requested but statically ineligible',
     'BF-I161': 'macro-gulp batch falls back on a host/compute block',
+    'BF-E180': 'drop overload policy on a ring with a guaranteed '
+               'reader that did not declare shed tolerance '
+               '(silent-loss hazard)',
+    'BF-W181': 'bridge per-stream quota smaller than one (macro-)span',
     'BF-W170': 'float GEMM path on ring-declared quantized (ci8/ci4) '
                'data',
     'BF-I170': 'header propagation stops at this block',
@@ -907,9 +911,98 @@ def new_errors_vs(baseline_diags, candidate_diags):
             if d.is_error and (d.code, d.block, d.ring) not in seen]
 
 
+def _check_overload(g, diags):
+    """Overload-policy misconfigurations (docs/robustness.md "Overload
+    & degradation"):
+
+    - **BF-E180** — a drop overload policy on a ring read by a
+      GUARANTEED consumer that did not declare ``shed_tolerant``: the
+      reader's guarantee says "I must see every frame", the policy
+      says "frames may be dropped"; the contradiction is a silent-loss
+      hazard (gaps surface only as zero-filled skips the consumer
+      never asked to tolerate).  Either make the consumer
+      shed-tolerant (it handles ``nframe_skipped``/the ``_overload``
+      header stamp), read unguaranteed, or keep the ring on 'block'.
+    - **BF-W181** — a bridge sender's per-stream quota bucket is
+      smaller than ONE span at the sequence's (macro-)gulp geometry:
+      every span exceeds the bucket, so under a drop policy the
+      stream sheds to zero throughput (and under 'block' every span
+      pays full refill time)."""
+    from ..pipeline import resolve_overload_policy
+    from ..blocks.bridge import BridgeSink
+    for b in g.blocks:
+        try:
+            policy = resolve_overload_policy(b)
+        except ValueError as exc:
+            diags.append(Diagnostic(
+                'BF-E180', 'block %r: %s' % (b.name, exc),
+                block=b.name))
+            continue
+        if policy in ('drop_oldest', 'drop_newest'):
+            for oring in getattr(b, 'orings', ()) or ():
+                rid = id(_base(oring))
+                for consumer in g.consumers.get(rid, ()):
+                    if not getattr(consumer, 'guarantee', True):
+                        continue       # unguaranteed: loss is its
+                                       # declared contract already
+                    if getattr(consumer, 'shed_tolerant', None):
+                        continue
+                    diags.append(Diagnostic(
+                        'BF-E180',
+                        'ring %r runs overload policy %r but its '
+                        'guaranteed reader %r never declared '
+                        'shed_tolerant: drops would surface as '
+                        'silent zero-filled gaps in a stream the '
+                        'reader contracted to see whole.  Mark the '
+                        'consumer BlockScope(shed_tolerant=True) '
+                        '(it must handle nframe_skipped / the '
+                        '_overload header stamp), read '
+                        'unguaranteed, or keep the ring on '
+                        "'block'"
+                        % (_ring_name(oring), policy, consumer.name),
+                        block=consumer.name,
+                        ring=_ring_name(oring)))
+    for b in g.blocks:
+        if not isinstance(b, BridgeSink):
+            continue
+        quota = getattr(b, 'quota_bytes_per_s', None)
+        if quota is None:
+            from ..io.bridge import bridge_quota_mbps
+            quota = bridge_quota_mbps() * 1e6
+        if not quota or quota <= 0:
+            continue
+        irings = getattr(b, 'irings', ()) or ()
+        if not irings:
+            continue
+        stream = g.streams.get(id(_base(irings[0])))
+        if stream is None or stream.header is None:
+            continue
+        try:
+            from ..ring import _tensor_info
+            fb = _tensor_info(stream.header)['frame_nbyte']
+            gulp = b.gulp_nframe or stream.gulp or 1
+            k = _static_k_requested(b) or 1
+            span_nbyte = int(gulp) * int(k) * int(fb)
+        except Exception:
+            continue
+        # bucket capacity = one second of quota (io/bridge._TokenBucket)
+        if span_nbyte > quota:
+            diags.append(Diagnostic(
+                'BF-W181',
+                'bridge sink %r per-stream quota (%.0f B/s) is '
+                'smaller than one %s-frame span (%d bytes, '
+                'gulp=%s x K=%s): every span overflows the token '
+                'bucket — a drop policy sheds the stream to zero, '
+                "'block' rate-limits every span by its full refill "
+                'time.  Raise the quota above one span per second '
+                'or shrink the macro batch'
+                % (b.name, quota, gulp * k, span_nbyte, gulp, k),
+                block=b.name, ring=_ring_name(irings[0])))
+
+
 _CHECKS = (_check_tensor_contracts, _check_ring_sizing,
            _check_donation, _check_mesh, _check_bridge, _check_macro,
-           _check_quantization)
+           _check_quantization, _check_overload)
 
 
 def verify_pipeline(pipeline):

@@ -30,6 +30,10 @@ Typical topology (sender host / receiver host)::
 
 from __future__ import annotations
 
+import os
+import threading
+import time
+
 from ..pipeline import Block
 from ..proclog import ProcLog
 from ..io.bridge import (RingSender, RingReceiver, BridgeListener,
@@ -37,9 +41,63 @@ from ..io.bridge import (RingSender, RingReceiver, BridgeListener,
                          bridge_window, bridge_crc)
 # one knob for all transient-socket budgets: BF_IO_RETRY_MAX (default
 # 8) is both the dial-retry budget and the reconnect budget here
-from ..io.udp_socket import _retry_budget as _reconnect_budget
+from ..io.udp_socket import (_retry_budget as _reconnect_budget,
+                             retry_backoff_s)
 
-__all__ = ['BridgeSink', 'BridgeSource', 'bridge_sink', 'bridge_source']
+__all__ = ['BridgeSink', 'BridgeSource', 'bridge_sink', 'bridge_source',
+           'CircuitOpenError']
+
+
+class CircuitOpenError(ConnectionError):
+    """Raised by a BridgeSink dial while its circuit breaker is open:
+    the peer exhausted a full redial budget moments ago, so further
+    dials fast-fail for a cool-off window (``BF_BRIDGE_COOLOFF_SECS``)
+    instead of hammering a dead endpoint — the supervisor's restart
+    backoff then paces recovery attempts."""
+
+
+def _cooloff_secs():
+    try:
+        return max(float(os.environ.get('BF_BRIDGE_COOLOFF_SECS', '')
+                         or 5.0), 0.0)
+    except ValueError:
+        return 5.0
+
+
+class _CircuitBreaker(object):
+    """Per-endpoint dial circuit breaker (docs/robustness.md): opened
+    when a sender EXHAUSTS its reconnect budget (individual dial
+    failures are the redial backoff's business, not the breaker's);
+    while open, dials fast-fail with :class:`CircuitOpenError`.
+    After the cool-off dials are admitted again (half-open); a
+    successful dial closes the circuit, another budget exhaustion
+    re-opens a full window."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._open_until = 0.0
+
+    def check(self, peer):
+        with self._lock:
+            now = time.monotonic()
+            if now < self._open_until:
+                raise CircuitOpenError(
+                    'bridge circuit to %s open for another %.1fs '
+                    '(redial budget exhausted)'
+                    % (peer, self._open_until - now))
+
+    def success(self):
+        with self._lock:
+            self._open_until = 0.0
+
+    def failure(self):
+        """A whole sender run ended in transport failure (the redial
+        budget is spent): (re)open the circuit for a cool-off window.
+        (The ``bridge.circuit_open`` counter is incremented by the
+        sender's budget-exhaustion path, the event that drives
+        this.)"""
+        with self._lock:
+            self._open_until = time.monotonic() + _cooloff_secs()
 
 
 class _BridgeBlock(Block):
@@ -90,6 +148,7 @@ class BridgeSink(_BridgeBlock):
     def __init__(self, iring, address, port, nstreams=None, window=None,
                  crc=None, guarantee=True, protocol=None,
                  connect_timeout=10.0, reconnect_max=None,
+                 quota_bytes_per_s=None, quota_gulps_per_s=None,
                  *args, **kwargs):
         super(BridgeSink, self).__init__([iring], *args, **kwargs)
         self.orings = []
@@ -112,6 +171,20 @@ class BridgeSink(_BridgeBlock):
         self.connect_timeout = float(connect_timeout)
         self.reconnect_max = _reconnect_budget() if reconnect_max is None \
             else int(reconnect_max)
+        #: per-stream quotas at the sender (None = BF_BRIDGE_QUOTA_*
+        #: env defaults; 0 = unlimited) — docs/robustness.md
+        self.quota_bytes_per_s = quota_bytes_per_s
+        self.quota_gulps_per_s = quota_gulps_per_s
+        #: reading a drop-policy ring through the credit window is
+        #: this block's JOB (sheds are counted, stamped, and surfaced
+        #: through its own ledger): declare shed tolerance so the
+        #: static verifier does not flag the guaranteed read (BF-E180)
+        if self.shed_tolerant is None:
+            self._shed_tolerant = True
+        #: per-endpoint dial circuit breaker (persists across
+        #: supervisor restarts of this block)
+        self._breaker = _CircuitBreaker()
+        self._shed_recorded = False
         self._sender = None
         self.out_proclog = ProcLog(self.name + '/out')
         self.out_proclog.update({'nring': 0})
@@ -124,8 +197,17 @@ class BridgeSink(_BridgeBlock):
         return ['system']
 
     def _connect(self):
-        return connect_striped(self.address, self.port, self.nstreams,
-                               timeout=self.connect_timeout)
+        # fast-fail while the circuit is open; a SUCCESSFUL dial
+        # closes it.  An individual dial failure does NOT open the
+        # breaker — that is the jittered redial backoff's job; the
+        # breaker only opens when a whole sender run exhausts its
+        # reconnect budget (see main)
+        self._breaker.check('%s:%d' % (self.address, self.port))
+        socks = connect_striped(self.address, self.port,
+                                self.nstreams,
+                                timeout=self.connect_timeout)
+        self._breaker.success()
+        return socks
 
     def _reconnect(self):
         exc = ConnectionError("bridge link to %s:%d dropped; redialing"
@@ -133,8 +215,29 @@ class BridgeSink(_BridgeBlock):
         self._record_reconnect(exc)
         return self._connect()
 
+    def _record_shed(self, reason, ngulps, nbyte):
+        """RingSender.on_shed callback: surface the FIRST shed of a
+        run to the supervisor's failure record (kind='degraded') so
+        the overload shows in pipeline history, not just counters —
+        later sheds of the same run only count (one record per
+        overload episode, not per gulp)."""
+        if self._shed_recorded:
+            return
+        self._shed_recorded = True
+        supervisor = getattr(self.pipeline, 'supervisor', None)
+        if supervisor is not None:
+            from ..supervision import BlockFailure
+            exc = RuntimeError(
+                'bridge sender shedding under overload (%s): '
+                '%d gulp(s) / %d byte(s) dropped, counted on '
+                'bridge.tx.shed_*' % (reason, ngulps, nbyte))
+            supervisor.record(BlockFailure(self.name, exc,
+                                           kind='degraded',
+                                           fatal=False))
+
     def main(self, orings):
         from ..macro import resolve_gulp_batch
+        from ..pipeline import resolve_overload_policy
         sender = RingSender(
             self.iring,
             gulp_nframe=self.gulp_nframe,
@@ -148,8 +251,15 @@ class BridgeSink(_BridgeBlock):
             reconnect_max=self.reconnect_max,
             shutdown_event=self.shutdown_event,
             heartbeat=self.heartbeat,
-            name=self.name)
+            name=self.name,
+            overload_policy=resolve_overload_policy(self),
+            quota_bytes_per_s=self.quota_bytes_per_s,
+            quota_gulps_per_s=self.quota_gulps_per_s,
+            on_shed=self._record_shed)
         self._sender = sender
+        # one 'degraded' supervisor record per RUN: a restarted main
+        # (new overload episode) records again
+        self._shed_recorded = False
         # When the producing block lives in THIS pipeline, pin the read
         # guarantee BEFORE checking in at the init barrier: the producer
         # creates its output sequence and only starts committing gulps
@@ -164,6 +274,15 @@ class BridgeSink(_BridgeBlock):
         self._release_init_barrier()
         try:
             sender.run()
+        except (ConnectionError, OSError):
+            # the sender gave up (redial budget spent, transport
+            # aborted): open the circuit so an on_failure='restart'
+            # policy paces further dials instead of hammering a dead
+            # peer.  Not during shutdown — a teardown wakeup is not a
+            # peer failure.
+            if not self.shutdown_event.is_set():
+                self._breaker.failure()
+            raise
         finally:
             sender.close()
 
@@ -253,8 +372,15 @@ class BridgeSource(_BridgeBlock):
                     if attempts > self.reconnect_max:
                         raise
                     # sender dropped mid-stream: re-accept and resume
-                    # (retransmitted frames dedup by sequence number)
+                    # (retransmitted frames dedup by sequence number),
+                    # after a full-jitter backoff so a flapping peer
+                    # doesn't spin the accept loop hot
                     self._record_reconnect(exc)
+                    from ..io.bridge import bridge_backoff_cap
+                    delay = retry_backoff_s(attempts, backoff=0.05,
+                                            cap=bridge_backoff_cap())
+                    if delay and self.shutdown_event.wait(delay):
+                        return
         finally:
             self.listener.close()
             self.listener = None

@@ -123,6 +123,75 @@ def bridge_crc():
     return os.environ.get('BF_BRIDGE_CRC', '0') == '1'
 
 
+def bridge_quota_mbps(default=0.0):
+    """Per-stream byte quota at the sender: ``BF_BRIDGE_QUOTA_MBPS``
+    MB/s per stream (0 = unlimited)."""
+    try:
+        return max(float(os.environ.get('BF_BRIDGE_QUOTA_MBPS', '')
+                         or default), 0.0)
+    except ValueError:
+        return default
+
+
+def bridge_quota_gulps(default=0.0):
+    """Per-stream gulp quota at the sender:
+    ``BF_BRIDGE_QUOTA_GULPS`` gulps/s per stream (0 = unlimited)."""
+    try:
+        return max(float(os.environ.get('BF_BRIDGE_QUOTA_GULPS', '')
+                         or default), 0.0)
+    except ValueError:
+        return default
+
+
+def bridge_backoff_cap(default=2.0):
+    """Cap of the full-jitter exponential redial backoff:
+    ``BF_BRIDGE_BACKOFF_CAP`` seconds (default 2.0)."""
+    try:
+        return max(float(os.environ.get('BF_BRIDGE_BACKOFF_CAP', '')
+                         or default), 0.0)
+    except ValueError:
+        return default
+
+
+class _TokenBucket(object):
+    """Token bucket for the per-stream sender quotas: refills at
+    ``rate`` units/s up to ``capacity``.  ``admit`` is
+    consume-or-refuse (drop policies); ``take_with_debt`` always
+    consumes and returns the time to sleep until the bucket is whole
+    again (block policy = rate limiting, never starvation — a span
+    larger than the capacity still passes, it just pays its full
+    refill time)."""
+
+    __slots__ = ('rate', 'capacity', 'tokens', 'stamp')
+
+    def __init__(self, rate, capacity=None):
+        self.rate = float(rate)
+        self.capacity = float(capacity if capacity is not None
+                              else max(rate, 1.0))
+        self.tokens = self.capacity
+        self.stamp = time.monotonic()
+
+    def _refill(self):
+        now = time.monotonic()
+        self.tokens = min(self.capacity,
+                          self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+
+    def admit(self, n):
+        self._refill()
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def take_with_debt(self, n):
+        self._refill()
+        self.tokens -= n
+        if self.tokens >= 0:
+            return 0.0
+        return -self.tokens / max(self.rate, 1e-9)
+
+
 def _counters():
     from ..telemetry import counters
     return counters
@@ -419,7 +488,9 @@ class RingSender(object):
                  protocol=WIRE_VERSION, window=None, crc=None,
                  gulp_batch=1, naive=False, dial=None, reconnect=None,
                  reconnect_max=3, shutdown_event=None, heartbeat=None,
-                 drain_timeout=60.0, name=None):
+                 drain_timeout=60.0, name=None, overload_policy=None,
+                 quota_bytes_per_s=None, quota_gulps_per_s=None,
+                 on_shed=None):
         self.ring = ring
         if sock is None:
             self.socks = []
@@ -469,6 +540,36 @@ class RingSender(object):
         #: bytes of one span at the current sequence's batch geometry —
         #: what a runtime window retune needs to grow the source ring
         self._cur_span_nbyte = 0
+        #: overload policy AT THE CREDIT WINDOW (docs/robustness.md
+        #: "Overload & degradation"): 'block' (default — classic
+        #: credit backpressure into the source ring), 'drop_newest'
+        #: (no credit -> the just-read gulp is released unsent,
+        #: counted), 'drop_oldest' (after a credit stall the sender
+        #: skips the accumulated backlog and ships the freshest data,
+        #: counted).  Shed spans were never emitted, so the reconnect
+        #: retransmit window and the shed ledger COMPOSE: a redial
+        #: replays only unacked live frames, never dropped spans.
+        self.overload_policy = overload_policy or 'block'
+        if self.overload_policy not in ('block', 'drop_oldest',
+                                        'drop_newest'):
+            raise ValueError("Unknown bridge overload policy %r"
+                             % (self.overload_policy,))
+        #: per-stream quotas (token buckets keyed by the sequence's
+        #: trace id): byte and gulp rates per second; 0/None =
+        #: unlimited.  Fair by construction — one stream exhausting
+        #: its bucket sheds (drop policies) or rate-limits (block)
+        #: only itself.
+        self.quota_bytes_per_s = float(
+            quota_bytes_per_s if quota_bytes_per_s is not None
+            else bridge_quota_mbps() * 1e6)
+        self.quota_gulps_per_s = float(
+            quota_gulps_per_s if quota_gulps_per_s is not None
+            else bridge_quota_gulps())
+        self.on_shed = on_shed
+        self._quota_buckets = {}     # stream id -> (bytes_tb, gulps_tb)
+        self._shed_gulps = 0
+        self._shed_bytes = 0
+        self._shed_by_stream = {}    # stream id -> [spans, bytes]
 
     # -- public ------------------------------------------------------------
     def prime(self):
@@ -578,7 +679,9 @@ class RingSender(object):
                      'npackets': self._tx_frames,
                      'nspans': self._tx_spans,
                      'rate_MBps': round(rate, 3),
-                     'reconnects': self._reconnects}, force=force)
+                     'reconnects': self._reconnects,
+                     'shed_gulps': self._shed_gulps,
+                     'shed_bytes': self._shed_bytes}, force=force)
         except Exception:
             pass
 
@@ -587,6 +690,142 @@ class RingSender(object):
             self._h_stall = _histograms().get_or_create(
                 'bridge.%s.send_stall_s' % self.name, unit='s')
         self._h_stall.record(dt)
+
+    # -- overload shedding & quotas (docs/robustness.md) -------------------
+    def _stream_id(self):
+        return self._cur_trace or ('seq%d' % self._cur_seq)
+
+    def _note_shed(self, nbyte, ngulps, reason):
+        """Count one sender-side shed (credit window, backlog skip, or
+        quota) in LOGICAL gulps + bytes: the
+        ``bridge.tx.shed_gulps/.shed_bytes`` counters (quota sheds
+        additionally on ``bridge.tx.quota_shed_gulps``), the
+        per-stream ledger the stats proclog publishes, and the
+        BridgeSink's ``on_shed`` degraded-mode callback."""
+        c = _counters()
+        c.inc('bridge.tx.shed_gulps', ngulps)
+        c.inc('bridge.tx.shed_bytes', nbyte)
+        if reason == 'quota':
+            c.inc('bridge.tx.quota_shed_gulps', ngulps)
+        stream = self._stream_id()
+        with self._lock:
+            self._shed_gulps += ngulps
+            self._shed_bytes += nbyte
+            entry = self._shed_by_stream.setdefault(stream, [0, 0])
+            entry[0] += ngulps
+            entry[1] += nbyte
+            while len(self._shed_by_stream) > self._MAX_STREAM_STATE:
+                self._shed_by_stream.pop(
+                    next(iter(self._shed_by_stream)))
+        if self.on_shed is not None:
+            try:
+                self.on_shed(reason, ngulps, nbyte)
+            except Exception:
+                pass
+        self._publish_stats()
+
+    def shed_stats(self):
+        """Cumulative sender-side shed ledger: total gulps/bytes and
+        the per-stream split (the fair-shedding audit)."""
+        with self._lock:
+            return {'shed_gulps': self._shed_gulps,
+                    'shed_bytes': self._shed_bytes,
+                    'by_stream': {k: tuple(v) for k, v
+                                  in self._shed_by_stream.items()}}
+
+    #: retained per-stream quota buckets / shed-ledger entries: the
+    #: sender streams ONE sequence at a time, so old streams' state is
+    #: only history — bound it so a months-long sender with thousands
+    #: of sequences doesn't grow without limit
+    _MAX_STREAM_STATE = 64
+
+    def _quota_state(self, stream):
+        tbs = self._quota_buckets.get(stream)
+        if tbs is None:
+            b = _TokenBucket(self.quota_bytes_per_s) \
+                if self.quota_bytes_per_s > 0 else None
+            g = _TokenBucket(self.quota_gulps_per_s) \
+                if self.quota_gulps_per_s > 0 else None
+            tbs = self._quota_buckets[stream] = (b, g)
+            while len(self._quota_buckets) > self._MAX_STREAM_STATE:
+                self._quota_buckets.pop(
+                    next(iter(self._quota_buckets)))
+        return tbs
+
+    def _quota_admit(self, nbyte, ngulps):
+        """Apply the per-stream quota to one span: True = send it.
+        Under a drop policy an over-quota span is refused (the caller
+        sheds it); under 'block' the span always passes but pays its
+        refill time first — rate limiting, not starvation."""
+        if self.quota_bytes_per_s <= 0 and self.quota_gulps_per_s <= 0:
+            return True
+        b, g = self._quota_state(self._stream_id())
+        if self.overload_policy == 'block':
+            wait = 0.0
+            if b is not None:
+                wait = max(wait, b.take_with_debt(nbyte))
+            if g is not None:
+                wait = max(wait, g.take_with_debt(ngulps))
+            while wait > 0 and not self._stop_requested():
+                step = min(wait, 0.05)
+                time.sleep(step)
+                wait -= step
+            return True
+        ok = True
+        if b is not None and not b.admit(nbyte):
+            ok = False
+        if ok and g is not None and not g.admit(ngulps):
+            # refund the byte tokens the first bucket consumed
+            if b is not None:
+                b.tokens = min(b.capacity, b.tokens + nbyte)
+            ok = False
+        return ok
+
+    def _credit_available(self):
+        """Non-blocking credit check (drop policies): True when a span
+        may be emitted now.  Transport errors still recover through
+        the blocking path."""
+        self._check_error()
+        with self._credit:
+            return self._inflight_spans < self.window \
+                and self._error is None
+
+    def _skip_backlog(self, seq, offset, batch, frame_nbyte,
+                      hdr_gulp=1):
+        """drop_oldest at the credit window: after a stall, skip the
+        accumulated backlog beyond ``window`` spans and resume at the
+        freshest data — the skipped (oldest unsent) gulps are counted
+        shed.  The reader guarantee advances at the next acquire, so
+        the source ring's writer unblocks without replaying a stale
+        burst after a reconnect (resume-after-shed)."""
+        try:
+            occ = self.ring.occupancy()
+            head = occ.get('head')
+            if head is None:
+                return offset
+            begin = seq._seq.begin
+            end = getattr(seq._seq, 'end', None)
+            if end is not None:
+                head = min(head, end)
+            avail = (head - begin) // max(frame_nbyte, 1)
+            # frames below the ring tail were already lost (and
+            # COUNTED) by the ring's own drop policy — the bridge
+            # ledger must only cover readable frames it chooses to
+            # skip, or the two ledgers would double-count the audit
+            tail_f = -(-max(occ.get('tail', 0) - begin, 0)
+                       // max(frame_nbyte, 1))
+        except Exception:
+            return offset
+        start = max(offset, tail_f)
+        backlog_spans = (avail - start) // max(batch, 1)
+        keep = max(int(self.window), 1)
+        if backlog_spans <= keep:
+            return offset
+        nskip = backlog_spans - keep
+        gulps_per_span = max(1, -(-batch // max(hdr_gulp, 1)))
+        self._note_shed(nskip * batch * frame_nbyte,
+                        nskip * gulps_per_span, 'backlog')
+        return start + nskip * batch
 
     # -- naive / v1 paths --------------------------------------------------
     def _iter_sequences(self):
@@ -843,10 +1082,17 @@ class RingSender(object):
             self._recover(exc)
 
     def _recover(self, exc):
-        """Transport failure: redial through ``reconnect`` (bounded
-        attempts) and retransmit every unacked frame, else abort."""
+        """Transport failure: redial through ``reconnect`` with
+        full-jitter exponential backoff (bounded attempts, counted on
+        ``bridge.redial_attempts``) and retransmit every unacked
+        frame; budget exhaustion counts ``bridge.circuit_open`` and
+        aborts — the BridgeSink's circuit breaker then fast-fails
+        further dials for a cool-off instead of hammering a dead
+        peer."""
+        from .udp_socket import retry_backoff_s
         if self.reconnect is None \
                 or self._reconnects >= self.reconnect_max:
+            _counters().inc('bridge.circuit_open')
             self._abort()
             raise exc
         self._stop_threads(join=True)
@@ -856,9 +1102,28 @@ class RingSender(object):
             except OSError:
                 pass
         last = exc
+        cap = bridge_backoff_cap()
+        attempt0 = self._reconnects
         while self._reconnects < self.reconnect_max:
             self._reconnects += 1
             _counters().inc('bridge.tx.reconnects')
+            _counters().inc('bridge.redial_attempts')
+            # full-jitter exponential backoff between redials (base
+            # 50 ms, cap BF_BRIDGE_BACKOFF_CAP): a fleet of senders
+            # redialing a restarted receiver must not arrive in
+            # synchronized waves.  Interruptible by shutdown.
+            delay = retry_backoff_s(self._reconnects - attempt0,
+                                    backoff=0.05, cap=cap)
+            if delay > 0:
+                if self.shutdown_event is not None:
+                    if self.shutdown_event.wait(delay):
+                        # clean shutdown mid-backoff: abort the
+                        # transport and surface the original error —
+                        # NOT a budget exhaustion, so no circuit_open
+                        self._abort()
+                        raise last
+                else:
+                    time.sleep(delay)
             try:
                 self.socks = list(self.reconnect())
                 self._handshake(self.socks)
@@ -886,6 +1151,7 @@ class RingSender(object):
                         s.close()
                     except OSError:
                         pass
+        _counters().inc('bridge.circuit_open')
         self._abort()
         raise last
 
@@ -1070,8 +1336,28 @@ class RingSender(object):
                 except Exception:
                     self._cur_span_nbyte = 0
                 offset = 0
+                try:
+                    frame_nbyte = seq.tensor['frame_nbyte']
+                except Exception:
+                    frame_nbyte = 1
                 while not self._stop_requested():
-                    self._wait_credit()
+                    # overload policy at the credit window
+                    # (docs/robustness.md): 'block' waits like the
+                    # classic pump; 'drop_newest' sheds the gulp in
+                    # hand when no credit is available; 'drop_oldest'
+                    # waits, then skips the accumulated backlog and
+                    # resumes at the freshest data
+                    shed_this = False
+                    if self.overload_policy == 'drop_newest':
+                        shed_this = not self._credit_available()
+                        if shed_this:
+                            self._check_error()
+                    else:
+                        self._wait_credit()
+                        if self.overload_policy == 'drop_oldest':
+                            offset = self._skip_backlog(
+                                seq, offset, batch, frame_nbyte,
+                                hdr_gulp)
                     try:
                         span = seq.acquire(offset, batch)
                     except EndOfDataStop:
@@ -1087,6 +1373,24 @@ class RingSender(object):
                             continue
                         break
                     offset = advanced
+                    ngulps = max(1, -(-span.nframe
+                                      // max(hdr_gulp, 1)))
+                    if not shed_this and \
+                            not self._quota_admit(
+                                span.nframe * frame_nbyte, ngulps):
+                        span.release()
+                        self._note_shed(span.nframe * frame_nbyte,
+                                        ngulps, 'quota')
+                        if self.heartbeat is not None:
+                            self.heartbeat()
+                        continue
+                    if shed_this:
+                        nbyte = span.nframe * frame_nbyte
+                        span.release()
+                        self._note_shed(nbyte, ngulps, 'credit')
+                        if self.heartbeat is not None:
+                            self.heartbeat()
+                        continue
                     self._emit_span(span, hdr_gulp)
                 self._emit(MSG_END_SEQ)
                 if self._stop_requested():

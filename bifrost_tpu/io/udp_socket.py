@@ -17,7 +17,8 @@ import select
 import socket
 import time as time_mod
 
-__all__ = ['Address', 'UDPSocket', 'retry_transient']
+__all__ = ['Address', 'UDPSocket', 'retry_transient',
+           'retry_backoff_s']
 
 #: errnos worth retrying with backoff: interrupted syscalls and the
 #: ICMP port-unreachable a connected UDP socket reports as
@@ -39,11 +40,36 @@ def _retry_backoff():
         return 0.005
 
 
+def _retry_cap():
+    try:
+        return float(os.environ.get('BF_IO_RETRY_CAP', '') or 0.25)
+    except ValueError:
+        return 0.25
+
+
+def retry_backoff_s(attempt, backoff=None, cap=None):
+    """Sleep length for retry ``attempt`` (1-based): FULL-JITTER
+    exponential backoff — ``uniform(0, min(cap, base * 2**(n-1)))``.
+    A fleet of endpoints retrying a restarted peer on a fixed cadence
+    arrives in synchronized waves (thundering herd); full jitter
+    de-correlates them while keeping the exponential envelope (cap
+    ``BF_IO_RETRY_CAP``, default 0.25 s; the bridge redial path passes
+    its own, larger cap)."""
+    import random
+    if backoff is None:
+        backoff = _retry_backoff()
+    if cap is None:
+        cap = _retry_cap()
+    return random.uniform(0.0, min(backoff * (2 ** (attempt - 1)),
+                                   cap))
+
+
 def retry_transient(fn, budget=None, backoff=None, extra=()):
     """Run ``fn()`` retrying transient socket errnos (EINTR /
-    ECONNREFUSED) with exponential backoff, up to a capped budget
-    (``BF_IO_RETRY_MAX``, default 8; base ``BF_IO_RETRY_BACKOFF``
-    seconds, default 5ms).  Retries are counted on the
+    ECONNREFUSED) with full-jitter exponential backoff, up to a capped
+    budget (``BF_IO_RETRY_MAX``, default 8; base
+    ``BF_IO_RETRY_BACKOFF`` seconds, default 5ms; per-sleep cap
+    ``BF_IO_RETRY_CAP``, default 0.25 s).  Retries are counted on the
     ``io.socket_retries`` telemetry counter; budget exhaustion
     re-raises the last error.  EAGAIN/EWOULDBLOCK are NOT retried here
     — on a nonblocking/timeout socket they mean "no data", which
@@ -67,7 +93,7 @@ def retry_transient(fn, budget=None, backoff=None, extra=()):
                 raise        # budget exhausted: surface the real error
             from ..telemetry import counters
             counters.inc('io.socket_retries')
-        time_mod.sleep(min(backoff * (2 ** (attempt - 1)), 0.25))
+        time_mod.sleep(retry_backoff_s(attempt, backoff))
 
 
 class _iovec(ctypes.Structure):

@@ -66,6 +66,8 @@ def _declare(lib):
         'bft_seq_end_offset': ([c.c_void_p, P(ll)], c.c_int),
         'bft_ring_reserve': ([c.c_void_p, ll, c.c_int, P(ll), P(ll)],
                              c.c_int),
+        'bft_ring_reserve_shed': ([c.c_void_p, ll, ll, P(ll), P(ll),
+                                   P(ll)], c.c_int),
         'bft_ring_commit': ([c.c_void_p, ll, ll], c.c_int),
         'bft_capture_create': ([P(c.c_void_p), c.c_int, c.c_int,
                                 c.c_void_p, c.c_int, c.c_int, c.c_int,

@@ -125,6 +125,27 @@ def resolve_sync_depth(scope):
         return BlockScope.DEFAULT_SYNC_DEPTH
 
 
+def resolve_overload_policy(scope):
+    """Effective ring overload policy for ``scope``'s OUTPUT rings:
+    the ``overload_policy`` tunable when set anywhere in the scope
+    chain, else the ``BF_OVERLOAD_POLICY`` environment default, else
+    None (leave the ring at its own setting — 'block' unless set
+    directly).  Values: 'block' | 'drop_oldest' | 'drop_newest'
+    (docs/robustness.md "Overload & degradation"); a bad value raises
+    here, at configuration time."""
+    p = scope.overload_policy
+    if p is None:
+        p = os.environ.get('BF_OVERLOAD_POLICY', '').strip() or None
+    if p is not None:
+        from .ring import Ring
+        if p not in Ring.OVERLOAD_POLICIES:
+            raise ValueError(
+                "Unknown overload policy %r (BF_OVERLOAD_POLICY / "
+                "overload_policy scope tunable); expected one of %s"
+                % (p, ', '.join(Ring.OVERLOAD_POLICIES)))
+    return p
+
+
 class BlockScope(object):
     """Nestable configuration scope; unset attributes inherit from the
     enclosing scope (reference: pipeline.py:84-162).
@@ -147,7 +168,16 @@ class BlockScope(object):
     supervision policy applied when a block's main loop raises, see
     docs/robustness.md), max_restarts / restart_backoff (restart-policy
     budget and exponential-backoff base; defaults BF_RESTART_MAX=3 and
-    BF_RESTART_BACKOFF=0.1s).
+    BF_RESTART_BACKOFF=0.1s),
+    overload_policy ('block' default | 'drop_oldest' | 'drop_newest'
+    — applied to the block's OUTPUT rings at the reserve path: under
+    overload, drop policies shed COUNTED data instead of blocking
+    back to capture; BF_OVERLOAD_POLICY sets the global default — see
+    docs/robustness.md "Overload & degradation"),
+    shed_tolerant (a consuming block's declaration that it accepts
+    gapped input from a drop-policy ring; without it a guaranteed
+    reader on such a ring is a silent-loss hazard the static verifier
+    rejects with BF-E180).
     """
 
     #: default device run-ahead (gulps) when sync_depth is unset;
@@ -159,14 +189,16 @@ class BlockScope(object):
     _TUNABLES = ('gulp_nframe', 'buffer_nframe', 'buffer_factor', 'core',
                  'device', 'mesh', 'share_temp_storage', 'sync_depth',
                  'sync_strict', 'donate', 'gulp_batch', 'on_failure',
-                 'max_restarts', 'restart_backoff')
+                 'max_restarts', 'restart_backoff', 'overload_policy',
+                 'shed_tolerant')
 
     def __init__(self, name=None, gulp_nframe=None, buffer_nframe=None,
                  buffer_factor=None, core=None, gpu=None, device=None,
                  mesh=None, share_temp_storage=False, fuse=False,
                  sync_depth=None, sync_strict=None, donate=None,
                  gulp_batch=None, on_failure=None, max_restarts=None,
-                 restart_backoff=None):
+                 restart_backoff=None, overload_policy=None,
+                 shed_tolerant=None):
         if name is None:
             name = 'BlockScope_%i' % BlockScope.instance_count
             BlockScope.instance_count += 1
@@ -185,6 +217,8 @@ class BlockScope(object):
         self._on_failure = on_failure
         self._max_restarts = max_restarts
         self._restart_backoff = restart_backoff
+        self._overload_policy = overload_policy
+        self._shed_tolerant = shed_tolerant
         self._fused = fuse
         self._temp_storage = {}
         self._parent_scope = get_current_block_scope() \
@@ -567,6 +601,11 @@ class Pipeline(BlockScope):
                 thread.start()
             self.synchronize_block_initializations()
             self.supervisor.start_watchdog(self.watchdog_secs)
+            # pipeline health state machine (docs/robustness.md):
+            # OK/DEGRADED/SHEDDING/STALLED/FAILED derived from the
+            # live SLO/shed/restart/heartbeat signals, published to
+            # pipeline/health and exposed as Pipeline.health()
+            self.supervisor.start_health()
             # periodic metrics publisher: telemetry/metrics +
             # rings_flow/<name> proclogs, BF_METRICS_FILE Prometheus
             # textfile (docs/observability.md)
@@ -606,6 +645,7 @@ class Pipeline(BlockScope):
             raise
         finally:
             self.supervisor.stop_watchdog()
+            self.supervisor.stop_health()
             if tuner is not None:
                 tuner.stop()             # publishes the final knob state
             metrics.stop()               # publishes one final snapshot
@@ -623,6 +663,23 @@ class Pipeline(BlockScope):
         so a standalone ``validate()`` sees the pre-fusion topology."""
         from .analysis import verify
         return verify.verify_pipeline(self)
+
+    def health(self):
+        """Current pipeline health (docs/robustness.md "Overload &
+        degradation"): ``{'state': 'OK'|'DEGRADED'|'SHEDDING'|
+        'STALLED'|'FAILED', 'since': unix_ts, 'blocks': {name:
+        state}, 'transitions': [...]}`` — the supervisor's health
+        state machine, derived from the live SLO ages, shed counters,
+        restart/reconnect records, and block heartbeats, with
+        hysteresis so transient bursts don't flap.  Callable from any
+        thread while ``run()`` is live (the monitor keeps it current);
+        before/after a run it evaluates the signals on demand."""
+        supervisor = getattr(self, 'supervisor', None)
+        if supervisor is None:
+            return {'state': 'OK', 'since': None,
+                    'blocks': {b.name: 'OK' for b in self.blocks},
+                    'transitions': []}
+        return supervisor.health_snapshot()
 
     def shutdown(self):
         self._shutting_down = True
@@ -742,6 +799,11 @@ class Block(BlockScope):
         #: carried in compute-span args so one gulp is traceable
         #: across blocks, pipelines, and hosts
         self._trace_ctx = None
+        #: pipeline health state machine (docs/robustness.md
+        #: "Overload & degradation"): kept current by the supervisor's
+        #: health monitor — blocks may consult it per gulp (or
+        #: override :meth:`on_health`) to cheapen work under pressure
+        self.health_state = 'OK'
         self.bind_proclog = ProcLog(self.name + '/bind')
         self.in_proclog = ProcLog(self.name + '/in')
         rnames = {'nring': len(self.irings)}
@@ -758,6 +820,17 @@ class Block(BlockScope):
         per gulp via _sync_gulp and at sequence boundaries)."""
         self._hb_time = time.monotonic()
         self._hb_gulps += 1
+
+    def on_health(self, state, prev):
+        """Degraded-mode hook (docs/robustness.md): called by the
+        supervisor's health monitor when this block's health state
+        transitions (e.g. OK -> DEGRADED under SLO pressure, ->
+        SHEDDING when its rings start dropping).  Blocks override it
+        to cheapen work under pressure — skip optional taps, coarsen
+        an integration, pause a debug export — and to restore full
+        work on the way back to OK.  Called from the monitor thread;
+        must be quick and must not raise (exceptions are swallowed
+        and counted on ``health.hook_errors``)."""
 
     # -- observability (docs/observability.md) ----------------------------
     def _compute_span(self, seq, gulp):
@@ -864,6 +937,16 @@ class Block(BlockScope):
         if self.device is not None:
             device.set_device(self.device)
         self.cache_scope_hierarchy()
+        # overload policy (docs/robustness.md "Overload &
+        # degradation"): resolve the scope tunable / BF_OVERLOAD_POLICY
+        # onto this block's OUTPUT rings — the reserve path in both
+        # ring cores then sheds (counted) instead of blocking when a
+        # drop policy is configured
+        _policy = resolve_overload_policy(self)
+        if _policy is not None:
+            for oring in self.orings:
+                getattr(oring, '_base_ring',
+                        oring).set_overload_policy(_policy)
         self._hb_time = time.monotonic()
         with ExitStack() as oring_stack:
             # The writing session is held open across restart attempts:
@@ -1151,6 +1234,10 @@ class SourceBlock(Block):
                 supervisor = getattr(self.pipeline, 'supervisor', None)
                 if supervisor is not None:
                     supervisor.block_skipped(self, exc)
+                # the skipped source's stale origin must not poison
+                # this block's commit-age p99 (see the transform-side
+                # skip path)
+                _slo.reset_block_ages(self.name)
             self._source_index += 1
 
     def _read_source(self, orings, sourcename):
@@ -1275,6 +1362,12 @@ class MultiTransformBlock(Block):
                 supervisor = getattr(self.pipeline, 'supervisor', None)
                 if supervisor is not None:
                     supervisor.block_skipped(self, exc)
+                # reset this block's SLO age tracking: the skipped
+                # sequence's stale capture origin would otherwise
+                # poison the commit-age p99 long after recovery
+                # (the drain below re-observes nothing — drained
+                # spans are discarded, not committed)
+                _slo.reset_block_ages(self.name)
                 self._drain_sequences(iseqs)
 
     # -- macro-gulp execution (bifrost_tpu.macro; docs/perf.md) -----------

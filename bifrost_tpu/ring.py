@@ -523,6 +523,22 @@ class Ring(object):
         #: block the caller NOR re-layout storage under a live span's
         #: zero-copy view
         self._pending_resize = None
+        #: overload policy at the reserve path (docs/robustness.md
+        #: "Overload & degradation"): 'block' (default — classic
+        #: backpressure), 'drop_oldest' (advance guaranteed readers
+        #: past the oldest unread data instead of blocking; sheds are
+        #: counted and the skipped frames surface downstream as
+        #: nframe_skipped), or 'drop_newest' (the reserve itself is
+        #: shed — the writer's gulp is produced into scratch and
+        #: discarded, counted).  Resolved from the owning block's
+        #: ``overload_policy`` scope tunable / BF_OVERLOAD_POLICY by
+        #: Block.run; settable directly on framework-external rings.
+        self.overload_policy = 'block'
+        #: counted shedding ledger (mirrors the ring.<name>.shed_*
+        #: counters; kept on the ring too so writers can stamp
+        #: cumulative totals into downstream sequence headers)
+        self._shed_gulps = 0
+        self._shed_bytes = 0
         #: set by poison(): the exception that killed the producing /
         #: consuming side; blocking ops then raise RingPoisonedError
         self._poisoned = None
@@ -715,6 +731,116 @@ class Ring(object):
                     'nread_open': self._nread_open,
                     'eod': self._eod,
                     'poisoned': self._poisoned is not None}
+
+    # -- overload policy & counted shedding (docs/robustness.md) ----------
+    OVERLOAD_POLICIES = ('block', 'drop_oldest', 'drop_newest')
+
+    def set_overload_policy(self, policy):
+        """Set this ring's reserve-path overload policy ('block' |
+        'drop_oldest' | 'drop_newest').  Validated here so a
+        misspelled policy fails at configuration time, not at the
+        first overloaded reserve."""
+        if policy not in self.OVERLOAD_POLICIES:
+            raise ValueError(
+                "Unknown overload policy %r on ring %s (expected one "
+                "of %s)" % (policy, self.name,
+                            ', '.join(self.OVERLOAD_POLICIES)))
+        self.overload_policy = policy
+        return policy
+
+    def shed_stats(self):
+        """Cumulative counted-shedding ledger for this ring: every
+        gulp/byte dropped by a drop_* overload policy.  Matches the
+        ``ring.<name>.shed_gulps`` / ``ring.<name>.shed_bytes``
+        telemetry counters."""
+        with self._lock:
+            return {'policy': self.overload_policy,
+                    'shed_gulps': self._shed_gulps,
+                    'shed_bytes': self._shed_bytes}
+
+    def _note_shed(self, nbyte, ngulps, header=None, frame_end=None):
+        """Account one shed (both cores, both drop policies): the
+        per-ring ledger, the ``ring.<name>.shed_gulps/.shed_bytes``
+        counters, and — when the stream carries a trace-context
+        origin — the age of the data being dropped on the
+        ``slo.shed_age_s`` histogram (how stale data was when the
+        pipeline chose to lose it; the SLO view of shedding)."""
+        if nbyte <= 0:
+            return
+        with self._lock:
+            self._shed_gulps += ngulps
+            self._shed_bytes += nbyte
+        obs = _observability()
+        c, slo = obs[0], obs[3]
+        c.inc('ring.%s.shed_gulps' % self.name, ngulps)
+        c.inc('ring.%s.shed_bytes' % self.name, nbyte)
+        if header is not None:
+            try:
+                age = slo.capture_age_s(header, frame_end)
+                if age is not None:
+                    slo.observe_shed(age)
+            except Exception:
+                pass            # SLO feed must never break shedding
+
+    def _reserve_span_shed(self, nbyte, frame_nbyte, span=None):
+        """Blocking reserve under the ``drop_oldest`` overload policy:
+        when flow control would block on a guaranteed reader, advance
+        that reader's guarantee past the needed bytes in whole-frame
+        steps — clamped at its oldest OPEN span, so a held span's
+        zero-copy view is never invalidated — and count the
+        min-guarantee advance as shed bytes.  Blocks only on the
+        committed head (the writer's own commit barrier) and on
+        readers pinned by open spans; both resolve by peer progress.
+        Returns ``(begin, shed_bytes)``.  Overridden by NativeRing
+        (the same protocol runs inside the C core there)."""
+        frame_nbyte = max(int(frame_nbyte or 1), 1)
+        shed = 0
+        with self._lock:
+            self._check_poison()
+            for sp in self._open_wspans:
+                if sp._closed and sp._commit_nbyte < sp._nbyte:
+                    raise RuntimeError(
+                        "Cannot reserve a span while a partial commit "
+                        "is pending")
+            if nbyte > self._ghost:
+                self._lock.release()
+                try:
+                    self.resize(nbyte, max(self._size, nbyte * 4),
+                                self._nringlet)
+                finally:
+                    self._lock.acquire()
+            begin = self._reserve_head
+            new_reserve = begin + nbyte
+            while True:
+                new_tail = new_reserve - self._size
+                limit = min(self._head, self._min_guarantee())
+                if new_tail <= limit:
+                    break
+                advanced = False
+                if new_tail <= self._head and self._guarantees:
+                    old_min = self._min_guarantee()
+                    for key, g in list(self._guarantees.items()):
+                        if g >= new_tail:
+                            continue
+                        target = g + -(-(new_tail - g) //
+                                       frame_nbyte) * frame_nbyte
+                        opens = self._open_reads.get(key)
+                        if opens:
+                            target = min(target, min(opens))
+                        if target > g:
+                            self._guarantees[key] = target
+                            advanced = True
+                    if advanced:
+                        new_min = self._min_guarantee()
+                        if old_min != _INF and new_min > old_min:
+                            shed += new_min - old_min
+                        continue        # re-check the limit
+                self._write_cond.wait()
+                self._check_poison()
+            self._reserve_head = new_reserve
+            if new_reserve - self._size > self._tail:
+                self._advance_tail(new_reserve - self._size)
+            return begin, shed
 
     # -- poisoning --------------------------------------------------------
     @property
@@ -1300,6 +1426,19 @@ class WriteSequence(_SequenceAPI):
         # the stored header from the caller's dict (reference stores the
         # serialized header: ring2.py:235).
         self._stored_header = json.loads(json.dumps(header))
+        # Overload stamp (docs/robustness.md): on a ring running a
+        # drop policy, every new sequence header carries the ring's
+        # CUMULATIVE shed ledger, so consumers (including remote ones
+        # — the bridge ships headers verbatim) know the stream is
+        # gapped and by how much, without a telemetry channel.
+        policy = getattr(ring, 'overload_policy', 'block')
+        if policy != 'block':
+            stats = ring.shed_stats()
+            self._stored_header['_overload'] = {
+                'policy': policy,
+                'shed_gulps': stats['shed_gulps'],
+                'shed_bytes': stats['shed_bytes'],
+            }
         tensor = _tensor_info(self._stored_header)
         ring.resize(gulp_nframe * tensor['frame_nbyte'],
                     buf_nframe * tensor['frame_nbyte'],
@@ -1455,9 +1594,11 @@ class _SpanAPI(object):
     def _host_view(self, writeable):
         """Zero-copy strided numpy view over the ring buffer, shaped
         (*ringlet_shape, nframe, *frame_shape)."""
+        raw = self._ring._storage.write_view(self._begin, self._nbyte)
+        return self._typed_view(raw, writeable)
+
+    def _typed_view(self, raw, writeable):
         t = self.tensor
-        storage = self._ring._storage
-        raw = storage.write_view(self._begin, self._nbyte)
         dtype = t['dtype']
         if dtype.is_packed or dtype.as_numpy_dtype().names is not None \
                 or not t['frame_shape']:
@@ -1506,6 +1647,10 @@ class WriteSpan(_SpanAPI):
         #: the per-ring ``ring.<name>.gulps`` throughput counter keeps
         #: counting LOGICAL gulps when K are committed at once)
         self._ngulps = 1
+        #: drop_newest overload shed (docs/robustness.md): the reserve
+        #: was refused without blocking — this span is SCRATCH (no
+        #: ring bytes); its commit is counted as shed, not published
+        self._shed = False
         # ring-wait observability: how long the writer was blocked in
         # flow control (covers BOTH cores — the native reserve happens
         # inside this call)
@@ -1515,18 +1660,76 @@ class WriteSpan(_SpanAPI):
         # guarantees (BF_RINGCHECK=1; docs/analysis.md)
         rc = _ringcheck.hook(ring)
         rc_tok = rc.reserve_enter(self._nbyte) if rc is not None else None
+        # overload policy at the reserve path (both cores — this
+        # constructor IS the shared reserve seam); explicit
+        # nonblocking callers keep WouldBlock semantics untouched
+        policy = getattr(ring, 'overload_policy', 'block')
+        if nonblocking:
+            policy = 'block'
         t0 = time.perf_counter()
+        shed_nbyte = 0
         try:
-            self._begin = ring._reserve_span(self._nbyte, nonblocking,
-                                             span=self)
+            if policy == 'drop_oldest':
+                self._begin, shed_nbyte = ring._reserve_span_shed(
+                    self._nbyte, sequence.tensor['frame_nbyte'],
+                    span=self)
+            elif policy == 'drop_newest':
+                try:
+                    self._begin = ring._reserve_span(
+                        self._nbyte, True, span=self)
+                except WouldBlock:
+                    # shed THIS gulp: the writer computes into scratch
+                    # and the commit is counted instead of published
+                    self._shed = True
+                    self._begin = None
+            else:
+                self._begin = ring._reserve_span(self._nbyte,
+                                                 nonblocking,
+                                                 span=self)
         except BaseException:
             if rc is not None:
                 rc.reserve_abort(rc_tok)
             raise
         dt = time.perf_counter() - t0
+        if self._shed:
+            if rc is not None:
+                rc.reserve_abort(rc_tok)
+            # best-effort logical position (frame_offset): where the
+            # span WOULD have landed — the committed head
+            try:
+                self._begin = ring.occupancy().get(
+                    'head', sequence._seq.begin)
+            except Exception:
+                self._begin = sequence._seq.begin
+            self.commit_nframe = 0
+            self._data = None
+            return
+        if shed_nbyte and rc is not None:
+            # mirror the forced guarantee advance in the shadow
+            # checker BEFORE it validates this overwriting reserve
+            rc.shed_advance(self._begin + self._nbyte -
+                            ring.total_span)
         if rc is not None:
             rc.reserve_done(rc_tok, self, self._begin, self._nbyte,
                             ring.total_span)
+        if shed_nbyte:
+            # drop_oldest accounting: shed bytes are whole frames of
+            # the live sequence (the audit a sequential guaranteed
+            # reader performs via nframe_skipped); gulps derived from
+            # the header's LOGICAL gulp
+            fb = sequence.tensor['frame_nbyte']
+            try:
+                gulp = int(sequence.header.get('gulp_nframe', 0) or 0)
+            except Exception:
+                gulp = 0
+            gulp_nbyte = gulp * fb if gulp > 0 else self._nbyte
+            ngulps = max(1, -(-shed_nbyte // max(gulp_nbyte, 1)))
+            ring._note_shed(shed_nbyte, ngulps,
+                            header=sequence.header,
+                            frame_end=max(
+                                (self._begin + self._nbyte -
+                                 ring.total_span -
+                                 sequence._seq.begin) // fb, 0))
         if ring._h_reserve is None:
             ring._h_reserve = hist.get_or_create(
                 'ring.%s.reserve_s' % ring.name, unit='s')
@@ -1551,7 +1754,17 @@ class WriteSpan(_SpanAPI):
         if self._ring.space == 'tpu':
             return self._device_array
         if self._data is None:
-            self._data = self._host_view(writeable=True)
+            if self._shed:
+                # drop_newest scratch: same shape/dtype as a real
+                # span, but backed by throwaway memory — the writer's
+                # compute proceeds unchanged and the commit is counted
+                # as shed instead of published
+                t = self.tensor
+                raw = np.zeros((t['nringlet'], self._nbyte),
+                               dtype=np.uint8)
+                self._data = self._typed_view(raw, writeable=True)
+            else:
+                self._data = self._host_view(writeable=True)
         return self._data
 
     @data.setter
@@ -1595,6 +1808,18 @@ class WriteSpan(_SpanAPI):
 
     def close(self):
         commit_nbyte = self.commit_nframe * self.frame_nbyte
+        if self._shed:
+            # drop_newest: nothing entered the ring — account what the
+            # writer WOULD have published (0 frames on the exception
+            # path: nothing was lost, nothing is counted)
+            if commit_nbyte:
+                self._ring._note_shed(
+                    commit_nbyte, self._ngulps,
+                    header=self._sequence.header,
+                    frame_end=self.frame_offset + self.commit_nframe)
+            if self._fill is not None:
+                self._fill.cancel()
+            return
         if self._ring.space != 'tpu':
             if self._fill is not None:
                 if commit_nbyte == self._nbyte:

@@ -422,6 +422,27 @@ class NativeRing(Ring):
         span._native_id = sid.value
         return begin.value
 
+    def _reserve_span_shed(self, nbyte, frame_nbyte, span=None):
+        """drop_oldest overload reserve (see Ring._reserve_span_shed):
+        the guarantee-advance shed protocol runs inside the C core
+        (bft_ring_reserve_shed) under the ring mutex; the counted
+        min-guarantee advance comes back as shed bytes."""
+        if span is None:
+            raise RuntimeError("NativeRing reserve requires a span "
+                               "object")
+        self._check_poison()
+        begin = ctypes.c_longlong()
+        sid = ctypes.c_longlong()
+        shed = ctypes.c_longlong()
+        rc = self._lib.bft_ring_reserve_shed(
+            self._handle, nbyte, int(max(frame_nbyte or 1, 1)),
+            ctypes.byref(begin), ctypes.byref(sid),
+            ctypes.byref(shed))
+        self._check_poison()
+        native.check(rc, 'reserve_shed')
+        span._native_id = sid.value
+        return begin.value, shed.value
+
     def _commit_span(self, wspan, commit_nbyte):
         native.check(self._lib.bft_ring_commit(
             self._handle, wspan._native_id, commit_nbyte), 'commit')

@@ -53,11 +53,17 @@ import traceback
 from .telemetry import counters
 
 __all__ = ['PipelineRuntimeError', 'PipelineStallError', 'BlockFailure',
-           'Supervisor', 'POLICIES', 'dump_thread_stacks',
-           'ring_occupancies']
+           'Supervisor', 'POLICIES', 'HEALTH_STATES', 'HealthMonitor',
+           'dump_thread_stacks', 'ring_occupancies']
 
 #: recognized on_failure policies
 POLICIES = ('abort', 'restart', 'skip_sequence')
+
+#: pipeline health states, least to most severe (docs/robustness.md
+#: "Overload & degradation"): OK -> DEGRADED (SLO violations, restarts,
+#: bridge reconnects) -> SHEDDING (drop-policy loss in progress) ->
+#: STALLED (no block progressing) -> FAILED (fatal failure / abort)
+HEALTH_STATES = ('OK', 'DEGRADED', 'SHEDDING', 'STALLED', 'FAILED')
 
 _BACKOFF_CAP = 5.0
 
@@ -79,9 +85,10 @@ def _env_int(name, default):
 class BlockFailure(object):
     """One recorded failure: which block, what was raised, the formatted
     traceback, and whether it was fatal to the pipeline (``kind`` is
-    'error', 'restarted', 'skipped', 'poisoned', 'reconnected', or
-    'stall' — 'reconnected' records a bridge endpoint's non-fatal
-    transport redial, blocks/bridge.py)."""
+    'error', 'restarted', 'skipped', 'poisoned', 'reconnected',
+    'degraded', or 'stall' — 'reconnected' records a bridge endpoint's
+    non-fatal transport redial, 'degraded' the first overload shed of
+    a bridge sender's run, blocks/bridge.py)."""
 
     __slots__ = ('block_name', 'exc', 'traceback', 'when', 'kind',
                  'fatal', 'restarts')
@@ -193,6 +200,7 @@ class Supervisor(object):
         self.abort_event = threading.Event()
         self._lock = threading.Lock()
         self._watchdog = None
+        self.health = None
         self.default_max_restarts = _env_int('BF_RESTART_MAX', 3)
         self.default_backoff = _env_float('BF_RESTART_BACKOFF', 0.1)
         # fail fast, in the launching thread, on a misspelled policy —
@@ -302,6 +310,32 @@ class Supervisor(object):
             return [f for f in self.failures
                     if f.block_name == block_name]
 
+    # -- health state machine (docs/robustness.md) -------------------------
+    def start_health(self):
+        """Start the pipeline health monitor (BF_HEALTH_INTERVAL
+        seconds per tick, default 0.5; 0 disables the thread —
+        ``Pipeline.health()`` then evaluates on demand)."""
+        interval = _env_float('BF_HEALTH_INTERVAL', 0.5)
+        self.health = HealthMonitor(self, interval)
+        if interval and interval > 0:
+            self.health.start()
+        return self.health
+
+    def stop_health(self):
+        if self.health is not None:
+            self.health.stop()
+
+    def health_snapshot(self):
+        """Current pipeline + per-block health.  While the monitor
+        thread is live its last tick is authoritative — an on-demand
+        evaluation would consume the monitor's counter deltas and
+        hysteresis clean-ticks out from under it; with no thread
+        (BF_HEALTH_INTERVAL=0, or before/after a run) evaluate now."""
+        if self.health is None:
+            self.health = HealthMonitor(self, 0.0)
+        return self.health.snapshot(
+            evaluate=not self.health.is_alive())
+
     # -- watchdog ----------------------------------------------------------
     def start_watchdog(self, secs=None):
         """Start the stall watchdog (no-op when no window configured).
@@ -330,6 +364,259 @@ class Supervisor(object):
             # a concurrently armed pipeline keeps recording)
             from .telemetry import spans
             spans.disable_flight_recorder()
+
+
+class HealthMonitor(threading.Thread):
+    """Pipeline health state machine (docs/robustness.md "Overload &
+    degradation"): derives one whole-pipeline state and one state per
+    block from the live robustness signals —
+
+    - **FAILED**: the supervisor recorded a fatal failure / aborted.
+    - **STALLED**: no live block has heartbeat within
+      ``BF_HEALTH_STALL_SECS`` (default 5, or the armed watchdog
+      window), or the watchdog counted a stall.
+    - **SHEDDING**: a drop-policy ring or the bridge shed data since
+      the last tick (``ring.*.shed_gulps`` / ``bridge.tx.shed_spans``
+      deltas).
+    - **DEGRADED**: SLO violations, block restarts/skips, or bridge
+      reconnects/circuit events since the last tick.
+    - **OK** otherwise.
+
+    Escalation is immediate; de-escalation requires
+    ``BF_HEALTH_HYSTERESIS`` consecutive clean ticks (default 4) so a
+    bursty overload does not flap the state.  Every evaluation is
+    published to the ``pipeline/health`` ProcLog (rendered by
+    ``tools/like_top.py``); transitions count on
+    ``health.transitions`` and are kept in a bounded history.  On a
+    per-block transition the block's ``health_state`` attribute is
+    updated and its :meth:`~bifrost_tpu.pipeline.Block.on_health`
+    degraded-mode hook is invoked (errors swallowed + counted)."""
+
+    #: severity order (index into HEALTH_STATES)
+    _SEV = {s: i for i, s in enumerate(HEALTH_STATES)}
+
+    def __init__(self, supervisor, interval):
+        super(HealthMonitor, self).__init__(name='bf-health',
+                                            daemon=True)
+        self.supervisor = supervisor
+        self.interval = max(float(interval or 0.0), 0.0)
+        self.hysteresis = max(_env_int('BF_HEALTH_HYSTERESIS', 4), 1)
+        stall = _env_float('BF_HEALTH_STALL_SECS', 0.0)
+        if stall <= 0:
+            stall = getattr(supervisor.pipeline, 'watchdog_secs',
+                            None) or _env_float('BF_WATCHDOG_SECS',
+                                                0.0) or 5.0
+        self.stall_secs = float(stall)
+        self._stop_event = threading.Event()
+        self._eval_lock = threading.Lock()
+        self._last = {}              # counter name -> last value
+        self._state = 'OK'
+        self._since = time.time()
+        self._clean_ticks = 0
+        self._block_states = {}
+        self._transitions = []       # (unix_ts, from, to, reason)
+        self._proclog = None
+        self._nfail_seen = 0
+
+    def stop(self):
+        self._stop_event.set()
+
+    def run(self):
+        while not self._stop_event.wait(self.interval):
+            try:
+                self.evaluate()
+            except Exception:
+                counters.inc('health.hook_errors')
+            if self._state == 'FAILED':
+                # terminal: keep the final state published and exit
+                return
+
+    # -- signal collection -------------------------------------------------
+    def _delta(self, snap, name):
+        cur = snap.get(name, 0)
+        prev = self._last.get(name, 0)
+        self._last[name] = cur
+        return max(cur - prev, 0)
+
+    def _ring_owner_names(self):
+        """{ring_name: owning block name} for shed attribution."""
+        out = {}
+        for block in self.supervisor.pipeline.blocks:
+            for ring in getattr(block, 'orings', ()) or ():
+                base = getattr(ring, '_base_ring', ring)
+                out[getattr(base, 'name', '?')] = block.name
+        return out
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, now=None):
+        from .telemetry import counters as _c
+        with self._eval_lock:
+            snap = _c.snapshot()
+            now = time.monotonic() if now is None else now
+            sup = self.supervisor
+            owners = self._ring_owner_names()
+
+            # per-block raw severity this tick
+            shed_by_block = {}
+            for name in list(snap):
+                if name.startswith('ring.') and \
+                        name.endswith('.shed_gulps'):
+                    d = self._delta(snap, name)
+                    if d:
+                        ring = name[len('ring.'):-len('.shed_gulps')]
+                        owner = owners.get(ring)
+                        if owner is not None:
+                            shed_by_block[owner] = \
+                                shed_by_block.get(owner, 0) + d
+            bridge_shed = (self._delta(snap, 'bridge.tx.shed_gulps') +
+                           self._delta(snap,
+                                       'bridge.tx.quota_shed_gulps'))
+            slo_violations = self._delta(snap, 'slo.violations')
+            degraded_events = (
+                self._delta(snap, 'block_restarts') +
+                self._delta(snap, 'bridge.tx.reconnects') +
+                self._delta(snap, 'bridge.redial_attempts') +
+                self._delta(snap, 'bridge.circuit_open'))
+            stalls = self._delta(snap, 'watchdog_stalls')
+
+            with sup._lock:
+                failures = list(sup.failures)
+            new_failures = failures[self._nfail_seen:]
+            self._nfail_seen = len(failures)
+            fatal = sup.abort_event.is_set() or \
+                any(f.fatal for f in failures)
+
+            blocks = sup.pipeline.blocks
+            live = [b for b in blocks
+                    if getattr(b, '_thread', None) is not None
+                    and b._thread.is_alive()]
+            beats = [getattr(b, '_hb_time', None) for b in live]
+            beats = [b for b in beats if b is not None]
+            all_stalled = bool(live) and bool(beats) and \
+                (now - max(beats)) >= self.stall_secs
+
+            per_block_sev = {b.name: 'OK' for b in blocks}
+
+            def raise_sev(name, state):
+                if name in per_block_sev and \
+                        self._SEV[state] > \
+                        self._SEV[per_block_sev[name]]:
+                    per_block_sev[name] = state
+
+            for f in new_failures:
+                if f.fatal:
+                    raise_sev(f.block_name, 'FAILED')
+                elif f.kind in ('restarted', 'skipped', 'reconnected',
+                                'degraded'):
+                    raise_sev(f.block_name, 'DEGRADED')
+            for name, nshed in shed_by_block.items():
+                raise_sev(name, 'SHEDDING')
+            for b in blocks:
+                # consume the per-block SLO delta EVERY tick (a
+                # lazily-established baseline would attribute all
+                # historical violations to whichever tick first
+                # evaluates the block)
+                if self._delta(snap, 'slo.%s.violations' % b.name):
+                    raise_sev(b.name, 'DEGRADED')
+
+            # pipeline severity this tick
+            if fatal:
+                raw = 'FAILED'
+            elif stalls or all_stalled:
+                raw = 'STALLED'
+            elif shed_by_block or bridge_shed:
+                raw = 'SHEDDING'
+            elif slo_violations or degraded_events or \
+                    any(s == 'DEGRADED'
+                        for s in per_block_sev.values()):
+                raw = 'DEGRADED'
+            else:
+                raw = 'OK'
+
+            self._apply(raw, per_block_sev, {
+                'shed_gulps': sum(shed_by_block.values()),
+                'bridge_shed': bridge_shed,
+                'slo_violations': slo_violations,
+                'degraded_events': degraded_events,
+                'stalled': bool(stalls or all_stalled),
+            })
+            return self._snapshot_locked()
+
+    def _apply(self, raw, per_block_sev, reasons):
+        # escalate immediately; de-escalate only after `hysteresis`
+        # consecutive ticks at the lower severity (anti-flap)
+        cur = self._state
+        if self._SEV[raw] >= self._SEV[cur]:
+            nxt = raw
+            self._clean_ticks = 0
+        else:
+            self._clean_ticks += 1
+            nxt = raw if self._clean_ticks >= self.hysteresis else cur
+        if nxt != cur:
+            reason = ', '.join('%s=%s' % kv
+                               for kv in sorted(reasons.items())
+                               if kv[1]) or 'recovered'
+            self._transitions.append((time.time(), cur, nxt, reason))
+            del self._transitions[:-32]
+            self._state = nxt
+            self._since = time.time()
+            self._clean_ticks = 0
+            counters.inc('health.transitions')
+        # per-block: immediate escalation, shared hysteresis counter
+        # is overkill per block — blocks recover with the pipeline
+        for block in self.supervisor.pipeline.blocks:
+            sev = per_block_sev.get(block.name, 'OK')
+            prev = self._block_states.get(block.name, 'OK')
+            if self._SEV[sev] < self._SEV[prev] and \
+                    self._clean_ticks == 0 and nxt != 'OK':
+                sev = prev          # hold until the pipeline recovers
+            if sev != prev:
+                self._block_states[block.name] = sev
+                block.health_state = sev
+                try:
+                    block.on_health(sev, prev)
+                except Exception:
+                    counters.inc('health.hook_errors')
+        self._publish()
+
+    def _snapshot_locked(self):
+        return {
+            'state': self._state,
+            'since': self._since,
+            'blocks': dict(self._block_states) or
+                {b.name: 'OK'
+                 for b in self.supervisor.pipeline.blocks},
+            'transitions': [
+                {'when': t, 'from': a, 'to': b, 'reason': r}
+                for t, a, b, r in self._transitions],
+        }
+
+    def snapshot(self, evaluate=False):
+        """Current health dict (``Pipeline.health()``); with
+        ``evaluate`` recompute now instead of returning the last
+        tick's view."""
+        if evaluate:
+            return self.evaluate()
+        with self._eval_lock:
+            return self._snapshot_locked()
+
+    def _publish(self):
+        try:
+            from .proclog import ProcLog
+            if self._proclog is None:
+                self._proclog = ProcLog('pipeline/health')
+            self._proclog.update({
+                'state': self._state,
+                'since_unix': round(self._since, 3),
+                'transitions':
+                    counters.get('health.transitions'),
+                'blocks': ','.join(
+                    '%s=%s' % kv
+                    for kv in sorted(self._block_states.items())
+                    if kv[1] != 'OK') or 'all-ok',
+            }, force=True)
+        except Exception:
+            pass
 
 
 class _Watchdog(threading.Thread):

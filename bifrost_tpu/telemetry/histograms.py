@@ -185,3 +185,27 @@ def reset():
     """Drop every histogram (tests/benchmarks)."""
     with _lock:
         _registry.clear()
+
+
+def clear(name):
+    """Zero one histogram IN PLACE (hot-path caches holding the object
+    keep recording into it) — the SLO age-reset path uses this so one
+    skipped/shed sequence's stale ages don't poison p99 forever."""
+    h = _registry.get(name)
+    if h is None:
+        return False
+    with h._lock:
+        h.count = 0
+        h.total = 0.0
+        h.vmin = float('inf')
+        h.vmax = 0.0
+        h.buckets = [0] * NBUCKET
+    return True
+
+
+def clear_matching(prefix):
+    """Zero every registered histogram whose name starts with
+    ``prefix`` (in place); returns how many were cleared."""
+    with _lock:
+        names = [n for n in _registry if n.startswith(prefix)]
+    return sum(1 for n in names if clear(n))

@@ -49,10 +49,15 @@ from . import counters, histograms
 from ..header_standard import trace_context
 
 __all__ = ['budget_s', 'reset_budget', 'capture_age_s',
-           'observe_commit', 'observe_exit', 'EXIT_HISTOGRAM']
+           'observe_commit', 'observe_exit', 'observe_shed',
+           'reset_block_ages', 'EXIT_HISTOGRAM', 'SHED_HISTOGRAM']
 
 #: the merged pipeline-exit age histogram (all sink blocks)
 EXIT_HISTOGRAM = 'slo.exit_age_s'
+#: age of data at the moment a drop_* overload policy shed it — how
+#: stale the stream had become when the pipeline chose loss over
+#: latency (docs/robustness.md "Overload & degradation")
+SHED_HISTOGRAM = 'slo.shed_age_s'
 
 _budget = None          # cached 1-tuple (budget seconds or None)
 
@@ -129,3 +134,25 @@ def observe_exit(name, age_s):
     histograms.observe(EXIT_HISTOGRAM, age_s)
     _observe('slo.%s.exit_age_s' % name,
              'slo.%s.violations' % name, age_s)
+
+
+def observe_shed(age_s):
+    """Record the age of data a drop_* overload policy shed
+    (``Ring._note_shed``, both ring cores): the merged
+    ``slo.shed_age_s`` histogram is how an operator sees WHAT was
+    lost under overload — old backlog (healthy drop_oldest behavior)
+    vs fresh data (the pipeline is badly underprovisioned).  Never
+    counts on the violation counters: shedding is the budget-KEEPING
+    mechanism."""
+    histograms.observe(SHED_HISTOGRAM, age_s)
+
+
+def reset_block_ages(name):
+    """Zero ``slo.<name>.commit_age_s`` / ``slo.<name>.exit_age_s``
+    in place.  Called when a block sheds or skips a whole sequence
+    (``on_failure='skip_sequence'``): the abandoned sequence's stale
+    origin would otherwise sit in the p99 forever, paging operators
+    about latency the recovery already resolved.  Violation COUNTERS
+    are cumulative history and are deliberately not reset."""
+    histograms.clear('slo.%s.commit_age_s' % name)
+    histograms.clear('slo.%s.exit_age_s' % name)

@@ -549,6 +549,99 @@ int bft_ring_reserve(void* ring_, long long nbyte, int nonblocking,
     return BFT_OK;
 }
 
+int bft_ring_reserve_shed(void* ring_, long long nbyte,
+                          long long frame_nbyte, long long* begin_out,
+                          long long* span_id_out,
+                          long long* shed_bytes_out) {
+    // bft_ring_reserve with the drop_oldest overload policy
+    // (docs/robustness.md "Overload & degradation"): instead of
+    // blocking on guaranteed readers, advance their guarantees in
+    // whole-frame steps past the bytes this reservation must
+    // overwrite — clamped at each reader's oldest OPEN span, so a
+    // held span's zero-copy view is never invalidated.  The shed is
+    // COUNTED: *shed_bytes_out accumulates the min-guarantee advance
+    // (== the bytes a sequential guaranteed reader will observe as
+    // nframe_skipped at its next acquire — the byte-accurate audit
+    // the chaos harness checks).  Blocks only on the committed head
+    // (the writer's own open spans) and on readers pinned by open
+    // spans, both of which resolve by peer progress — never a
+    // deadlock against a slow reader.
+    Ring* r = static_cast<Ring*>(ring_);
+    if (!r || !begin_out || !span_id_out || !shed_bytes_out ||
+        nbyte < 0)
+        return BFT_ERR_INVALID;
+    if (frame_nbyte <= 0) frame_nbyte = 1;
+    *shed_bytes_out = 0;
+    std::unique_lock<std::mutex> lk(r->mtx);
+    for (auto& ws : r->open_wspans)
+        if (ws.commit_nbyte >= 0 && ws.commit_nbyte < ws.nbyte)
+            return BFT_ERR_STATE;
+    if (nbyte > r->ghost) {
+        r->span_cv.wait(lk, [&] {
+            return r->nwrite_open == 0 && r->nread_open == 0;
+        });
+        int64_t g = std::max<int64_t>(r->ghost, nbyte);
+        int64_t s = std::max<int64_t>(r->size, nbyte * 4);
+        int64_t n = r->nringlet;
+        if (r->resize_pending_locked())
+            r->fold_pending_locked(&g, &s, &n);
+        int rc = r->realloc_locked(s, g, n);
+        if (rc != BFT_OK) return rc;
+    }
+    int64_t begin = r->reserve_head;
+    int64_t new_reserve = begin + nbyte;
+    for (;;) {
+        int64_t new_tail = new_reserve - r->size;
+        int64_t limit = std::min<int64_t>(r->head,
+                                          r->min_guarantee_locked());
+        if (new_tail <= limit) break;
+        // shed: only guaranteed readers can be advanced, and only
+        // over COMMITTED bytes (new_tail <= head); otherwise the
+        // writer is blocked on its own commit barrier and must wait
+        bool advanced = false;
+        if (new_tail <= r->head) {
+            int64_t old_min = r->min_guarantee_locked();
+            for (auto& kv : r->readers) {
+                Reader* rd = kv.second.get();
+                if (!rd->guarantee || rd->guarantee_offset >= new_tail)
+                    continue;
+                int64_t target = rd->guarantee_offset +
+                    ((new_tail - rd->guarantee_offset + frame_nbyte - 1)
+                     / frame_nbyte) * frame_nbyte;
+                if (!rd->open_spans.empty())
+                    target = std::min<int64_t>(
+                        target, *rd->open_spans.begin());
+                if (target > rd->guarantee_offset) {
+                    rd->guarantee_offset = target;
+                    advanced = true;
+                }
+            }
+            if (advanced) {
+                int64_t new_min = r->min_guarantee_locked();
+                if (new_min > old_min && old_min != NO_END)
+                    *shed_bytes_out += new_min - old_min;
+                continue;           // re-check the limit
+            }
+        }
+        r->write_cv.wait(lk);
+    }
+    r->reserve_head = new_reserve;
+    int64_t new_tail = new_reserve - r->size;
+    if (new_tail > r->tail) {
+        r->tail = new_tail;
+        r->gc_sequences_locked();
+    }
+    WSpan ws;
+    ws.id = r->next_wspan_id++;
+    ws.begin = begin;
+    ws.nbyte = nbyte;
+    r->open_wspans.push_back(ws);
+    r->nwrite_open += 1;
+    *begin_out = begin;
+    *span_id_out = ws.id;
+    return BFT_OK;
+}
+
 int bft_ring_commit(void* ring_, long long span_id, long long commit_nbyte) {
     Ring* r = static_cast<Ring*>(ring_);
     if (!r) return BFT_ERR_INVALID;

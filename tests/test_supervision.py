@@ -24,6 +24,9 @@ from tests.util import (NumpySourceBlock, GatherSink, simple_header,
 
 pytestmark = pytest.mark.faults
 
+CORES = ['python'] + (['native'] if native_mod.available()
+                      else [])
+
 
 @pytest.fixture(autouse=True)
 def clean_faults_and_counters():
@@ -168,6 +171,84 @@ def test_restart_budget_exhaustion_escalates_to_abort():
     assert counters.get('block_restarts') == 2
 
 
+@pytest.mark.parametrize('core', CORES)
+def test_restart_storm_budget_exhaustion_mid_macro_gulp(core,
+                                                       monkeypatch):
+    """Restart-storm drill (ISSUE 11): the restart budget
+    (BF_RESTART_MAX) is exhausted MID-MACRO-GULP — a K=4 macro chain
+    is active when the faulted source burns through every restart —
+    and the escalation must be a clean poison cascade (no hang, every
+    downstream block woken) with EXACT block_restarts/block_failures
+    counters, in BOTH ring cores (the host rings of the chain run on
+    the parametrized core; the device ring always runs the Python
+    chunk-map core)."""
+    if core == 'python':
+        monkeypatch.setattr(native_mod, '_lib', None)
+        monkeypatch.setattr(native_mod, '_tried', True)
+    monkeypatch.setenv('BF_RESTART_MAX', '2')
+    nt = 8
+    gulps = [np.full((nt, 3), float(k), dtype=np.float32)
+             for k in range(16)]
+    hdr = _hdr()
+    hdr['gulp_nframe'] = nt
+    # the fault fires on gulp 3 of every (re)started source run:
+    # mid-stream, while the device chain is consuming K=4 macro spans
+    with faults.injected('block.on_data', match='NumpySourceBlock',
+                         count=3, after=2):
+        with bf.Pipeline(gulp_batch=4) as p:
+            p.shutdown_timeout = 5.0
+            src = NumpySourceBlock(gulps, hdr, gulp_nframe=nt,
+                                   on_failure='restart',
+                                   restart_backoff=0.01)
+            dev = bf.blocks.copy(src, space='tpu')
+            host = bf.blocks.copy(dev, space='system')
+            sink = GatherSink(host)
+            exc = _run_with_timeout(p)
+    # budget (2) exhausted by the 3rd failure: fatal abort, poison
+    # cascade reaches every block, run() re-raises the aggregate
+    assert isinstance(exc, PipelineRuntimeError), repr(exc)
+    assert counters.get('block_restarts') == 2
+    assert counters.get('block_failures') == 3
+    assert counters.get('ring_poisoned') >= 3   # every chain ring
+    kinds = [f.kind for f in exc.failures]
+    assert kinds.count('restarted') == 2
+    assert kinds.count('error') == 1
+    assert any(k == 'poisoned' for k in kinds), \
+        "no poison-cascade record: downstream died uncleanly"
+
+
+@pytest.mark.parametrize('core', CORES)
+def test_skip_sequence_resets_slo_ages(core, monkeypatch):
+    """ISSUE 11 satellite: a skip_sequence drain must reset the
+    block's commit-age SLO histograms — the skipped sequence's stale
+    origin must not poison the p99 forever."""
+    if core == 'python':
+        monkeypatch.setattr(native_mod, '_lib', None)
+        monkeypatch.setattr(native_mod, '_tried', True)
+    from bifrost_tpu.telemetry import histograms, slo
+    histograms.reset()
+    with faults.injected('block.on_data', match='Ident', count=1,
+                         after=2):
+        with bf.Pipeline() as p:
+            src = TwoSeqSource(_gulps(5), _hdr(), gulp_nframe=4)
+            blk = Ident(src, on_failure='skip_sequence')
+            sink = GatherSink(blk)
+            exc = _run_with_timeout(p)
+    assert exc is None, repr(exc)
+    # seq-a recorded 2 commit ages before the fault; the skip reset
+    # them; seq-b recorded its 5 — without the reset this would be 7
+    h = histograms.get('slo.%s.commit_age_s' % blk.name)
+    assert h is not None, "no commit ages recorded at all"
+    assert h.snapshot()['count'] == 5
+    # the unit contract, directly:
+    slo.observe_commit('unit_block', 123.0)
+    assert histograms.get(
+        'slo.unit_block.commit_age_s').snapshot()['count'] == 1
+    slo.reset_block_ages('unit_block')
+    assert histograms.get(
+        'slo.unit_block.commit_age_s').snapshot()['count'] == 0
+
+
 def test_skip_sequence_policy_degrades_gracefully():
     """A skip_sequence transform abandons the failing sequence (its
     output for it stays empty) and delivers the next one intact."""
@@ -220,9 +301,6 @@ def test_init_failure_still_raises_pipeline_init_error():
 # ---------------------------------------------------------------------------
 # ring poisoning (both cores)
 # ---------------------------------------------------------------------------
-
-CORES = ['python'] + (['native'] if native_mod.available() else [])
-
 
 @pytest.fixture(params=CORES)
 def ring_core(request, monkeypatch):

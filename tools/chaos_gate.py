@@ -1,0 +1,96 @@
+#!/usr/bin/env python3
+"""Chaos/soak gate: the overload-resilience layer must hold its
+invariants under a scripted fault schedule.
+
+Runs bench_suite config 15 (tools/chaos_soak.py machinery: a bridged
+two-process pipeline driven through an overload burst, a connection
+kill/redial, and a deterministic mid-stream block failure —
+docs/robustness.md "Overload & degradation") in a fresh subprocess
+pinned to the CPU backend, and asserts the soak's invariants:
+
+- ``no_deadlock``            — both pipeline processes exited cleanly;
+- ``no_silent_loss``         — produced == delivered + shed bytes,
+  exact across BOTH shed ledgers (every missing gulp is a counted
+  shed, never a silent gap);
+- ``shedding_engaged``       — the burst actually forced counted
+  shedding (a soak that never overloads proves nothing);
+- ``health_traversal``       — pipeline health reached SHEDDING and
+  returned to OK;
+- ``p99_under_budget``       — capture-to-exit p99 stayed under
+  ``BF_SLO_MS`` while shedding;
+- ``recovered_reconnects`` / ``restart_recovered`` /
+  ``overload_stamped`` — the kill redialed-and-resumed, the injected
+  failure cost exactly one supervisor restart, and downstream
+  sequence headers carry the ``_overload`` shed stamp.
+
+The full config result is written to the ``--out`` JSON artifact
+(``CHAOS_SOAK_${ROUND}.json``) so bench rounds record the overload
+path's health next to the throughput numbers.
+
+Exit codes: 0 pass, 3 an invariant failed, 2 the soak failed to run.
+``tools/watch_and_bench.sh`` runs this after the bridge gate
+(``BF_SKIP_CHAOS_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config15(timeout=900):
+    """One bench_suite --config 15 subprocess on the CPU backend;
+    returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # configured overload/fault tuning would skew the scripted drill
+    for var in ('BF_OVERLOAD_POLICY', 'BF_FAULTS', 'BF_SLO_MS',
+                'BF_BRIDGE_WINDOW', 'BF_BRIDGE_STREAMS',
+                'BF_BRIDGE_QUOTA_MBPS', 'BF_BRIDGE_QUOTA_GULPS'):
+        env.pop(var, None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '15'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'invariants' in d:
+            return d
+    raise RuntimeError(
+        'config 15 produced no invariants result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1200:], out.stderr[-1200:]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default='CHAOS_SOAK.json',
+                    help='artifact path for the full config result')
+    ap.add_argument('--timeout', type=int, default=900)
+    args = ap.parse_args(argv)
+    try:
+        res = run_config15(timeout=args.timeout)
+    except Exception as exc:
+        print('chaos_gate: soak failed to run: %s: %s'
+              % (type(exc).__name__, exc))
+        return 2
+    with open(args.out, 'w') as f:
+        json.dump(res, f, indent=2, sort_keys=True)
+    inv = res.get('invariants', {})
+    for name in sorted(inv):
+        print('%-22s %s' % (name, 'ok' if inv[name] else 'FAIL'))
+    print('ledger: %s' % json.dumps(res.get('ledger', {}),
+                                    sort_keys=True))
+    ok = bool(inv) and all(inv.values())
+    print('chaos_gate: %s -> %s' % ('PASS' if ok else 'FAIL',
+                                    args.out))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Chaos/soak driver for the overload-resilience layer
+(docs/robustness.md "Overload & degradation").
+
+Drives a bridged two-process pipeline (paced source -> drop_oldest
+ring -> BridgeSink -> chaos TCP proxy -> BridgeSource -> sink) through
+a scripted fault schedule — slow-consumer overload burst (the proxy
+stops forwarding, so credit stalls and counted shedding engages),
+connection kill/redial (receiver 'restart': jittered sender redial +
+retransmit, receiver re-accept + resume), and a deterministic
+mid-stream block failure (testing/faults.py) absorbed by the restart
+policy — then audits the invariants:
+
+- no deadlock (both processes exit inside the timeout);
+- no silent loss (produced == delivered + shed, byte-exact across the
+  ring and bridge shed ledgers);
+- health traverses OK -> SHEDDING -> ... -> OK;
+- capture-to-exit p99 stays under ``BF_SLO_MS`` while shedding;
+- the kill recovers (reconnects counted both sides, clean MSG_END)
+  and the injected failure costs exactly one supervisor restart.
+
+The machinery lives in ``bench_suite.bench_chaos_soak`` (config 15 —
+what ``tools/chaos_gate.py`` gates in CI); this CLI exposes the
+schedule knobs for interactive chaos drills::
+
+    python tools/chaos_soak.py                     # default drill
+    python tools/chaos_soak.py --secs 60 --tick-ms 2   # longer soak
+    BF_CHAOS_SEED=7 python tools/chaos_soak.py     # jitter the phases
+
+Exit codes: 0 every invariant held, 3 an invariant failed, 2 the
+drill itself could not run (matches tools/telemetry_diff.py).
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('--secs', type=float, default=None,
+                    help='approximate streaming seconds (scales the '
+                         'gulp count at --tick-ms pacing)')
+    ap.add_argument('--tick-ms', type=float, default=5.0,
+                    help='source pacing per gulp (default 5 ms)')
+    ap.add_argument('--pause-at', type=float, default=2.0,
+                    help='overload burst start (s; default 2)')
+    ap.add_argument('--pause-secs', type=float, default=3.0,
+                    help='overload burst length (s; default 3)')
+    ap.add_argument('--kill-at', type=float, default=6.5,
+                    help='connection kill time (s; default 6.5)')
+    ap.add_argument('--slo-ms', type=float, default=5000.0,
+                    help='BF_SLO_MS budget the p99 invariant checks')
+    ap.add_argument('--out', default=None,
+                    help='write the full result JSON here')
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import bench_suite
+
+    kwargs = dict(tick_ms=args.tick_ms, pause_at=args.pause_at,
+                  pause_secs=args.pause_secs, kill_at=args.kill_at,
+                  slo_ms=args.slo_ms)
+    if args.secs:
+        # 3 sources share the stream: size each for ~secs/3 of pacing
+        kwargs['ngulp'] = max(int(args.secs * 1e3 / args.tick_ms / 3),
+                              50)
+    seed = os.environ.get('BF_CHAOS_SEED', '').strip()
+    if seed:
+        # jittered schedule: same invariants, different interleavings
+        rng = random.Random(int(seed))
+        kwargs['pause_at'] = args.pause_at * rng.uniform(0.7, 1.4)
+        kwargs['pause_secs'] = args.pause_secs * rng.uniform(0.7, 1.3)
+        kwargs['kill_at'] = (kwargs['pause_at'] + kwargs['pause_secs']
+                             + rng.uniform(0.5, 2.5))
+
+    try:
+        res = bench_suite.bench_chaos_soak(**kwargs)
+    except Exception as exc:
+        print('chaos_soak: drill failed to run: %s: %s'
+              % (type(exc).__name__, exc))
+        return 2
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(res, f, indent=2, sort_keys=True)
+    print(json.dumps(res['invariants'], indent=2, sort_keys=True))
+    print('ledger: %s' % json.dumps(res['ledger'], sort_keys=True))
+    print('chaos_soak: %s (%.2f%% of produced bytes shed, all '
+          'counted)' % ('PASS' if res['pass'] else 'FAIL',
+                        res['value']))
+    return 0 if res['pass'] else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -170,12 +170,13 @@ def get_device_memory_usage(timeout=10.0):
     return data
 
 
-def collect_blocks(pids=None, autotune=None):
+def collect_blocks(pids=None, autotune=None, health=None):
     """Per-block rows across pipelines: pid/name/cmd/core and the perf
     times (reference: like_top.py:305-330).  Pass a dict as
     ``autotune`` to collect each process's ``analysis/autotune`` knob
-    panel from the SAME proclog walk (a separate collect_autotune()
-    pass would re-parse every proclog file per refresh)."""
+    panel — and as ``health`` its ``pipeline/health`` state row
+    (docs/robustness.md) — from the SAME proclog walk (a separate
+    collect pass would re-parse every proclog file per refresh)."""
     rows = {}
     for pid in (pids if pids is not None else list_pipelines()):
         contents = proclog.load_by_pid(pid)
@@ -183,6 +184,10 @@ def collect_blocks(pids=None, autotune=None):
             panel = contents.get('analysis', {}).get('autotune')
             if panel:
                 autotune[pid] = panel
+        if health is not None:
+            hrow = contents.get('pipeline', {}).get('health')
+            if hrow:
+                health[pid] = hrow
         cmd = get_command_line(pid)
         for block, logs in contents.items():
             if block == 'rings':
@@ -240,7 +245,8 @@ def collect_autotune(pids=None):
 
 
 def render_text(load, cpu, mem, dev, rows, tuners=None,
-                sort_key='process', sort_rev=True, width=140):
+                sort_key='process', sort_rev=True, width=140,
+                health=None):
     """Render the full display as text lines (shared by --once and the
     curses loop)."""
     host = socket.gethostname()
@@ -291,6 +297,16 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
                       d['wait99'] * 1e3, d['age99'] * 1e3, d['gpd'],
                       int(d['shards']), d['gops'],
                       d['cmd'][:max(width - 157, 0)]))
+    # pipeline health state machine (pipeline/health ProcLog —
+    # docs/robustness.md "Overload & degradation")
+    for pid in sorted(health or {}):
+        h = health[pid]
+        out.append('')
+        out.append('[health] pid %s  state %s  transitions %s  %s'
+                   % (pid, h.get('state', '?'),
+                      h.get('transitions', '?'),
+                      ('blocks: %s' % h['blocks'])[:max(width - 40, 0)]
+                      if h.get('blocks') else ''))
     # live auto-tuner knob panel (analysis/autotune ProcLog, fed by
     # the autotune.* counters — docs/autotune.md)
     for pid in sorted(tuners or {}):
@@ -337,16 +353,19 @@ def run_curses(args):
                 sort_key = new_key
             now = time.time()
             if now - t_last > args.interval or state is None:
-                tuners = {}
+                tuners, health = {}, {}
                 state = (get_load_average(), get_processor_usage(),
                          get_memory_swap_usage(),
                          get_device_memory_usage() if args.devices
                          else None,
-                         collect_blocks(autotune=tuners), tuners)
+                         collect_blocks(autotune=tuners,
+                                        health=health),
+                         tuners, health)
                 t_last = now
             maxy, maxx = scr.getmaxyx()
-            lines = render_text(*state, sort_key=sort_key,
-                                sort_rev=sort_rev, width=maxx)
+            lines = render_text(*state[:6], sort_key=sort_key,
+                                sort_rev=sort_rev, width=maxx,
+                                health=state[6])
             for y, line in enumerate(lines[:maxy - 1]):
                 attr = curses.A_REVERSE if line.startswith('   PID') \
                     else curses.A_NORMAL
@@ -378,13 +397,13 @@ def main():
     if args.once:
         get_processor_usage()        # prime the delta state
         time.sleep(0.05)
-        tuners = {}
+        tuners, health = {}, {}
         lines = render_text(
             get_load_average(), get_processor_usage(),
             get_memory_swap_usage(),
             get_device_memory_usage() if args.devices else None,
-            collect_blocks(autotune=tuners), tuners,
-            sort_key=args.sort)
+            collect_blocks(autotune=tuners, health=health), tuners,
+            sort_key=args.sort, health=health)
         print('\n'.join(lines))
         return 0
     run_curses(args)

@@ -34,6 +34,12 @@
 #                               bench topology + every example linted by
 #                               the pipeline verifier; tools/verify_gate.py,
 #                               strict: any BF-E fails the round up front)
+#   CHAOS_SOAK_${ROUND}.json  - chaos/soak gate (config 15 on CPU: a
+#                               bridged two-process pipeline under a
+#                               scripted overload+kill+fault schedule —
+#                               no deadlock, no silent loss, health
+#                               SHEDDING->OK, p99 under BF_SLO_MS;
+#                               tools/chaos_gate.py)
 #   bench_watch.log           - probe/attempt history (gitignored)
 cd "$(dirname "$0")/.." || exit 1
 ROUND="${BF_BENCH_ROUND:-r$(date -u +%Y%m%d)}"
@@ -202,6 +208,23 @@ for i in $(seq 1 400); do
         if [ "$brg" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) ring bridge wire gate FAILED" >> "$LOG"
           exit "$brg"
+        fi
+      fi
+      # Chaos/soak gate: config 15 on CPU — a bridged two-process
+      # pipeline under a scripted overload+kill+fault schedule must
+      # never deadlock, account every lost byte in the shed ledgers
+      # (no silent loss), traverse SHEDDING and recover to OK, and
+      # keep the capture-to-exit p99 under BF_SLO_MS while shedding
+      # (tools/chaos_gate.py; docs/robustness.md "Overload &
+      # degradation").  Writes CHAOS_SOAK_${ROUND}.json.
+      if [ "${BF_SKIP_CHAOS_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) chaos/soak gate (config 15, CPU)" >> "$LOG"
+        python tools/chaos_gate.py --out "CHAOS_SOAK_${ROUND}.json" >> "$LOG" 2>&1
+        crc_gate=$?
+        echo "$(date -u +%FT%TZ) chaos gate rc=$crc_gate" >> "$LOG"
+        if [ "$crc_gate" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) chaos/soak gate FAILED" >> "$LOG"
+          exit "$crc_gate"
         fi
       fi
       # Mesh-resident pipeline gate: config 11 on an 8-device
