@@ -611,7 +611,12 @@ def bench_gulp_batch(reps=3, ngulp=96):
     """The config-8 gulp chain (host src -> copy h2d -> fused
     FFT->detect->reduce -> copy d2h -> sink) at K in {1, 4, 16}
     macro-gulp batch, emitting dispatches/gulp + throughput per arm
-    (docs/perf.md "Macro-gulp execution").
+    (docs/perf.md "Macro-gulp execution"), plus a compiled-segment
+    arm (K16seg): the same chain written as SEPARATE fft/detect/
+    reduce blocks under ``BF_SEGMENTS=auto`` at K=16 — the segment
+    compiler fuses them back into one program, so the macro-K ladder
+    and ring elision are measured composing (config 16 /
+    tools/segment_gate.py is the dedicated gate).
 
     Noise defenses follow the observability gate (tools/
     obs_overhead.py): per-arm MINIMA over ``reps`` interleaved
@@ -645,68 +650,94 @@ def bench_gulp_batch(reps=3, ngulp=96):
     raw['im'] = rng.randint(-64, 64, raw.shape)
     hdr = simple_header([-1, NP, NF], 'ci8',
                         labels=['time', 'pol', 'fine_time'])
-    ks = (1, 4, 16)
+    #: (arm label, macro K, compiled-segments arm): K16seg runs the
+    #: SAME math as reference-style SEPARATE fft/detect/reduce blocks
+    #: under BF_SEGMENTS=auto — the segment compiler must recover the
+    #: hand-fused chain's performance from the unfused pipeline
+    #: (docs/perf.md "Compiled pipeline segments"; config 16 is the
+    #: dedicated gate, this arm keeps the comparison visible next to
+    #: the macro-K ladder it composes with)
+    arm_specs = (('K1', 1, False), ('K4', 4, False),
+                 ('K16', 16, False), ('K16seg', 16, True))
 
-    def run_arm(k, tag):
+    def run_arm(k, seg, tag):
         counters.reset()
-        with bf.Pipeline(gulp_batch=k, sync_depth=4) as p:
+        # 'off' (not None) on the plain-K arms: an ambient BF_SEGMENTS
+        # must not skew the macro-K ladder's baselines.  'force' (not
+        # 'auto') on the seg arm: a silent fusion regression must
+        # fail the arm loudly, never quietly measure the unfused
+        # chain under the compiled-segment label
+        with bf.Pipeline(gulp_batch=k, sync_depth=4,
+                         segments='force' if seg else 'off') as p:
             src = NumpySourceBlock([raw.copy() for _ in range(ngulp)],
                                    hdr, gulp_nframe=NT)
             b = bf.blocks.copy(src, space='tpu')
-            fb = bf.blocks.fused(
-                b, [FftStage('fine_time', axis_labels='freq'),
-                    DetectStage('stokes', axis='pol'),
-                    ReduceStage('freq', RF)],
-                name='FusedBatch_%s' % tag)
+            if seg:
+                b = bf.blocks.fft(b, axes='fine_time',
+                                  axis_labels='freq')
+                b = bf.blocks.detect(b, mode='stokes', axis='pol')
+                fb = bf.blocks.reduce(b, 'freq', RF)
+            else:
+                fb = bf.blocks.fused(
+                    b, [FftStage('fine_time', axis_labels='freq'),
+                        DetectStage('stokes', axis='pol'),
+                        ReduceStage('freq', RF)],
+                    name='FusedBatch_%s' % tag)
             b2 = bf.blocks.copy(fb, space='system')
             sink = GatherSink(b2)
             t0 = time.perf_counter()
             p.run()
             dt = time.perf_counter() - t0
         snap = counters.snapshot()
+        frag = 'Segment' if seg else 'FusedBatch'
         disp = gulps = 0
         for name, v in snap.items():
-            if name.startswith('block.') and 'FusedBatch' in name:
+            if name.startswith('block.') and frag in name:
                 if name.endswith('.dispatches'):
                     disp += v
                 elif name.endswith('.gulps'):
                     gulps += v
         return dt, disp, gulps, sink.result()
 
-    times = {k: [] for k in ks}
-    stats = {k: None for k in ks}
+    times = {label: [] for label, _k, _s in arm_specs}
+    stats = {label: None for label, _k, _s in arm_specs}
     outputs = {}
     for rep in range(max(reps, 1)):
-        order = list(ks) if rep % 2 == 0 else list(reversed(ks))
-        for k in order:
-            dt, disp, gulps, out = run_arm(k, 'k%d_r%d' % (k, rep))
-            times[k].append(dt)
-            stats[k] = (disp, gulps)
-            outputs.setdefault(k, out)
+        order = list(arm_specs) if rep % 2 == 0 \
+            else list(reversed(arm_specs))
+        for label, k, seg in order:
+            dt, disp, gulps, out = run_arm(
+                k, seg, '%s_r%d' % (label.lower(), rep))
+            times[label].append(dt)
+            stats[label] = (disp, gulps)
+            outputs.setdefault(label, out)
     nsamples = ngulp * NT * NP * NF
     arms = {}
-    for k in ks:
-        disp, gulps = stats[k]
-        tmin = min(times[k])
-        arms['K%d' % k] = {
+    for label, _k, _s in arm_specs:
+        disp, gulps = stats[label]
+        tmin = min(times[label])
+        arms[label] = {
             'ms_min': round(tmin * 1e3, 1),
-            'ms_all': [round(t * 1e3, 1) for t in times[k]],
+            'ms_all': [round(t * 1e3, 1) for t in times[label]],
             'msps_best': round(nsamples / tmin / 1e6, 1),
             'fused_dispatches': disp,
             'fused_gulps': gulps,
             'dispatches_per_gulp': round(disp / float(max(gulps, 1)),
                                          4),
         }
-    t1, t16 = min(times[1]), min(times[16])
+    t1, t16 = min(times['K1']), min(times['K16'])
     dp1 = arms['K1']['dispatches_per_gulp']
     dp16 = arms['K16']['dispatches_per_gulp']
-    same = all(np.array_equal(outputs[1], outputs[k]) for k in ks[1:])
+    same = all(np.array_equal(outputs['K1'], outputs[label])
+               for label, _k, _s in arm_specs[1:])
     return {
         'config': 'macro-gulp batched dispatch: config-8 chain at '
-                  'K in {1,4,16}, %d x %d-frame gulps' % (ngulp, NT),
+                  'K in {1,4,16} plus a compiled-segment arm '
+                  '(unfused blocks + BF_SEGMENTS=auto at K=16), '
+                  '%d x %d-frame gulps' % (ngulp, NT),
         'value': round(t1 / t16, 2),
         'unit': 'x gulp-loop speedup (K=16 vs K=1, min-of-%d)'
-                % len(times[1]),
+                % len(times['K1']),
         'arms': arms,
         'outputs_identical': bool(same),
         # the acceptance pair the batch gate (tools/batch_gate.py)
@@ -719,6 +750,203 @@ def bench_gulp_batch(reps=3, ngulp=96):
                      'table (docs/perf.md) measures ~6x headroom '
                      'between dispatch-bound and amortized regimes '
                      'on the tunneled chip',
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 16: compiled pipeline segments (BF_SEGMENTS — ring elision);
+# gated by tools/segment_gate.py into BENCH_SEGMENT_${ROUND}.json
+# ---------------------------------------------------------------------------
+
+def bench_segments(reps=9, ngulp=288):
+    """Compiled pipeline segments (bifrost_tpu.segments; docs/perf.md
+    "Compiled pipeline segments"): the config-8 math written as
+    reference-style SEPARATE fft/detect/reduce device blocks, run
+    three ways at macro K=16:
+
+    - ``unfused``  — BF_SEGMENTS off: three device blocks, each
+      macro-batched, two interior device rings handed off per span
+      (the pre-segment status quo);
+    - ``segment``  — BF_SEGMENTS=auto: the compiler fuses the three
+      blocks into ONE program scanning the K-gulp span and elides
+      both interior rings — 0 Python dispatches and 0 ring handoffs
+      per gulp inside the segment;
+    - ``fused``    — the hand-written FusedBlock chain (config 9's
+      K=16 arm): the performance target the segment arm must match,
+      since both compile the SAME composed program.
+
+    Noise defenses as configs 9/11: per-arm minima over ``reps``
+    interleaved repetitions, arm order alternating between
+    repetitions.  What the gate asserts (tools/segment_gate.py):
+
+    - ``outputs_identical``        — segment arm byte-identical to
+                                     the unfused chain (and to the
+                                     hand-fused arm);
+    - ``zero_interior_dispatches`` — the member blocks dispatched
+                                     exactly ZERO times; the device
+                                     chain's ``block.*.dispatches``
+                                     counts segments, not blocks
+                                     (1/K per gulp at K=16);
+    - ``elided``                   — both interior rings elided and
+                                     registering no span traffic;
+    - ``throughput_ok``            — segment wall-clock no worse than
+                                     the hand-fused macro K=16 arm.
+                                     Judged by the PAIRED-median
+                                     estimator (the e2e/autotune
+                                     gates' policy): per-repetition
+                                     segment/fused ratios from the
+                                     interleaved arms, median taken —
+                                     adjacent same-length runs on the
+                                     2-core CI host spread ±10%, so a
+                                     min-vs-min wall comparison of two
+                                     arms that compile the SAME
+                                     program cannot certify a 5%
+                                     bound, but paired ratios cancel
+                                     the drift.  ``ngulp`` is sized so
+                                     each arm runs long enough (~0.5s)
+                                     that per-run constant noise
+                                     (pipeline spin-up, first spans)
+                                     sits well inside the threshold.
+    """
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests'))
+    import bifrost_tpu as bf
+    from bifrost_tpu.telemetry import counters
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    bf.enable_compilation_cache()
+    NT, NP, NF, RF, K = 64, 2, 256, 4, 16
+    rng = np.random.RandomState(3)
+    raw = np.zeros((NT, NP, NF), dtype=np.dtype([('re', 'i1'),
+                                                 ('im', 'i1')]))
+    raw['re'] = rng.randint(-64, 64, raw.shape)
+    raw['im'] = rng.randint(-64, 64, raw.shape)
+    hdr = simple_header([-1, NP, NF], 'ci8',
+                        labels=['time', 'pol', 'fine_time'])
+    arm_specs = ('unfused', 'segment', 'fused')
+
+    def run_arm(arm):
+        counters.reset()
+        # explicit 'off' on the baseline arms: segments=None would
+        # defer to an ambient BF_SEGMENTS and silently fuse the
+        # 'unfused' baseline into the very thing it baselines
+        seg_mode = 'force' if arm == 'segment' else 'off'
+        with bf.Pipeline(gulp_batch=K, sync_depth=4,
+                         segments=seg_mode) as p:
+            src = NumpySourceBlock([raw.copy() for _ in range(ngulp)],
+                                   hdr, gulp_nframe=NT)
+            b = bf.blocks.copy(src, space='tpu')
+            if arm == 'fused':
+                fb = bf.blocks.fused(
+                    b, [FftStage('fine_time', axis_labels='freq'),
+                        DetectStage('stokes', axis='pol'),
+                        ReduceStage('freq', RF)])
+            else:
+                b = bf.blocks.fft(b, axes='fine_time',
+                                  axis_labels='freq')
+                b = bf.blocks.detect(b, mode='stokes', axis='pol')
+                fb = bf.blocks.reduce(b, 'freq', RF)
+            b2 = bf.blocks.copy(fb, space='system')
+            sink = GatherSink(b2)
+            t0 = time.perf_counter()
+            p.run()
+            dt = time.perf_counter() - t0
+        snap = counters.snapshot()
+        # device-chain dispatch accounting: member blocks must count
+        # ZERO dispatches in the segment arm (block.*.dispatches ==
+        # segments, not blocks); gulps stay synthesized 1:1
+        chain = ('FftBlock', 'DetectBlock', 'ReduceBlock', 'Segment',
+                 'FusedBlock')
+        disp = gulps = member_disp = 0
+        for name, v in snap.items():
+            if not name.startswith('block.'):
+                continue
+            if name.endswith('.dispatches') and \
+                    any(c in name for c in chain):
+                disp += v
+                # the segment's own name embeds its head member's
+                # ('Segment_x3_FftBlock_0'): member accounting must
+                # exclude it — only REAL member-block dispatches count
+                if 'Segment' not in name and \
+                        any(c in name for c in chain[:3]):
+                    member_disp += v
+            elif name.endswith('.gulps') and \
+                    ('Segment' in name or 'FusedBlock' in name or
+                     (arm == 'unfused' and 'ReduceBlock' in name)):
+                gulps += v
+        stats = {
+            'device_chain_dispatches': disp,
+            'member_dispatches': member_disp,
+            'dispatches_per_gulp': round(disp / float(max(gulps, 1)),
+                                         4),
+            'segment_dispatches': snap.get('segment.dispatches', 0),
+            'segment_gulps': snap.get('segment.gulps', 0),
+            'segment_elided_rings': snap.get('segment.elided_rings',
+                                             0),
+            'segments_compiled': snap.get('segment.compiled', 0),
+        }
+        return dt, stats, sink.result()
+
+    times = {a: [] for a in arm_specs}
+    stats = {a: None for a in arm_specs}
+    outputs = {}
+    for rep in range(max(reps, 1)):
+        order = list(arm_specs) if rep % 2 == 0 \
+            else list(reversed(arm_specs))
+        for arm in order:
+            dt, st, out = run_arm(arm)
+            times[arm].append(dt)
+            stats[arm] = st
+            outputs.setdefault(arm, out)
+    nsamples = ngulp * NT * NP * NF
+    arms = {}
+    for arm in arm_specs:
+        tmin = min(times[arm])
+        arms[arm] = dict(stats[arm],
+                         ms_min=round(tmin * 1e3, 1),
+                         ms_all=[round(t * 1e3, 1)
+                                 for t in times[arm]],
+                         msps_best=round(nsamples / tmin / 1e6, 1))
+    t_un, t_seg = min(times['unfused']), min(times['segment'])
+    t_fused = min(times['fused'])
+    # drift-robust paired comparison: same-rep ratios of the
+    # interleaved arms, median over reps
+    paired_vs_fused = float(np.median(
+        [s / f for s, f in zip(times['segment'], times['fused'])]))
+    paired_vs_unfused = float(np.median(
+        [s / u for s, u in zip(times['segment'],
+                               times['unfused'])]))
+    seg = stats['segment']
+    same = np.array_equal(outputs['unfused'], outputs['segment']) \
+        and np.array_equal(outputs['unfused'], outputs['fused'])
+    return {
+        'config': 'compiled pipeline segments: unfused 3-block device '
+                  'chain vs BF_SEGMENTS=auto vs hand-fused, all at '
+                  'macro K=%d, %d x %d-frame gulps' % (K, ngulp, NT),
+        'value': round(t_un / t_seg, 2),
+        'unit': 'x gulp-loop speedup (segment vs unfused, min-of-%d)'
+                % len(times['unfused']),
+        'arms': arms,
+        'outputs_identical': bool(same),
+        # the acceptance set tools/segment_gate.py checks
+        'zero_interior_dispatches':
+            bool(seg['member_dispatches'] == 0 and
+                 seg['segments_compiled'] >= 1),
+        'elided': bool(seg['segment_elided_rings'] == 2),
+        'throughput_ok': bool(paired_vs_fused <= 1.05),
+        'vs_fused': round(t_seg / t_fused, 3),
+        'paired_vs_fused': round(paired_vs_fused, 3),
+        'paired_vs_unfused': round(paired_vs_unfused, 3),
+        'roofline': {
+            'bound': 'per-boundary Python dispatch + ring handoff; '
+                     'the segment arm removes BOTH inside the chain '
+                     '(segment.dispatches per gulp = 1/K, interior '
+                     'ring traffic = 0) — docs/perf.md "Compiled '
+                     'pipeline segments"',
         },
     }
 
@@ -2338,13 +2566,14 @@ ALL = {
     13: bench_beamform_chain,
     14: bench_autotune,
     15: bench_chaos_soak,
+    16: bench_segments,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-15; 0 = all')
+                    help='config number 1-16; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -2354,7 +2583,7 @@ def main(argv=None):
                     help='flagship pipeline Msamples/s for config 7')
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
-    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14)
+    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16)
                    for c in todo)
     if need_dev:
         from bench import _backend_alive
@@ -2572,6 +2801,37 @@ def _verify_config15():
     return [ptx, prx]
 
 
+def _verify_config16():
+    """The segment gate's chain (bench_segments): reference-style
+    SEPARATE fft/detect/reduce device blocks at macro K=16.  Built
+    WITHOUT segments engaged (lint validates the constructed graph),
+    so the verifier must both prove it clean (0 BF-E) and report a
+    BF-I190 reason for every device-ring boundary — 'disabled' on the
+    two fusable interior boundaries, 'host' at the copy movers."""
+    import sys as _sys
+    import os as _os
+    _tests = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests')
+    if _tests not in _sys.path:
+        _sys.path.insert(0, _tests)
+    import bifrost_tpu as bf
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    NT, NP, NF, RF = 64, 2, 256, 4
+    raw = np.zeros((NT, NP, NF), dtype=np.dtype([('re', 'i1'),
+                                                 ('im', 'i1')]))
+    hdr = simple_header([-1, NP, NF], 'ci8',
+                        labels=['time', 'pol', 'fine_time'])
+    with bf.Pipeline(sync_depth=4, gulp_batch=16) as p:
+        src = NumpySourceBlock([raw.copy()], hdr, gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fft(b, axes='fine_time', axis_labels='freq')
+        b = bf.blocks.detect(b, mode='stokes', axis='pol')
+        b = bf.blocks.reduce(b, 'freq', RF)
+        GatherSink(bf.blocks.copy(b, space='system'))
+    return p
+
+
 def build_verify_topologies():
     """{name: builder} over every pipeline-shaped bench config.  Each
     builder returns a Pipeline, a list of Pipelines, or None when the
@@ -2586,6 +2846,7 @@ def build_verify_topologies():
         'config13_beamform': _verify_config13,
         'config14_tune': _verify_config14,
         'config15_chaos': _verify_config15,
+        'config16_segments': _verify_config16,
     }
 
 

@@ -67,6 +67,8 @@ CODES = {
                'data',
     'BF-I170': 'header propagation stops at this block',
     'BF-I171': 'gulp geometry unknown; ring sizing not proven',
+    'BF-I190': 'device-ring boundary did not fuse into a compiled '
+               'segment',
     'BF-I199': 'verifier check failed internally (diagnostic only)',
 }
 
@@ -166,6 +168,11 @@ class scope_overrides(object):
       pin their own value below the root keep it, mirroring
       ``macro.retune_gulp_batch`` writing only the root scope.
     - ``bridge_window``: ``{bridge sink block name: window}``.
+    - ``bridge_streams`` / ``segment_split``: accepted for protocol
+      uniformity (every tuner knob rides the same gate), but no
+      static check constrains them today — stripe count and segment
+      splits change dispatch/connection count, never ring geometry —
+      so they shape no verdict.
 
     Overrides only shape the verdict on the calling thread, so a
     concurrent ``Pipeline.validate()`` elsewhere still sees the live
@@ -1000,9 +1007,33 @@ def _check_overload(g, diags):
                 block=b.name, ring=_ring_name(irings[0])))
 
 
+def _check_segments(g, diags):
+    """BF-I190: why each device-ring boundary did NOT fuse into a
+    compiled segment (bifrost_tpu.segments; docs/perf.md "Compiled
+    pipeline segments").  The reasons come from the SAME planner the
+    compiler runs, so a segment can never form across a boundary this
+    check cannot prove safe — they are one computation.  Mirrors
+    BF-W160's job for macro-gulp: the runtime's silent fusion
+    fallback, surfaced at submit time WITH the reason.  Info-level by
+    design: an unfused boundary is the pre-segment status quo, not a
+    misconfiguration."""
+    from .. import segments as _segments
+    mode = _segments.resolve_mode(getattr(g.pipeline, 'segments',
+                                          None))
+    _chains, boundaries = _segments.plan(g.pipeline, mode)
+    for b in boundaries:
+        diags.append(Diagnostic(
+            'BF-I190',
+            'ring %r boundary %s -> %s did not fuse into a compiled '
+            'segment (reason: %s — %s)'
+            % (b['ring'], b['producer'], b['consumer'], b['reason'],
+               _segments.REASONS.get(b['reason'], '?')),
+            block=b['producer'], ring=b['ring']))
+
+
 _CHECKS = (_check_tensor_contracts, _check_ring_sizing,
            _check_donation, _check_mesh, _check_bridge, _check_macro,
-           _check_quantization, _check_overload)
+           _check_quantization, _check_overload, _check_segments)
 
 
 def verify_pipeline(pipeline):

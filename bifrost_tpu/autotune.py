@@ -73,7 +73,7 @@ import time
 from .supervision import _env_float, _env_int
 
 __all__ = ['AutoTuner', 'maybe_start', 'resolve_mode', 'apply_profile',
-           'load_profile']
+           'load_profile', 'topology_signature']
 
 #: controller tick period (seconds)
 DEFAULT_INTERVAL = 0.5
@@ -87,6 +87,7 @@ DEFAULT_MIN_GAIN = 0.02
 MAX_GULP_BATCH = 16
 MAX_SYNC_DEPTH = 32
 MAX_WINDOW = 32
+MAX_STREAMS = 8
 #: per-ring growth ceiling for the capacity knob (bytes)
 MAX_RING_BYTES = 256 << 20
 #: hysteresis thresholds for the trigger signals
@@ -129,12 +130,71 @@ def load_profile(path=None):
     return prof if isinstance(prof, dict) and 'knobs' in prof else None
 
 
+def topology_signature(pipeline):
+    """Structural identity of a pipeline's block/ring graph:
+    ``(hash, block_keys, ring_keys)``.
+
+    ``block_keys``/``ring_keys`` map LIVE names to STRUCTURAL keys —
+    a block is ``<Type>#<n>`` (the n-th block of that type in
+    construction order), a ring is ``<producer key>.out<j>`` (or
+    ``<first consumer key>.in<j>`` for externally-fed rings) — and
+    the hash digests block types plus ring roles (producer/consumer
+    positions and spaces).  Names never enter any of it, so renaming
+    a ring or a block leaves the signature — and every key — intact.
+
+    This is what makes freeze profiles PORTABLE (docs/autotune.md):
+    version-2 profiles key their per-ring/per-block knobs by
+    structural key instead of positional name, so a profile survives
+    a topology rename that used to invalidate every entry."""
+    import hashlib
+    blocks = list(pipeline.blocks)
+    counts = {}
+    bkey = {}
+    for b in blocks:
+        t = type(b).__name__
+        i = counts.get(t, 0)
+        counts[t] = i + 1
+        bkey[id(b)] = '%s#%d' % (t, i)
+
+    def base(r):
+        return getattr(r, '_base_ring', r)
+
+    ring_key, ring_live = {}, {}
+    for b in blocks:
+        for j, r in enumerate(getattr(b, 'orings', None) or []):
+            br = base(r)
+            ring_key.setdefault(id(br), '%s.out%d' % (bkey[id(b)], j))
+            ring_live.setdefault(id(br), getattr(br, 'name', '?'))
+    for b in blocks:
+        for j, r in enumerate(getattr(b, 'irings', None) or []):
+            br = base(r)
+            ring_key.setdefault(id(br), '%s.in%d' % (bkey[id(b)], j))
+            ring_live.setdefault(id(br), getattr(br, 'name', '?'))
+    struct = []
+    for b in blocks:
+        def keys(rings):
+            return ','.join(
+                '%s:%s' % (ring_key[id(base(r))],
+                           getattr(base(r), 'space', '?'))
+                for r in (rings or []))
+        struct.append('%s|in=%s|out=%s'
+                      % (bkey[id(b)], keys(getattr(b, 'irings', None)),
+                         keys(getattr(b, 'orings', None))))
+    digest = hashlib.sha1('\n'.join(struct).encode()).hexdigest()[:16]
+    return (digest,
+            {b.name: bkey[id(b)] for b in blocks},
+            {ring_live[rid]: key for rid, key in ring_key.items()})
+
+
 def apply_profile(pipeline, profile):
     """Pin a pipeline's tunables to a saved profile's knob values
     (the freeze-replay path; also the warm start when a profile file
     already exists).  Ring capacities are requested through the
-    deferred-resize protocol; unknown ring/block names are skipped —
-    a profile from a different topology applies what it can."""
+    deferred-resize protocol.  Version-2 profiles key per-ring /
+    per-block knobs by STRUCTURAL key (:func:`topology_signature`),
+    so a renamed ring or block still receives its entry; version-1
+    name keys still apply as names.  Unknown keys are skipped — a
+    profile from a different topology applies what it can."""
     knobs = (profile or {}).get('knobs', {})
     if 'gulp_batch' in knobs:
         from .macro import retune_gulp_batch
@@ -143,20 +203,37 @@ def apply_profile(pipeline, profile):
         # 0 is legal (hard drain every gulp — resolve_sync_depth): a
         # profile frozen at 0 must restore the operator's memory bound
         pipeline._sync_depth = max(int(knobs['sync_depth']), 0)
+    _sig, bmap, rmap = topology_signature(pipeline)
+    live_block = {v: k for k, v in bmap.items()}
+    live_ring = {v: k for k, v in rmap.items()}
     windows = knobs.get('bridge_window', {})
-    if windows:
+    streams = knobs.get('bridge_streams', {})
+    if windows or streams:
         from .blocks.bridge import BridgeSink
         by_name = {b.name: b for b in pipeline.blocks
                    if isinstance(b, BridgeSink)}
-        for name, w in windows.items():
-            b = by_name.get(name)
+        for key, w in windows.items():
+            b = by_name.get(live_block.get(key, key))
             if b is not None:
                 b.retune_window(int(w))
+        for key, n in streams.items():
+            b = by_name.get(live_block.get(key, key))
+            if b is not None:
+                b.retune_streams(int(n))
+    splits = knobs.get('segment_split', {})
+    if splits:
+        from . import segments as _segments
+        by_name = {b.name: b
+                   for b in getattr(pipeline, '_segments', [])}
+        for key, n in splits.items():
+            b = by_name.get(live_block.get(key, key))
+            if b is not None:
+                _segments.retune_split(b, int(n))
     ring_bytes = knobs.get('ring_total_bytes', {})
     if ring_bytes:
         rings = _pipeline_rings(pipeline)
-        for name, nbyte in ring_bytes.items():
-            r = rings.get(name)
+        for key, nbyte in ring_bytes.items():
+            r = rings.get(live_ring.get(key, key))
             if r is not None:
                 try:
                     r.request_resize(r._ghost or 1, int(nbyte))
@@ -439,6 +516,128 @@ class _BridgeWindowKnob(_Knob):
         return self.tuner._verifier_allows_window(self.block, value)
 
 
+class _BridgeStreamsKnob(_Knob):
+    """One BridgeSink's connection-stripe count (the
+    ``BF_BRIDGE_STREAMS`` dial, retuned live — the other "remaining
+    knob" from the macro-tuning round).  Trigger: the sender still
+    spends a real fraction of wall time credit-stalled AFTER its
+    window knob has converged — a wide-enough window has covered the
+    link latency, so what remains is single-connection throughput,
+    and another TCP stream (its own congestion window) is the next
+    lever.  A step restripes via a drained planned redial at a span
+    boundary (``RingSender.retune_streams``), so stepping is cheap
+    but not free; the shared evaluate/revert machinery keeps the
+    extra stripes only when the objective says they pay (loopback
+    links typically revert — striping is a DCN win)."""
+
+    def __init__(self, tuner, block, window_knob=None):
+        super(_BridgeStreamsKnob, self).__init__(tuner)
+        self.block = block
+        self.window_knob = window_knob
+        self.name = 'bridge_streams.%s' % block.name
+
+    def read(self):
+        return int(self.block.nstreams)
+
+    def write(self, value):
+        self.block.retune_streams(int(value))
+
+    def signal(self, snap):
+        hrates = snap.get('rates', {}).get('histograms', {})
+        h = hrates.get('bridge.%s.send_stall_s' % self.block.name)
+        if h is None:
+            return None
+        return h['sum_per_s']
+
+    def triggered(self, sig):
+        # sequenced after the window knob: both knobs read the same
+        # stall signal, and stepping them concurrently would make the
+        # objective attribution meaningless
+        if self.window_knob is not None and \
+                not self.window_knob.converged:
+            return False
+        return sig > self.tuner.stall_frac_trigger
+
+    def engaged(self, snap):
+        # a restripe is applied by the PUMP thread at a span boundary
+        # (and a backlogged link defers it): hold judgment until the
+        # live sender actually runs the new stripe count — otherwise
+        # the evaluate window opens against the old wiring and the
+        # step is judged on noise
+        sender = getattr(self.block, '_sender', None)
+        if sender is None:
+            return True
+        return getattr(sender, '_restripe_pending', None) is None \
+            and len(sender.socks) == self.read()
+
+    def step(self, value):
+        nxt = min(max(value, 1) * 2, self.tuner.max_streams)
+        return nxt if nxt > value else None
+
+    def guard(self, value):
+        return self.tuner._verifier_allows_aux('bridge_streams',
+                                               self.block, value)
+
+
+class _SegmentSplitKnob(_Knob):
+    """One compiled segment's split count (bifrost_tpu.segments;
+    docs/perf.md "Compiled pipeline segments").  The fully-fused
+    program (split 0) is the measured default; this knob PROBES
+    whether splitting the segment at a member boundary schedules
+    better — one giant XLA program occasionally loses to two smaller
+    sequential ones (compile-time scheduling, VMEM pressure on real
+    chips) — keeps the split only when the windowed objective
+    improves, and RE-FUSES by the ordinary revert otherwise.  A split
+    changes dispatch count only, never ring geometry (the interior
+    rings stay elided either way); it still rides the same
+    verifier-gated retune protocol as every other knob.  Applies at
+    the next sequence, like macro-K.  Trigger: THIS segment's own
+    dispatch rate (``block.<segment>.dispatches``), and — with
+    several compiled segments — sequenced after the previous
+    segment's knob converges, so two probes never share one
+    evaluate window against the single pipeline objective."""
+
+    def __init__(self, tuner, block, prev_knob=None):
+        super(_SegmentSplitKnob, self).__init__(tuner)
+        self.block = block
+        self.prev_knob = prev_knob
+        self.name = 'segment_split.%s' % block.name
+
+    def read(self):
+        try:
+            return int(self.block._segment_split)
+        except (TypeError, ValueError):
+            return 0
+
+    def write(self, value):
+        from . import segments as _segments
+        _segments.retune_split(self.block, value)
+
+    def signal(self, snap):
+        rate = snap.get('rates', {}).get('counters', {}).get(
+            'block.%s.dispatches' % self.block.name, 0.0)
+        return rate if rate > 0 else None
+
+    def triggered(self, sig):
+        if self.prev_knob is not None and \
+                not self.prev_knob.converged:
+            return False
+        return sig > 0
+
+    def engaged(self, snap):
+        # a split lands at the NEXT sequence (_resolve_splits)
+        return getattr(self.block, '_splits_active', 0) == self.read()
+
+    def step(self, value):
+        nxt = value + 1
+        ceiling = max(len(getattr(self.block, '_members', [])) - 1, 0)
+        return nxt if nxt <= ceiling else None
+
+    def guard(self, value):
+        return self.tuner._verifier_allows_aux('segment_split',
+                                               self.block, value)
+
+
 class _RingCapacityKnob(_Knob):
     """One ring's total capacity: grow (never shrink — the BF-E101
     floor is a hard lower bound by construction) while the ring sits
@@ -510,6 +709,8 @@ class AutoTuner(threading.Thread):
         self.max_sync_depth = _env_int('BF_AUTOTUNE_MAX_DEPTH',
                                        MAX_SYNC_DEPTH)
         self.max_window = _env_int('BF_AUTOTUNE_MAX_WINDOW', MAX_WINDOW)
+        self.max_streams = _env_int('BF_AUTOTUNE_MAX_STREAMS',
+                                    MAX_STREAMS)
         self.max_ring_bytes = _env_int('BF_AUTOTUNE_MAX_RING_BYTES',
                                        MAX_RING_BYTES)
         #: ticks a pending step may wait for engagement (a macro-K
@@ -569,9 +770,29 @@ class AutoTuner(threading.Thread):
             from .blocks.bridge import BridgeSink
             for b in self.pipeline.blocks:
                 if isinstance(b, BridgeSink):
-                    knobs.append(_BridgeWindowKnob(self, b))
+                    wk = _BridgeWindowKnob(self, b)
+                    knobs.append(wk)
+                    # stripe count sequences AFTER the window knob
+                    # (same trigger signal, disjoint stepping); the
+                    # v1 wire has no striping, so no knob there —
+                    # retune_streams would set a value the sender
+                    # can never apply
+                    if getattr(b, 'protocol', None) != 1:
+                        knobs.append(_BridgeStreamsKnob(
+                            self, b, window_knob=wk))
         except Exception:
             pass
+        # compiled segments (bifrost_tpu.segments): the split/re-fuse
+        # boundary knob — mesh segments never split (_resolve_splits
+        # pins 0 there), so no knob is built for them; multiple
+        # segments' knobs chain so only one probes at a time
+        prev_seg_knob = None
+        for seg in getattr(self.pipeline, '_segments', []) or []:
+            if getattr(seg, 'mesh', None) is None and \
+                    len(getattr(seg, '_members', [])) > 1:
+                prev_seg_knob = _SegmentSplitKnob(
+                    self, seg, prev_knob=prev_seg_knob)
+                knobs.append(prev_seg_knob)
         for ring in _pipeline_rings(self.pipeline).values():
             knobs.append(_RingCapacityKnob(self, ring))
         return knobs
@@ -606,7 +827,15 @@ class AutoTuner(threading.Thread):
                 pass
         windows = knobs.get('bridge_window') or {}
         if isinstance(windows, dict) and windows:
-            overrides['bridge_window'] = windows
+            # v2 profiles key by structural key — translate to the
+            # LIVE block names the verifier's checks match against
+            try:
+                _sig, bmap, _rmap = topology_signature(self.pipeline)
+                live = {v: k for k, v in bmap.items()}
+            except Exception:
+                live = {}
+            overrides['bridge_window'] = {
+                live.get(key, key): w for key, w in windows.items()}
         if not overrides:
             return True
         try:
@@ -637,8 +866,18 @@ class AutoTuner(threading.Thread):
         return not verify.new_errors_vs(self._baseline(), cand)
 
     def _verifier_allows_window(self, block, value):
+        return self._verifier_allows_aux('bridge_window', block, value)
+
+    def _verifier_allows_aux(self, key, block, value):
+        """Per-block candidate gate: re-run the verifier with
+        ``{key: {block name: value}}`` supplied through the
+        thread-local override seam and refuse any step that would
+        INTRODUCE a BF-E.  ``bridge_streams`` / ``segment_split``
+        have no static constraint today (they change connection or
+        dispatch count, never ring geometry) — they still ride this
+        gate so every knob follows one retune protocol."""
         from .analysis import verify
-        overrides = {'bridge_window': {block.name: value}}
+        overrides = {key: {block.name: value}}
         try:
             with verify.scope_overrides(overrides):
                 cand = verify.verify_pipeline(self.pipeline)
@@ -743,17 +982,34 @@ class AutoTuner(threading.Thread):
             knobs['gulp_batch'] = values['gulp_batch']
         if 'sync_depth' in values:
             knobs['sync_depth'] = values['sync_depth']
-        windows = {b.name: int(b.window)
-                   for b in self.pipeline.blocks
-                   if isinstance(b, BridgeSink)}
+        # version 2: per-block/per-ring knobs key by STRUCTURAL key
+        # (topology_signature) — a renamed ring or block no longer
+        # invalidates its entry; apply_profile translates back
+        try:
+            sig, bmap, rmap = topology_signature(self.pipeline)
+        except Exception:
+            sig, bmap, rmap = None, {}, {}
+        windows, streams = {}, {}
+        for b in self.pipeline.blocks:
+            if isinstance(b, BridgeSink):
+                key = bmap.get(b.name, b.name)
+                windows[key] = int(b.window)
+                streams[key] = int(b.nstreams)
         if windows:
             knobs['bridge_window'] = windows
-        ring_bytes = {name: int(r.total_span)
+            knobs['bridge_streams'] = streams
+        splits = {bmap.get(s.name, s.name):
+                  int(getattr(s, '_segment_split', 0) or 0)
+                  for s in getattr(self.pipeline, '_segments', [])}
+        if splits:
+            knobs['segment_split'] = splits
+        ring_bytes = {rmap.get(name, name): int(r.total_span)
                       for name, r in
                       _pipeline_rings(self.pipeline).items()}
         if ring_bytes:
             knobs['ring_total_bytes'] = ring_bytes
-        prof = {'version': 1, 'pipeline': self.pipeline.name,
+        prof = {'version': 2, 'pipeline': self.pipeline.name,
+                'topology': sig,
                 'ticks': self.ticks, 'retunes': self.retunes,
                 'knobs': knobs}
         path = profile_path()

@@ -303,6 +303,21 @@ class BridgeSink(_BridgeBlock):
             sender.retune_window(window)
         return window
 
+    def retune_streams(self, nstreams):
+        """Runtime stripe-count retune (the auto-tuner's
+        ``BF_BRIDGE_STREAMS`` knob — docs/autotune.md): updates this
+        block's ``nstreams`` (what the dial callable connects with)
+        and asks the LIVE sender to restripe at its next span
+        boundary — a drained, planned redial the receiver re-accepts
+        like any reconnect, counted on ``bridge.tx.restripes``; see
+        :meth:`~bifrost_tpu.io.bridge.RingSender.retune_streams`."""
+        nstreams = max(int(nstreams), 1)
+        self.nstreams = nstreams
+        sender = self._sender
+        if sender is not None:
+            sender.retune_streams(nstreams)
+        return nstreams
+
 
 class BridgeSource(_BridgeBlock):
     """0-in/1-out block receiving a bridged stream into its output

@@ -13,7 +13,23 @@ from __future__ import annotations
 
 from ..pipeline import TransformBlock
 
-__all__ = ['FusedBlock', 'fused']
+__all__ = ['FusedBlock', 'fused', 'device_stages']
+
+
+def device_stages(block):
+    """The jit-backed Stage chain ``block`` executes as pure device
+    math, or None when the block is not stage-backed (host blocks,
+    space movers, sources/sinks, bridges).  This is the segment
+    compiler's eligibility primitive (bifrost_tpu.segments): any two
+    adjacent blocks with stage chains compose into ONE traced body —
+    a FusedBlock contributes its whole chain, a jitted stage block
+    its single stage."""
+    from .fft import _StageBlock
+    if isinstance(block, FusedBlock):
+        return list(block.stages)
+    if isinstance(block, _StageBlock):
+        return [block._stage]
+    return None
 
 
 class FusedBlock(TransformBlock):

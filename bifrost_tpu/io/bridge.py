@@ -540,6 +540,9 @@ class RingSender(object):
         #: bytes of one span at the current sequence's batch geometry —
         #: what a runtime window retune needs to grow the source ring
         self._cur_span_nbyte = 0
+        #: pending stripe-count retune, applied by the pump thread at
+        #: the next span boundary (retune_streams/_apply_restripe)
+        self._restripe_pending = None
         #: overload policy AT THE CREDIT WINDOW (docs/robustness.md
         #: "Overload & degradation"): 'block' (default — classic
         #: credit backpressure into the source ring), 'drop_newest'
@@ -605,6 +608,72 @@ class RingSender(object):
         with self._credit:
             self._credit.notify_all()
         return window
+
+    def retune_streams(self, nstreams):
+        """Runtime stripe-count retune (the auto-tuner's
+        ``BF_BRIDGE_STREAMS`` knob — docs/autotune.md).  Striping is
+        fixed at connect time (frames interleave across the socket
+        list by sequence number), so the change is applied by the PUMP
+        thread at the next span boundary as a planned restripe: drain
+        the credit window (every frame acked — nothing to retransmit),
+        close the stripes, redial through ``dial`` (which reads the
+        owner's updated stripe count), and re-handshake.  The receiver
+        treats the redial like any reconnect-and-resume; counted on
+        ``bridge.tx.restripes``, never against the reconnect budget."""
+        self._restripe_pending = max(int(nstreams), 1)
+        with self._credit:
+            self._credit.notify_all()
+        return self._restripe_pending
+
+    def _apply_restripe(self):
+        """The pump-thread half of :meth:`retune_streams` (span
+        boundary, v2 wire only)."""
+        n, self._restripe_pending = self._restripe_pending, None
+        if self.dial is None or self.naive or self.protocol < 2 \
+                or n == len(self.socks):
+            return
+        # drain the window with a SHORT bound: a backlogged link that
+        # cannot ack within the grace window simply defers the
+        # restripe to a later span boundary (the knob's step lands
+        # late) — the full _drain would hard-abort after its 60s
+        # stall timeout, turning a tuning probe into a transport
+        # failure.  Transport errors during the wait ride the
+        # ordinary _check_error -> _recover path (whose redial
+        # already dials the new stripe count).
+        deadline = time.monotonic() + 5.0
+        while True:
+            self._check_error()
+            with self._credit:
+                if not self._unacked:
+                    break
+                self._credit.wait(0.1)
+            if self._stop_requested():
+                return
+            if time.monotonic() >= deadline:
+                self._restripe_pending = n
+                return
+        self._stop_threads(join=True)
+        for s in self.socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self.socks = list(self.dial())
+            self._handshake(self.socks)
+        except (OSError, ConnectionError, BridgeProtocolError) as exc:
+            # a transient dial failure (or an open circuit breaker)
+            # during a PLANNED restripe must ride the ordinary
+            # reconnect machinery — jittered backoff, budget,
+            # nothing to retransmit (the window was drained) — not
+            # abort the sender: a tuning probe must never turn a
+            # link blip into a pipeline failure.  The recovery dial
+            # reads the owner's already-updated stripe count, so the
+            # restripe completes through it (counted as a reconnect).
+            self._recover(exc)
+            return
+        self._start_threads()
+        _counters().inc('bridge.tx.restripes')
 
     def run(self):
         self.prime()
@@ -1341,6 +1410,10 @@ class RingSender(object):
                 except Exception:
                     frame_nbyte = 1
                 while not self._stop_requested():
+                    # planned restripe (retune_streams): applied here,
+                    # at a span boundary, after draining the window
+                    if self._restripe_pending is not None:
+                        self._apply_restripe()
                     # overload policy at the credit window
                     # (docs/robustness.md): 'block' waits like the
                     # classic pump; 'drop_newest' sheds the gulp in

@@ -55,7 +55,8 @@ from __future__ import annotations
 import os
 
 __all__ = ['resolve_gulp_batch', 'retune_gulp_batch',
-           'chain_batch_mode', 'build_batched_fn', 'fallback_reason']
+           'chain_batch_mode', 'build_batched_fn', 'fallback_reason',
+           'split_ranges']
 
 
 def resolve_gulp_batch(scope):
@@ -108,6 +109,33 @@ def _split_count(nframe, gulp):
     """(full_gulps, remainder_frames) of a macro span."""
     k, r = divmod(int(nframe), int(gulp))
     return k, r
+
+
+def split_ranges(member_sizes, nsplits):
+    """Stage-index ranges of a compiled segment split into
+    ``nsplits + 1`` sequential sub-programs (bifrost_tpu.segments,
+    the auto-tuner's segment-boundary knob).
+
+    ``member_sizes`` is the per-member stage count of the fused chain
+    (split points may only land on member boundaries — a member's own
+    stage composition is indivisible).  Members are divided into
+    ``nsplits + 1`` contiguous groups as evenly as possible; returns
+    ``[(stage_lo, stage_hi), ...]`` half-open ranges into the
+    segment's flat stage list.  ``nsplits`` clamps to the available
+    boundary count; 0 returns the whole chain as one range."""
+    sizes = [int(s) for s in member_sizes]
+    nparts = max(min(int(nsplits), len(sizes) - 1), 0) + 1
+    # contiguous member groups, balanced like np.array_split
+    base, extra = divmod(len(sizes), nparts)
+    ranges = []
+    m0 = s0 = 0
+    for part in range(nparts):
+        count = base + (1 if part < extra else 0)
+        s1 = s0 + sum(sizes[m0:m0 + count])
+        ranges.append((s0, s1))
+        m0 += count
+        s0 = s1
+    return ranges
 
 
 def build_batched_fn(per_gulp_for_shape, taxis_in, taxis_out,

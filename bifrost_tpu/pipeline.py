@@ -344,7 +344,7 @@ class Pipeline(BlockScope):
     instance_count = 0
 
     def __init__(self, name=None, auto_fuse=None, watchdog_secs=None,
-                 **kwargs):
+                 segments=None, **kwargs):
         if name is None:
             name = 'Pipeline_%i' % Pipeline.instance_count
             Pipeline.instance_count += 1
@@ -353,6 +353,15 @@ class Pipeline(BlockScope):
             auto_fuse = os.environ.get('BF_AUTO_FUSE',
                                        '0').strip() == '1'
         self.auto_fuse = auto_fuse
+        #: segment-compiler mode (bifrost_tpu.segments; docs/perf.md
+        #: "Compiled pipeline segments"): None defers to BF_SEGMENTS
+        #: (default off), 'auto' fuses every provably-safe chain of
+        #: device blocks into ONE compiled program and elides the
+        #: interior rings, 'force' additionally raises when no
+        #: segment forms
+        self.segments = segments
+        #: SegmentBlocks created by the compiler pass (run())
+        self._segments = []
         #: stall-watchdog window in seconds (None: BF_WATCHDOG_SECS or
         #: off) — see docs/robustness.md
         self.watchdog_secs = watchdog_secs
@@ -529,6 +538,17 @@ class Pipeline(BlockScope):
         from .supervision import Supervisor
         if self.auto_fuse:
             self._auto_fuse()
+        # segment compiler (bifrost_tpu.segments; docs/perf.md
+        # "Compiled pipeline segments"): fuse maximal provably-safe
+        # chains of device blocks into ONE compiled program each and
+        # elide the interior rings — 0 Python dispatches and 0 ring
+        # handoffs per gulp inside a segment.  Runs BEFORE validation
+        # so lint/strict modes judge the graph that will actually
+        # execute; the verifier reports a BF-I190 reason for every
+        # boundary that did not fuse (same planner, docs/analysis.md).
+        from . import segments as _segments
+        if _segments.resolve_mode(self.segments) != 'off':
+            _segments.compile_pipeline(self)
         # lint mode (tools/bf_lint.py): validate the constructed graph,
         # report, and return WITHOUT launching anything — scripts run
         # end to end as pure topology builders
@@ -659,8 +679,12 @@ class Pipeline(BlockScope):
         (stable-coded ``BF-Exxx``/``BF-Wxxx``/``BF-Ixxx`` findings —
         docs/analysis.md has the catalog).  ``run()`` calls this
         automatically per ``BF_VALIDATE={off,warn,strict}``; note that
-        auto-fusion (``auto_fuse``) rewrites the graph inside ``run``,
-        so a standalone ``validate()`` sees the pre-fusion topology."""
+        auto-fusion (``auto_fuse``) and the segment compiler
+        (``segments``/``BF_SEGMENTS``) rewrite the graph inside
+        ``run`` BEFORE its validation pass, so a standalone
+        ``validate()`` sees the pre-fusion topology — with a BF-I190
+        info naming each boundary the segment compiler would (or
+        could not) fuse."""
         from .analysis import verify
         return verify.verify_pipeline(self)
 

@@ -51,6 +51,13 @@ Ring-bridge counters (io/bridge.py wire v2 — docs/networking.md):
 - ``bridge.tx.reconnects``                 sender-side transport
                                            redials (unacked frames
                                            retransmitted)
+- ``bridge.tx.restripes``                  planned stripe-count
+                                           retunes (the auto-tuner's
+                                           BF_BRIDGE_STREAMS knob):
+                                           drained redials at a span
+                                           boundary, never counted
+                                           against the reconnect
+                                           budget
 - ``bridge.rx.frames`` / ``bridge.rx.bytes`` /
   ``bridge.rx.spans``                      frames/bytes/spans committed
                                            by RingReceiver
@@ -106,6 +113,31 @@ Macro-gulp execution counters (bifrost_tpu.macro — docs/perf.md):
                                            on h2d_issued only — watch
                                            block.<name>.dispatches to
                                            confirm macro H2D engaged
+
+Compiled-segment counters (bifrost_tpu.segments — docs/perf.md
+"Compiled pipeline segments"):
+
+- ``segment.compiled``                     chains fused into one
+                                           compiled segment at plan
+                                           time
+- ``segment.elided_rings``                 interior rings elided by
+                                           those segments (no span
+                                           ever flows through them)
+- ``segment.dispatches`` /
+  ``segment.gulps``                        real dispatches issued by
+                                           segment programs and the
+                                           logical gulps they covered
+                                           (> 1 dispatch per gulp-set
+                                           only when the auto-tuner
+                                           split a segment).  Member
+                                           blocks keep synthesized
+                                           ``block.<name>.gulps`` but
+                                           NO dispatches counter —
+                                           ``block.*.dispatches``
+                                           counts segments, not
+                                           blocks (the regression
+                                           sentinel watches both
+                                           segment.* counters)
 
 Mesh-resident pipeline counters (docs/parallel.md):
 

@@ -53,7 +53,10 @@ def _codes(diags):
 
 def test_clean_chain_validates_clean():
     """The config-8 chain (the hot path every bench runs) must verify
-    with zero diagnostics — the strict gate depends on this."""
+    with zero errors/warnings — the strict gate depends on this.
+    Info-level findings are allowed (BF-I190 inventories the unfused
+    device-ring boundaries on every chain, by design); anything
+    visible in warn mode is not."""
     with bf.Pipeline(sync_depth=4) as p:
         src = NumpySourceBlock(_raw(), _hdr(), gulp_nframe=NT)
         b = bf.blocks.copy(src, space='tpu')
@@ -63,7 +66,10 @@ def test_clean_chain_validates_clean():
                                  ReduceStage('freq', 4)])
         GatherSink(bf.blocks.copy(fb, space='system'))
         diags = p.validate()
-    assert diags == [], _codes(diags)
+    visible = [d for d in diags if d.severity != 'info']
+    assert visible == [], _codes(visible)
+    # the info inventory names each non-fused device-ring boundary
+    assert {d.code for d in diags} <= {'BF-I190'}, _codes(diags)
 
 
 def test_undersized_macro_ring_is_deadlock_error():
@@ -230,10 +236,15 @@ def test_float_path_on_quantized_ring_warns():
             GatherSink(bf.blocks.copy(b, space='system'))
             return p.validate()
 
+    def visible(diags):
+        # BF-I190 inventories unfused boundaries on every chain; this
+        # test is about the warning
+        return [d for d in diags if d.severity != 'info']
+
     diags = build(accuracy='f32')
     assert 'BF-W170' in _codes(diags), _codes(diags)
-    assert build(accuracy='int8') == []
-    assert build(accuracy='f32', impl='int8_wide') == []
+    assert visible(build(accuracy='int8')) == []
+    assert visible(build(accuracy='f32', impl='int8_wide')) == []
     forced = build(accuracy='int8', impl='planar_bf16')
     assert 'BF-W170' in _codes(forced), _codes(forced)
 

@@ -663,3 +663,173 @@ def test_mprobe_disk_coin_flip_reraced(monkeypatch, tmp_path):
     w, _ms, _e = mprobe.select('disk_fam', 'k1', cands,
                                lambda: (np.ones(4, np.float32),))
     assert calls['a'] > 0 and calls['b'] > 0
+
+
+# ---------------------------------------------------------------------------
+# structural (topology-hash) freeze profiles — rename portability
+# ---------------------------------------------------------------------------
+
+def test_topology_signature_ignores_names():
+    p1, p2 = _pipeline(), _pipeline()
+    s1 = autotune.topology_signature(p1)
+    s2 = autotune.topology_signature(p2)
+    # two builds of the same topology share the hash even though
+    # every ring/block NAME differs (instance counters are global)
+    assert s1[0] == s2[0]
+    # renaming a ring changes neither the hash nor its structural key
+    ring = p1.blocks[1].orings[0]
+    base = getattr(ring, '_base_ring', ring)
+    old = base.name
+    base.name = 'renamed_ring'
+    s1b = autotune.topology_signature(p1)
+    assert s1b[0] == s1[0]
+    assert s1b[2]['renamed_ring'] == s1[2][old]
+
+
+def test_profile_v2_is_structurally_keyed_and_portable():
+    p = _pipeline()
+    tuner = AutoTuner(p, mode='freeze')
+    retune_gulp_batch(p, 8)
+    prof = tuner._dump_profile()
+    assert prof['version'] == 2
+    assert prof['topology'] == autotune.topology_signature(p)[0]
+    # per-ring knobs key by structural role, never positional name
+    rkeys = list(prof['knobs']['ring_total_bytes'])
+    assert rkeys and all('#' in k and '.out' in k for k in rkeys)
+    # a FRESH build of the same topology — different ring/block names
+    # throughout — still receives every knob
+    p2 = _pipeline()
+    applied = autotune.apply_profile(p2, prof)
+    assert applied['gulp_batch'] == 8
+    assert resolve_gulp_batch(p2) == 8
+
+
+def test_profile_v1_name_keys_still_apply():
+    p = _pipeline()
+    ring = getattr(p.blocks[1].orings[0], '_base_ring',
+                   p.blocks[1].orings[0])
+    prof = {'version': 1, 'knobs': {
+        'gulp_batch': 4,
+        'ring_total_bytes': {ring.name: ring.total_span}}}
+    applied = autotune.apply_profile(p, prof)
+    assert applied['gulp_batch'] == 4
+    assert resolve_gulp_batch(p) == 4
+
+
+# ---------------------------------------------------------------------------
+# the bridge stripe-count knob (BF_BRIDGE_STREAMS online)
+# ---------------------------------------------------------------------------
+
+def _bridge_pipeline():
+    from bifrost_tpu.blocks.bridge import bridge_sink
+    with bf.Pipeline(name='tune_streams_%d'
+                          % int(time.time() * 1e6)) as p:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        b = bridge_sink(src, '127.0.0.1', 1, window=1, nstreams=1)
+    return p, b
+
+
+def _stall_snap(sink_name, stall=0.5):
+    return {'rates': {'dt': 1.0, 'counters': {},
+                      'histograms': {
+                          'bridge.%s.send_stall_s' % sink_name:
+                              {'sum_per_s': stall}}},
+            'rings': {}, 'histograms': {}}
+
+
+def test_bridge_streams_knob_sequences_after_window_and_reverts():
+    p, sink = _bridge_pipeline()
+    tuner = AutoTuner(p, mode='on')
+    wknob = next(k for k in tuner.knobs
+                 if k.name.startswith('bridge_window'))
+    sknob = next(k for k in tuner.knobs
+                 if k.name.startswith('bridge_streams'))
+    snap = _stall_snap(sink.name)
+    # stalled, but the window knob has not converged: stripes hold
+    sknob.tick(snap, objective=100.0)
+    assert sknob.read() == 1 and sink.nstreams == 1
+    wknob.converged = True
+    sknob.tick(snap, objective=100.0)
+    assert sknob.read() == 2 and sink.nstreams == 2
+    for _ in range(tuner.cooldown_ticks):
+        sknob.tick(snap, objective=100.0)
+    # the extra stripe HURT (loopback): revert re-narrows and pins
+    sknob.tick(snap, objective=10.0)
+    assert sknob.read() == 1 and sink.nstreams == 1
+    assert sknob.converged
+    assert counters.get('autotune.reverts') >= 1
+
+
+def test_retune_streams_plumbing_without_live_sender():
+    _p, sink = _bridge_pipeline()
+    assert sink.retune_streams(4) == 4
+    assert sink.nstreams == 4
+    assert sink.retune_streams(0) == 1       # clamps
+
+
+# ---------------------------------------------------------------------------
+# the segment split/re-fuse knob
+# ---------------------------------------------------------------------------
+
+def _segment_pipeline():
+    from bifrost_tpu import segments as bseg
+    with bf.Pipeline(name='tune_seg_%d' % int(time.time() * 1e6),
+                     segments='auto') as p:
+        src = NumpySourceBlock(_gulps(), _hdr(), gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fftshift(b, 'freq')
+        b = bf.blocks.fftshift(b, 'freq')
+        GatherSink(bf.blocks.copy(b, space='system'))
+    segs = bseg.compile_pipeline(p)
+    assert len(segs) == 1
+    return p, segs[0]
+
+
+def _segment_snap(seg, rate=5.0):
+    return {'rates': {'dt': 1.0,
+                      'counters': {'block.%s.dispatches'
+                                   % seg.name: rate},
+                      'histograms': {}},
+            'rings': {}, 'histograms': {}}
+
+
+def test_segment_split_knob_probes_then_refuses():
+    p, seg = _segment_pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs
+                if k.name.startswith('segment_split'))
+    assert knob.read() == 0
+    knob.tick(_segment_snap(seg), objective=100.0)
+    assert knob.read() == 1                  # probed one split
+    # the split lands at the next sequence; emulate engagement
+    seg._splits_active = 1
+    for _ in range(tuner.cooldown_ticks):
+        knob.tick(_segment_snap(seg), objective=100.0)
+    knob.tick(_segment_snap(seg), objective=50.0)   # the split HURT
+    assert knob.read() == 0                  # reverted == re-fused
+    assert knob.converged
+    assert counters.get('autotune.reverts') >= 1
+
+
+def test_segment_split_knob_requires_traffic():
+    p, seg = _segment_pipeline()
+    tuner = AutoTuner(p, mode='on')
+    knob = next(k for k in tuner.knobs
+                if k.name.startswith('segment_split'))
+    knob.tick(_segment_snap(seg, rate=0.0), objective=100.0)
+    assert knob.read() == 0                  # no segment traffic yet
+
+
+def test_profile_v2_carries_segment_and_stream_knobs():
+    p, seg = _segment_pipeline()
+    from bifrost_tpu import segments as bseg
+    bseg.retune_split(seg, 1)
+    tuner = AutoTuner(p, mode='freeze')
+    prof = tuner._dump_profile()
+    key = [k for k in prof['knobs'].get('segment_split', {})]
+    assert key and key[0].startswith('SegmentBlock#')
+    assert prof['knobs']['segment_split'][key[0]] == 1
+    # a fresh build receives the split through the structural key
+    p2, seg2 = _segment_pipeline()
+    autotune.apply_profile(p2, prof)
+    assert seg2._segment_split == 1

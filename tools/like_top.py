@@ -16,7 +16,10 @@ Panes (matching the reference's information set):
     this block commits/exits it — the SLO column, telemetry.slo,
     needs a trace-context origin in the stream),
     G/D = logical gulps per dispatch (1.0 unbatched; ~K when
-    macro-gulp execution is amortizing dispatch — docs/perf.md),
+    macro-gulp execution is amortizing dispatch — docs/perf.md; a
+    '+'-prefixed block is a compiled-segment member whose row is
+    synthesized by its segment, so fusion never reads as a dead
+    block),
     Shd = mesh width of the executing plan (1 single-device; N when
     the block runs sharded over an N-chip mesh — docs/parallel.md),
     GOP/s = GEMM-class throughput (declared real ops per gulp over
@@ -220,7 +223,13 @@ def collect_blocks(pids=None, autotune=None, health=None):
                 # GEMM-class throughput (docs/perf.md beamformer
                 # section): declared real ops per gulp over the median
                 # gulp time, in Gop/s (0 = not a GEMM-class block)
-                'gops': max(0.0, _num(perf.get('gemm_gops_per_s')))}
+                'gops': max(0.0, _num(perf.get('gemm_gops_per_s'))),
+                # compiled-segment membership (bifrost_tpu.segments):
+                # a fused member block's row is SYNTHESIZED by its
+                # segment (docs/perf.md) — the G/D column then shows
+                # the segment's amortization, so fusion never reads
+                # as a dead block
+                'seg': str(perf.get('in_segment') or '')}
     return rows
 
 
@@ -281,6 +290,7 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
     out.append(hdr)
     order = sorted(rows, key=lambda k: rows[k][sort_key],
                    reverse=sort_rev)
+    any_seg = False
     for key in order:
         d = rows[key]
         try:
@@ -288,6 +298,10 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
         except (KeyError, TypeError):
             pct = '%5s' % ' '
         name = d['name'].split('/')[-1][:24]
+        if d.get('seg'):
+            # fused into a compiled segment: synthesized row
+            any_seg = True
+            name = ('+' + name)[:24]
         out.append('%6i  %-24s  %4s  %5s  %8.3f  %8.3f  %8.3f  %8.3f'
                    '  %8.2f  %8.2f  %8.2f  %8.2f  %5.1f  %3i  %7.1f'
                    '  %s'
@@ -297,6 +311,10 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
                       d['wait99'] * 1e3, d['age99'] * 1e3, d['gpd'],
                       int(d['shards']), d['gops'],
                       d['cmd'][:max(width - 157, 0)]))
+    if any_seg:
+        out.append("('+' = fused into a compiled segment: the row is "
+                   'synthesized by the segment, G/D shows its '
+                   'amortization — docs/perf.md)')
     # pipeline health state machine (pipeline/health ProcLog —
     # docs/robustness.md "Overload & degradation")
     for pid in sorted(health or {}):

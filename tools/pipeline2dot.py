@@ -23,6 +23,12 @@ Annotations matching the reference's information set:
     is visible in the graph, not disguised as an ordinary block
   * dotted bidirectional association edges between blocks bound to the
     same core (reference: pipeline2dot.py:188-219)
+  * compiled pipeline segments (bifrost_tpu.segments, docs/perf.md)
+    rendered as ONE dashed cluster per segment: the member blocks
+    grouped with the segment node, the elided interior rings dashed +
+    grayed, the cluster labeled with the live dispatches-per-gulp
+    from the segment's perf key — fusion is visible instead of
+    looking like a chain of dead blocks
   * static-verifier diagnostics (bifrost_tpu.analysis.verify, published
     to the ``analysis/verify`` ProcLog by BF_VALIDATE=warn|strict)
     overlaid on the graph: rings/edges carrying a BF-E render red,
@@ -175,6 +181,37 @@ def bridge_label(info, stats):
     return '\\n'.join(parts)
 
 
+def segment_info(contents):
+    """{segment block: {'members': [...], 'elided': [...], 'split':
+    n, 'dpg': dispatches-per-gulp}} from the ``<block>/segment``
+    ProcLogs compiled segments publish (bifrost_tpu.segments) plus
+    the live ``segment_dispatches_per_gulp`` perf key.  pipeline2dot
+    renders each as ONE cluster: the member blocks grouped with the
+    segment node, the elided interior rings dashed — the graph shows
+    the fusion instead of a chain of apparently-dead blocks."""
+    out = {}
+    for block, logs in contents.items():
+        if _is_ring_entry(block):
+            continue
+        seg = logs.get('segment')
+        if not isinstance(seg, dict) or 'members' not in seg:
+            continue
+        perf = logs.get('perf', {})
+        try:
+            dpg = float(perf.get('segment_dispatches_per_gulp', 0))
+        except (TypeError, ValueError):
+            dpg = 0.0
+        out[block] = {
+            'members': [m for m in
+                        str(seg.get('members', '')).split(',') if m],
+            'elided': [r for r in
+                       str(seg.get('elided', '')).split(',') if r],
+            'split': int(float(seg.get('split', 0) or 0)),
+            'dpg': dpg,
+        }
+    return out
+
+
 def ring_flow(contents):
     """rings_flow/<name> ProcLogs -> {ring_name: fields} (published by
     telemetry.exporter.MetricsPublisher)."""
@@ -263,16 +300,44 @@ def to_dot(pid, contents, associations=True):
     geometry = ring_geometry(contents)
     ring_flows = ring_flow(contents)
     bridges = bridge_info(contents)
+    segments = segment_info(contents)
     diag_blocks, diag_rings = verifier_diags(contents)
     cmd = get_command_line(pid)
     if cmd.startswith('python'):
         cmd = cmd.split(None, 1)[-1]
     cmd = os.path.basename(cmd.split(None, 1)[0]) if cmd else ''
 
+    # compiled-segment membership: member blocks and elided interior
+    # rings render INSIDE their segment's cluster (dashed border); a
+    # block name may be stored with or without the pipeline prefix,
+    # so membership matches on the trailing path component too
+    seg_of_block, seg_of_ring = {}, {}
+    for seg, info in segments.items():
+        seg_of_block[seg] = seg
+        for m in info['members']:
+            seg_of_block[m] = seg
+            seg_of_block[m.split('/')[-1]] = seg
+        for r in info['elided']:
+            seg_of_ring[r] = seg
+
+    def _block_segment(block):
+        return seg_of_block.get(block) or \
+            seg_of_block.get(block.split('/')[-1])
+
     lines = ['digraph graph%d {' % pid,
              '  rankdir=LR;',
              '  labelloc="t";',
              '  label="Pipeline: %s\\n ";' % cmd]
+    cluster_nodes = {seg: [] for seg in segments}
+
+    def emit_node(line, block=None, ring=None):
+        seg = _block_segment(block) if block is not None \
+            else seg_of_ring.get(ring)
+        if seg in cluster_nodes:
+            cluster_nodes[seg].append(line)
+        else:
+            lines.append(line)
+
     rings = set()
     for block, (ins, outs) in sorted(flows.items()):
         # the transport's per-endpoint stats directories are telemetry
@@ -287,10 +352,10 @@ def to_dot(pid, contents, associations=True):
             # process here — annotate with the live transport figures
             info = bridges[block]
             stats = bridge_stats(contents, block)
-            lines.append('  "%s" [label="%s\\n%s\\n%s" shape="cds" '
-                         'style=filled fillcolor=lightgoldenrod];'
-                         % (block, block, cpu,
-                            bridge_label(info, stats)))
+            emit_node('  "%s" [label="%s\\n%s\\n%s" shape="cds" '
+                      'style=filled fillcolor=lightgoldenrod];'
+                      % (block, block, cpu,
+                         bridge_label(info, stats)), block=block)
         else:
             shape = 'ellipse' if block in sources else \
                 'diamond' if block in sinks else 'box'
@@ -299,15 +364,15 @@ def to_dot(pid, contents, associations=True):
                 # verifier finding on this block: tinted fill + a
                 # colored border, tooltip carries code + message
                 color, fill, tip = overlay
-                lines.append('  "%s" [label="%s\\n%s" shape="%s" '
-                             'style=filled fillcolor=%s color=%s '
-                             'penwidth=2 tooltip="%s"];'
-                             % (block, block, cpu, shape, fill,
-                                color, tip))
+                emit_node('  "%s" [label="%s\\n%s" shape="%s" '
+                          'style=filled fillcolor=%s color=%s '
+                          'penwidth=2 tooltip="%s"];'
+                          % (block, block, cpu, shape, fill,
+                             color, tip), block=block)
             else:
-                lines.append('  "%s" [label="%s\\n%s" shape="%s" '
-                             'style=filled fillcolor=lightsteelblue];'
-                             % (block, block, cpu, shape))
+                emit_node('  "%s" [label="%s\\n%s" shape="%s" '
+                          'style=filled fillcolor=lightsteelblue];'
+                          % (block, block, cpu, shape), block=block)
         # sequence proclogs record the block's INPUT header
         # (pipeline.py MultiTransformBlock.main), so the dtype label
         # belongs on the input edges only
@@ -345,8 +410,41 @@ def to_dot(pid, contents, associations=True):
                 extra += '  x%d ringlets' % nringlet
         else:
             extra = ''
-        lines.append('  "ring:%s" [label="%s%s" shape=ellipse];'
-                     % (r, r, extra))
+        if str(r) in seg_of_ring:
+            # elided interior ring of a compiled segment: still shown
+            # (the topology is real) but dashed + grayed — no span
+            # ever flows through it while the segment is fused
+            emit_node('  "ring:%s" [label="%s%s\\n(elided)" '
+                      'shape=ellipse style=dashed color=gray50 '
+                      'fontcolor=gray50];' % (r, r, extra),
+                      ring=str(r))
+        else:
+            lines.append('  "ring:%s" [label="%s%s" shape=ellipse];'
+                         % (r, r, extra))
+    # compiled-segment clusters (bifrost_tpu.segments): one dashed box
+    # around the segment node, its member blocks, and the elided
+    # interior rings, labeled with the LIVE dispatch amortization from
+    # the segment's perf proclog (docs/perf.md).  Graphviz assigns a
+    # node to the FIRST (sub)graph that mentions it, and the edge
+    # statements above already name the member/ring nodes at the root
+    # — so the cluster subgraphs must be INSERTED before every edge,
+    # right after the graph header, or they render as empty boxes
+    cluster_lines = []
+    for i, (seg, info) in enumerate(sorted(segments.items())):
+        label = 'compiled segment (%d blocks' % len(info['members'])
+        if info.get('split'):
+            label += ', split %d' % info['split']
+        label += ')'
+        if info.get('dpg'):
+            label += '\\n%.4g dispatches/gulp' % info['dpg']
+        cluster_lines.append('  subgraph cluster_segment_%d {' % i)
+        cluster_lines.append('    label="%s";' % label)
+        cluster_lines.append('    style=dashed; color=steelblue; '
+                             'fontcolor=steelblue;')
+        for node in cluster_nodes.get(seg, []):
+            cluster_lines.append('  ' + node)
+        cluster_lines.append('  }')
+    lines[4:4] = cluster_lines
     if associations:
         for a, b in core_associations(contents):
             lines.append('  "%s" -> "%s" [style="dotted" dir="both"];'

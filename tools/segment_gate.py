@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""Compiled-segment gate: ring elision must be free and invisible.
+
+Runs bench_suite config 16 (the config-8 math as SEPARATE
+fft/detect/reduce device blocks, run unfused vs under
+``BF_SEGMENTS=auto`` vs hand-fused, all at macro K=16 —
+bench_suite.bench_segments) in a fresh subprocess pinned to the CPU
+backend, and asserts:
+
+- ``outputs_identical``        — the segment arm's output stream is
+  byte-identical to the unfused chain (and to the hand-fused arm: the
+  compiler builds the SAME composed program a FusedBlock would);
+- ``zero_interior_dispatches`` — the fused member blocks issued
+  exactly ZERO Python dispatches: inside a segment there are 0
+  dispatches and 0 ring handoffs per gulp, and ``block.*.dispatches``
+  counts segments, not blocks;
+- ``elided``                   — both interior rings were elided
+  (``segment.elided_rings == 2``) and registered no span traffic;
+- ``throughput_ok``            — the segment arm is no worse than the
+  hand-fused macro K=16 arm by more than ``--threshold`` percent,
+  judged by the PAIRED-median estimator (per-repetition
+  segment/fused wall ratios from the interleaved arms, median over
+  reps — the e2e/autotune gates' policy: both arms compile the SAME
+  program, and on the 2-core CI host adjacent same-length runs
+  spread ±10%, so only paired ratios can certify a 5% bound; eliding
+  rings must never cost throughput where it cannot win it).
+
+The arm interleaving / min-of-N noise defenses live inside config 16
+itself (per-arm minima, alternating arm order between repetitions).
+The full config result is written to the ``--out`` JSON artifact so
+bench rounds record the segment path's health next to the throughput
+numbers (``BENCH_SEGMENT_${ROUND}.json``).
+
+Exit codes: 0 pass, 3 a gate condition failed, 2 the bench arm failed
+to produce a result.  ``tools/watch_and_bench.sh`` runs this after the
+macro-gulp batch gate (``BF_SKIP_SEGMENT_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config16(timeout=1800):
+    """One bench_suite --config 16 subprocess on the CPU backend;
+    returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # a configured global mode/batch would skew the labeled arms
+    env.pop('BF_SEGMENTS', None)
+    env.pop('BF_GULP_BATCH', None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '16'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'arms' in d:
+            return d
+    raise RuntimeError(
+        'config 16 produced no arms result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1000:], out.stderr[-1000:]))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default='BENCH_SEGMENT.json',
+                    help='artifact path (full config-16 result + '
+                         'verdict)')
+    ap.add_argument('--threshold', type=float, default=5.0,
+                    help='max allowed segment-arm regression vs the '
+                         'hand-fused K=16 arm, percent')
+    ap.add_argument('--timeout', type=float, default=1800.0,
+                    help='bench subprocess timeout in seconds')
+    args = ap.parse_args()
+
+    if os.environ.get('BF_SKIP_SEGMENT_GATE', '0') == '1':
+        print('segment_gate: skipped (BF_SKIP_SEGMENT_GATE=1)')
+        return 0
+
+    try:
+        res = run_config16(timeout=args.timeout)
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        print('segment_gate: bench arm failed: %s' % exc,
+              file=sys.stderr)
+        return 2
+
+    t_fused = float(res['arms']['fused']['ms_min'])
+    t_seg = float(res['arms']['segment']['ms_min'])
+    t_un = float(res['arms']['unfused']['ms_min'])
+    paired = float(res.get('paired_vs_fused',
+                           t_seg / t_fused if t_fused > 0 else 1.0))
+    regression_pct = (paired - 1.0) * 100.0
+    throughput_ok = regression_pct < args.threshold
+    zero_disp = bool(res.get('zero_interior_dispatches'))
+    elided = bool(res.get('elided'))
+    outputs_ok = bool(res.get('outputs_identical'))
+    ok = throughput_ok and zero_disp and elided and outputs_ok
+    artifact = dict(res,
+                    gate={'paired_vs_fused': round(paired, 4),
+                          'regression_vs_fused_pct':
+                          round(regression_pct, 2),
+                          'threshold_pct': args.threshold,
+                          'throughput_ok': throughput_ok,
+                          'zero_interior_dispatches': zero_disp,
+                          'elided': elided,
+                          'outputs_identical': outputs_ok,
+                          'pass': ok,
+                          'round': os.environ.get('BF_BENCH_ROUND',
+                                                  '')})
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write('\n')
+    seg = res['arms']['segment']
+    print('segment_gate: unfused %.1fms / segment %.1fms / fused '
+          '%.1fms min-of-N; paired median vs fused %+.2f%% '
+          '(threshold %.1f%%), member dispatches %d, dispatches/gulp '
+          '%.4f, elided rings %d, outputs_identical=%s %s'
+          % (t_un, t_seg, t_fused, regression_pct, args.threshold,
+             seg['member_dispatches'], seg['dispatches_per_gulp'],
+             seg['segment_elided_rings'], outputs_ok,
+             'PASS' if ok else 'FAIL'))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

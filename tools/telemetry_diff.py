@@ -22,6 +22,9 @@ DIRECTION is bad:
     violations / dropped /
       crc_errors / reconnects
       / fallback              higher     any increase
+    segment.elided_rings /
+      segment.dispatches      lower      any decrease (fusion
+                                         silently disengaged)
     overhead_pct              higher     2 points (absolute)
 
 Unmatched numeric keys are compared informationally (reported at
@@ -58,6 +61,12 @@ WATCHLIST = [
     ('*wait*', 'higher', 'pct', 25.0),
     ('*violations*', 'higher', 'any', 0.0),
     ('*dropped*', 'higher', 'any', 0.0),
+    # compiled pipeline segments (docs/perf.md): fewer elided rings or
+    # less dispatch traffic through segments between same-config
+    # rounds means fusion silently disengaged — a perf regression even
+    # when wall-clock noise hides it
+    ('*segment.elided_rings*', 'lower', 'any', 0.0),
+    ('*segment.dispatches*', 'lower', 'any', 0.0),
     ('*crc_errors*', 'higher', 'any', 0.0),
     ('*reconnects*', 'higher', 'any', 0.0),
     ('*fallback*', 'higher', 'any', 0.0),

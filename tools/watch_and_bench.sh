@@ -22,6 +22,13 @@
 #   BENCH_BATCH_${ROUND}.json - macro-gulp batch gate (config 9 on CPU:
 #                               K=16 >= K=1 min-of-N, alternating arm
 #                               order; tools/batch_gate.py)
+#   BENCH_SEGMENT_${ROUND}.json - compiled-segment gate (config 16 on
+#                               CPU: BF_SEGMENTS=auto fuses the unfused
+#                               device chain into one program, byte-
+#                               identical, zero member dispatches, both
+#                               interior rings elided, no regression vs
+#                               the hand-fused K=16 arm;
+#                               tools/segment_gate.py)
 #   BENCH_BEAM_${ROUND}.json  - quantized beamformer gate (config 13 on
 #                               CPU: quantized winner beats the f32
 #                               baseline arm, within accuracy class,
@@ -159,6 +166,22 @@ for i in $(seq 1 400); do
         if [ "$grc" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) macro-gulp batch gate FAILED" >> "$LOG"
           exit "$grc"
+        fi
+      fi
+      # Compiled-segment gate: config 16 on the CPU backend — the
+      # segment compiler must fuse the unfused device chain into ONE
+      # program (byte-identical outputs, zero member-block dispatches,
+      # both interior rings elided) and must not regress vs the
+      # hand-fused macro K=16 arm.  A failure exits nonzero (the
+      # capture artifacts above are already in place).
+      if [ "${BF_SKIP_SEGMENT_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) compiled-segment gate (config 16, CPU)" >> "$LOG"
+        python tools/segment_gate.py --out "BENCH_SEGMENT_${ROUND}.json" >> "$LOG" 2>&1
+        sgc=$?
+        echo "$(date -u +%FT%TZ) segment gate rc=$sgc" >> "$LOG"
+        if [ "$sgc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) compiled-segment gate FAILED" >> "$LOG"
+          exit "$sgc"
         fi
       fi
       # Auto-tune convergence gate: config 14 on the CPU backend — the
