@@ -2550,6 +2550,502 @@ def bench_chaos_soak(tick_ms=5.0, ngulp=700, nsrc=3, fault_after=450,
     }
 
 
+# ---------------------------------------------------------------------------
+# config 17: multi-host fabric chaos — a loopback fabric (2 capture ->
+# 1 reduce fan-in, reduce -> 1 fan-out leg) survives a SIGKILL'd
+# capture host: survivors shed counted and recover, the relaunched
+# host rejoins and replays only unacked frames, and produced ==
+# delivered + shed holds byte-exact across all surviving ledgers
+# (docs/fabric.md; gated by tools/fabric_gate.py into
+# FABRIC_CHAOS_${ROUND}.json)
+# ---------------------------------------------------------------------------
+
+_FABRIC_CAP_SCRIPT = r'''
+import json, os, sys, time
+(root, spec_path, host, origin_id, nseq, gulp_per_seq,
+ tick_ms) = (sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]),
+             int(sys.argv[5]), int(sys.argv[6]), float(sys.argv[7]))
+sys.path.insert(0, root)
+sys.path.insert(0, os.path.join(root, 'tests'))
+import numpy as np
+import bifrost_tpu as bf
+from bifrost_tpu import fabric
+from bifrost_tpu.pipeline import SourceBlock
+from bifrost_tpu.telemetry import counters
+from util import _NumpyReader, simple_header
+
+NT, NC = 4, 16
+tick_s = tick_ms * 1e-3
+seq_frames = gulp_per_seq * NT
+spec = fabric.FabricSpec.load(spec_path)
+
+class PacedCapture(SourceBlock):
+    """Deterministic indexed stream: frame f of sequence i carries
+    (origin_id, i*seq_frames + f) in channels 0/1 — the byte-exact
+    audit reads these back at the far end.  A relaunch resumes each
+    sequence from the receiver-committed frontier (resume map), so
+    only unacked frames are replayed."""
+    produced = 0
+    def __init__(self, names, resume):
+        SourceBlock.__init__(self, list(names), NT)
+        self._resume = dict(resume)
+    def create_reader(self, name):
+        i = int(name.rsplit('s', 1)[1])
+        start = (self._resume.get(name, 0) // NT) * NT
+        gulps = []
+        for g0 in range(start, seq_frames, NT):
+            arr = np.zeros((NT, NC), np.float32)
+            arr[:, 0] = origin_id
+            arr[:, 1] = i * seq_frames + g0 + np.arange(NT)
+            gulps.append(arr)
+        return _NumpyReader(gulps)
+    def on_sequence(self, reader, name):
+        hdr = simple_header([-1, NC], 'f32', name=name,
+                            gulp_nframe=NT)
+        hdr['tsamp'] = tick_s / NT
+        return [hdr]
+    def on_data(self, reader, ospans):
+        time.sleep(tick_s)
+        arr = reader.read(NT)
+        if arr is None:
+            return [0]
+        ospans[0].data.as_numpy()[:NT] = arr
+        PacedCapture.produced += NT
+        return [NT]
+
+def build(ctx):
+    resume = ctx.resume_map('capture')
+    names = ['%s.s%02d' % (host, i) for i in range(nseq)]
+    names = [n for n in names if resume.get(n, 0) < seq_frames]
+    ctx.sink('capture', PacedCapture(names, resume))
+
+fh = fabric.FabricHost(spec, host, build)
+fh.build()
+print('START %.3f' % time.monotonic(), flush=True)
+fh.run(install_signals=True)
+snap = counters.snapshot()
+print('RESULT ' + json.dumps({
+    'produced_frames': PacedCapture.produced,
+    'rejoining': int(fh.rejoining),
+    'resume_skipped_frames':
+        snap.get('fabric.resume.skipped_frames', 0),
+    'reconnects': snap.get('bridge.tx.reconnects', 0),
+}), flush=True)
+'''
+
+_FABRIC_REDUCE_SCRIPT = r'''
+import json, os, sys, threading, time
+root, spec_path = sys.argv[1], sys.argv[2]
+sys.path.insert(0, root)
+sys.path.insert(0, os.path.join(root, 'tests'))
+import bifrost_tpu as bf
+from bifrost_tpu import fabric
+from bifrost_tpu.telemetry import counters
+
+spec = fabric.FabricSpec.load(spec_path)
+
+def build(ctx):
+    ctx.sink('spectra', ctx.source('capture'))
+
+fh = fabric.FabricHost(spec, 'reduce', build)
+fh.build()
+print('READY', flush=True)
+states, alive_series, stop = [], [], threading.Event()
+def sample():
+    while not stop.wait(0.15):
+        try:
+            states.append(fh.pipeline.health()['state'])
+            peers = fh.membership.peers_snapshot()
+            alive_series.append(bool(peers['cap1']['alive']))
+        except Exception:
+            pass
+t = threading.Thread(target=sample, daemon=True); t.start()
+try:
+    fh.run(install_signals=True)
+finally:
+    stop.set(); t.join(timeout=2)
+    health = fh.pipeline.health()
+    states.append(health['state'])
+snap = counters.snapshot()
+shed_bytes = sum(v for k, v in snap.items()
+                 if k.startswith('ring.') and k.endswith('.shed_bytes'))
+shed_gulps = sum(v for k, v in snap.items()
+                 if k.startswith('ring.') and k.endswith('.shed_gulps'))
+# alive -> dead -> alive transitions of the killed host
+trans = []
+for a in alive_series:
+    if not trans or trans[-1] != a:
+        trans.append(a)
+print('RESULT ' + json.dumps({
+    'states': sorted(set(states)),
+    'final_state': states[-1] if states else None,
+    'ring_shed_bytes': shed_bytes,
+    'ring_shed_gulps': shed_gulps,
+    'bridge_shed_bytes': snap.get('bridge.tx.shed_bytes', 0),
+    'gapped': snap.get('fabric.fanin.gapped', 0),
+    'sessions_adopted': snap.get('bridge.rx.sessions_adopted', 0),
+    'peers_dead': snap.get('fabric.peers.dead', 0),
+    'peers_rejoined': snap.get('fabric.peers.rejoined', 0),
+    'fanin_sequences': snap.get('fabric.fanin.sequences', 0),
+    'cap1_alive_transitions': trans,
+    'health_transitions': [
+        {'from': tr['from'], 'to': tr['to'],
+         'reason': tr['reason']}
+        for tr in health.get('transitions', [])],
+}), flush=True)
+'''
+
+_FABRIC_LEG_SCRIPT = r'''
+import json, os, sys
+root, spec_path = sys.argv[1], sys.argv[2]
+sys.path.insert(0, root)
+sys.path.insert(0, os.path.join(root, 'tests'))
+import numpy as np
+import bifrost_tpu as bf
+from bifrost_tpu import fabric
+from bifrost_tpu.telemetry import histograms
+from util import GatherSink
+
+spec = fabric.FabricSpec.load(spec_path)
+sink = {}
+
+def build(ctx):
+    sink['s'] = GatherSink(ctx.source('spectra'))
+
+fh = fabric.FabricHost(spec, 'leg0', build)
+fh.build()
+print('READY', flush=True)
+fh.run(install_signals=True)
+s = sink['s']
+frames = np.concatenate(s.gulps, axis=0) if s.gulps \
+    else np.zeros((0, 16), np.float32)
+per_origin = {}
+for o in (0, 1):
+    idx = frames[frames[:, 0] == o][:, 1].astype(np.int64)
+    per_origin[str(o)] = {
+        'frames': int(idx.shape[0]),
+        'unique': int(np.unique(idx).shape[0]),
+        'ordered': bool(np.all(np.diff(idx) > 0))
+        if idx.shape[0] > 1 else True,
+    }
+gap_stamped = any(
+    isinstance(h.get('_overload'), dict)
+    and h['_overload'].get('fabric_gapped')
+    for h in s.headers)
+resumed = any((h.get('_fabric') or {}).get('resumed')
+              for h in s.headers)
+h_age = histograms.get('slo.fabric_exit_age_s')
+print('RESULT ' + json.dumps({
+    'delivered_frames': int(frames.shape[0]),
+    'delivered_bytes': int(frames.shape[0] * 16 * 4),
+    'per_origin': per_origin,
+    'gap_stamped': bool(gap_stamped),
+    'resumed_tagged': bool(resumed),
+    'fabric_age_count': 0 if h_age is None else int(h_age.count),
+    'origins_tagged': sorted(set(
+        (h.get('_fabric') or {}).get('origin') or '?'
+        for h in s.headers)),
+}), flush=True)
+'''
+
+
+def _fabric_free_ports(n, exclude=()):
+    """n distinct free TCP/UDP-usable ports, reserved briefly."""
+    import socket as socket_mod
+    socks, ports = [], []
+    while len(ports) < n:
+        s = socket_mod.socket()
+        s.setsockopt(socket_mod.SOL_SOCKET,
+                     socket_mod.SO_REUSEADDR, 1)
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+        if port in exclude:
+            s.close()
+            continue
+        socks.append(s)
+        ports.append(port)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _fabric_port_block(n, tries=64):
+    """Base of ``n`` CONSECUTIVE free ports: fan endpoints derive
+    ``port + i``, so the whole derived range must be probed — a base
+    whose +1 happens to be taken collides two listeners."""
+    import socket as socket_mod
+    for _ in range(tries):
+        socks = []
+        try:
+            s0 = socket_mod.socket()
+            s0.setsockopt(socket_mod.SOL_SOCKET,
+                          socket_mod.SO_REUSEADDR, 1)
+            s0.bind(('127.0.0.1', 0))
+            base = s0.getsockname()[1]
+            socks.append(s0)
+            ok = True
+            for i in range(1, n):
+                s = socket_mod.socket()
+                s.setsockopt(socket_mod.SOL_SOCKET,
+                             socket_mod.SO_REUSEADDR, 1)
+                try:
+                    s.bind(('127.0.0.1', base + i))
+                except OSError:
+                    s.close()
+                    ok = False
+                    break
+                socks.append(s)
+            if ok:
+                return base
+        finally:
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+    raise RuntimeError('no block of %d consecutive free ports' % n)
+
+
+def _fabric_read_start(proc, timeout):
+    import select as select_mod
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ready, _, _ = select_mod.select([proc.stdout], [], [], 0.25)
+        if not ready:
+            if proc.poll() is not None:
+                raise RuntimeError('fabric process exited rc=%s before '
+                                   'reporting readiness'
+                                   % proc.returncode)
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError('fabric process closed stdout early')
+        if line.startswith(('READY', 'START')):
+            return line.strip()
+    raise RuntimeError('fabric process never reported readiness')
+
+
+def _fabric_collect(proc, timeout, name):
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except Exception:
+        proc.kill()
+        out, err = proc.communicate()
+        raise RuntimeError('fabric %s did not exit in time' % name)
+    if proc.returncode:
+        raise RuntimeError('fabric %s rc=%d:\n%s'
+                           % (name, proc.returncode, (err or '')[-1500:]))
+    for line in (out or '').splitlines():
+        if line.startswith('RESULT '):
+            return json.loads(line[len('RESULT '):])
+    raise RuntimeError('fabric %s produced no RESULT:\n%s\n%s'
+                       % (name, (out or '')[-800:], (err or '')[-800:]))
+
+
+def bench_fabric_chaos(nseq=24, gulp_per_seq=10, tick_ms=15.0,
+                       pause_at=1.2, pause_secs=0.8, kill_at=2.4,
+                       down_secs=1.4, timeout=240):
+    """Multi-host fabric chaos drill (docs/fabric.md): a loopback
+    fabric of 4 launcher processes — cap0/cap1 (paced deterministic
+    captures) fan-in over the ``capture`` link to ``reduce``, which
+    fans out over the ``spectra`` link through a chaos TCP proxy to
+    ``leg0`` — driven through:
+
+    1. a ``pause_secs`` proxy stall (the fan-out leg's credit stalls,
+       the leg ring sheds counted drop_oldest, reduce health reaches
+       SHEDDING);
+    2. a SIGKILL of the cap1 HOST at ``kill_at`` (reduce's membership
+       marks it dead, the fan-in marks its origin GAPPED via the
+       ``_overload`` stamp instead of stalling);
+    3. a relaunch after ``down_secs`` (jittered rejoin: resume probe,
+       session adoption, replay of ONLY unacked frames);
+    4. a calm tail to a clean whole-fabric drain.
+
+    Invariants: no deadlock; exactly-once per-origin delivery (no
+    dups, ordered); produced == delivered + shed BYTE-EXACT across
+    the surviving ledgers; shedding engaged and health traversed
+    SHEDDING -> OK; membership saw cap1 alive -> dead -> alive; the
+    rejoined host replayed only unacked frames; the gap is stamped
+    downstream; and the cross-host fabric SLO histogram measured at
+    the leg."""
+    import signal as signal_mod
+    import subprocess
+    import tempfile
+    root = os.path.dirname(os.path.abspath(__file__))
+    NT, NC = 4, 16
+    frame_nbyte = NC * 4
+    expected_frames = 2 * nseq * gulp_per_seq * NT
+
+    tmpdir = tempfile.mkdtemp(prefix='bf_fabric_')
+    cap_base = _fabric_port_block(2)     # 2-origin fan-in: port, +1
+    ports = _fabric_free_ports(5, exclude=(cap_base, cap_base + 1))
+    leg_port = ports[0]
+    ctrl = ports[1:5]
+    proxy = _ChaosProxy(leg_port)
+    spec = {
+        'name': 'chaos17',
+        'hosts': {
+            'cap0': {'address': '127.0.0.1', 'control_port': ctrl[0],
+                     'role': 'capture'},
+            'cap1': {'address': '127.0.0.1', 'control_port': ctrl[1],
+                     'role': 'capture'},
+            'reduce': {'address': '127.0.0.1',
+                       'control_port': ctrl[2], 'role': 'reduce'},
+            'leg0': {'address': '127.0.0.1', 'control_port': ctrl[3],
+                     'role': 'leg'},
+        },
+        'links': {
+            'capture': {'kind': 'fanin', 'src': ['cap0', 'cap1'],
+                        'dst': 'reduce', 'port': cap_base,
+                        'window': 2,
+                        'gulp_nbyte': NT * frame_nbyte},
+            'spectra': {'kind': 'fanout', 'src': 'reduce',
+                        'dst': ['leg0'], 'port': leg_port,
+                        'window': 2, 'buffer_spans': 8,
+                        'gulp_nbyte': NT * frame_nbyte,
+                        'connect': {'leg0': ['127.0.0.1',
+                                             proxy.port]}},
+        },
+    }
+    spec_path = os.path.join(tmpdir, 'spec.json')
+    with open(spec_path, 'w') as f:
+        json.dump(spec, f)
+
+    env = dict(os.environ, JAX_PLATFORMS='cpu', BF_TRACE_CONTEXT='1',
+               BF_FABRIC_STATE=os.path.join(tmpdir, 'state'),
+               BF_FABRIC_HEARTBEAT_SECS='0.1',
+               BF_FABRIC_DEADLINE_SECS='0.6',
+               BF_FABRIC_GAP_SECS='0.4',
+               BF_FABRIC_REJOIN_CAP='0.3',
+               BF_SLO_MS='30000')
+    for var in ('BF_OVERLOAD_POLICY', 'BF_FAULTS', 'BF_BRIDGE_WINDOW',
+                'BF_BRIDGE_STREAMS', 'BF_METRICS_FILE',
+                'BF_FABRIC_IDENTITY'):
+        env.pop(var, None)
+
+    def spawn(script, args, name):
+        return subprocess.Popen(
+            [sys.executable, '-c', script, root, spec_path] + args,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+
+    def spawn_cap(host, origin_id):
+        return spawn(_FABRIC_CAP_SCRIPT,
+                     [host, str(origin_id), str(nseq),
+                      str(gulp_per_seq), str(tick_ms)], host)
+
+    procs = {}
+    schedule = []
+    cap1_run2 = None
+    try:
+        procs['leg0'] = spawn(_FABRIC_LEG_SCRIPT, [], 'leg0')
+        _fabric_read_start(procs['leg0'], timeout)
+        procs['reduce'] = spawn(_FABRIC_REDUCE_SCRIPT, [], 'reduce')
+        _fabric_read_start(procs['reduce'], timeout)
+        procs['cap0'] = spawn_cap('cap0', 0)
+        procs['cap1'] = spawn_cap('cap1', 1)
+        _fabric_read_start(procs['cap0'], timeout)
+        _fabric_read_start(procs['cap1'], timeout)
+        t0 = time.monotonic()
+
+        def at(when):
+            time.sleep(max(when - (time.monotonic() - t0), 0))
+
+        at(pause_at)
+        schedule.append(('pause', round(time.monotonic() - t0, 2)))
+        proxy.pause(pause_secs)
+        at(kill_at)
+        schedule.append(('kill cap1',
+                         round(time.monotonic() - t0, 2)))
+        procs['cap1'].send_signal(signal_mod.SIGKILL)
+        procs['cap1'].wait(timeout=10)
+        at(kill_at + down_secs)
+        schedule.append(('relaunch cap1',
+                         round(time.monotonic() - t0, 2)))
+        cap1_run2 = spawn_cap('cap1', 1)
+        _fabric_read_start(cap1_run2, timeout)
+
+        cap0_res = _fabric_collect(procs['cap0'], timeout, 'cap0')
+        cap1_res = _fabric_collect(cap1_run2, timeout, 'cap1-rejoin')
+        reduce_res = _fabric_collect(procs['reduce'], timeout,
+                                     'reduce')
+        leg_res = _fabric_collect(procs['leg0'], timeout, 'leg0')
+    finally:
+        proxy.close()
+        for p in list(procs.values()) + ([cap1_run2]
+                                         if cap1_run2 else []):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+    delivered = leg_res['delivered_frames']
+    shed_bytes = (reduce_res['ring_shed_bytes']
+                  + reduce_res['bridge_shed_bytes'])
+    shed_frames = shed_bytes // frame_nbyte
+    per = leg_res['per_origin']
+    trans = reduce_res['cap1_alive_transitions']
+    # membership must have seen cap1 alive, then dead, then alive
+    saw_death = any(trans[i] and not trans[i + 1]
+                    and any(trans[i + 2:])
+                    for i in range(max(len(trans) - 2, 0)))
+    # health must have RECOVERED after shedding: some transition
+    # enters SHEDDING, and a LATER one reaches OK (the final sampled
+    # state may legitimately be a lower-severity residue of the
+    # teardown drain; FAILED/STALLED always fail)
+    health_trans = reduce_res.get('health_transitions', [])
+    shed_idx = [i for i, t in enumerate(health_trans)
+                if t['to'] == 'SHEDDING']
+    recovered = bool(shed_idx) and any(
+        t['to'] == 'OK' for t in health_trans[shed_idx[0] + 1:])
+    invariants = {
+        'no_deadlock': True,          # every arm exited inside timeout
+        'no_silent_loss': bool(
+            expected_frames == delivered + shed_frames
+            and shed_bytes % frame_nbyte == 0),
+        'exactly_once': bool(all(
+            per[o]['frames'] == per[o]['unique'] and per[o]['ordered']
+            for o in per)),
+        'shedding_engaged': bool(shed_bytes > 0),
+        'health_traversal': bool(
+            'SHEDDING' in reduce_res['states'] and recovered
+            and reduce_res['final_state'] not in ('FAILED',
+                                                  'STALLED')),
+        'host_death_observed': bool(
+            reduce_res['peers_dead'] >= 1
+            and reduce_res['peers_rejoined'] >= 1 and saw_death),
+        'rejoin_replayed_only_unacked': bool(
+            cap1_res['rejoining'] == 1
+            and cap1_res['resume_skipped_frames'] > 0
+            and reduce_res['sessions_adopted'] >= 1),
+        'origin_gapped_not_stalled': bool(
+            reduce_res['gapped'] >= 1 and leg_res['gap_stamped']),
+        'fabric_slo_measured': bool(leg_res['fabric_age_count'] > 0),
+    }
+    produced_bytes = expected_frames * frame_nbyte
+    return {
+        'config': 'fabric chaos: 2 capture -> fan-in -> reduce -> '
+                  'fan-out leg through a chaos proxy; pause %.1fs@'
+                  '%.1fs, SIGKILL cap1@%.1fs, rejoin after %.1fs'
+                  % (pause_secs, pause_at, kill_at, down_secs),
+        'value': round(shed_frames / max(expected_frames, 1) * 100.0,
+                       2),
+        'unit': '% of produced frames shed (all counted; ledger '
+                'byte-exact)',
+        'invariants': invariants,
+        'ledger': {
+            'produced_bytes': produced_bytes,
+            'delivered_bytes': leg_res['delivered_bytes'],
+            'shed_bytes': shed_bytes,
+            'unaccounted_bytes': (produced_bytes
+                                  - leg_res['delivered_bytes']
+                                  - shed_bytes),
+        },
+        'schedule': schedule,
+        'cap0': cap0_res, 'cap1_rejoin': cap1_res,
+        'reduce': reduce_res, 'leg0': leg_res,
+        'pass': all(invariants.values()),
+    }
+
+
 ALL = {
     1: bench_sigproc_cpu,
     2: bench_spectroscopy,
@@ -2567,13 +3063,14 @@ ALL = {
     14: bench_autotune,
     15: bench_chaos_soak,
     16: bench_segments,
+    17: bench_fabric_chaos,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-16; 0 = all')
+                    help='config number 1-17; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -2832,6 +3329,66 @@ def _verify_config16():
     return p
 
 
+def _verify_config17():
+    """The fabric chaos topology (bench_fabric_chaos) as build-only
+    pipelines: all four hosts' sub-pipelines materialized from ONE
+    FabricSpec on loopback — the verifier must prove every host's
+    graph clean (the fan-out leg rings run drop_oldest with a
+    shed-tolerant BridgeSink reader, so no BF-E180), and the spec
+    itself passes ``verify_fabric`` (no BF-E2xx) first."""
+    import sys as _sys
+    import os as _os
+    _tests = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests')
+    if _tests not in _sys.path:
+        _sys.path.insert(0, _tests)
+    from bifrost_tpu import fabric
+    from bifrost_tpu.analysis.verify import verify_fabric
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    NT, NC = 4, 16
+    cap_base = _fabric_port_block(2)     # 2-origin fan-in: port, +1
+    ports = [cap_base] + _fabric_free_ports(
+        2, exclude=(cap_base, cap_base + 1))
+    spec = fabric.FabricSpec('verify17', hosts={
+        'cap0': {'address': '127.0.0.1', 'role': 'capture'},
+        'cap1': {'address': '127.0.0.1', 'role': 'capture'},
+        'reduce': {'address': '127.0.0.1', 'role': 'reduce'},
+        'leg0': {'address': '127.0.0.1', 'role': 'leg'},
+    }, links={
+        'capture': {'kind': 'fanin', 'src': ['cap0', 'cap1'],
+                    'dst': 'reduce', 'port': ports[0], 'window': 2,
+                    'gulp_nbyte': NT * NC * 4},
+        'spectra': {'kind': 'fanout', 'src': 'reduce',
+                    'dst': ['leg0'], 'port': ports[2], 'window': 2,
+                    'buffer_spans': 8, 'gulp_nbyte': NT * NC * 4},
+    })
+    spec_errs = [d for d in verify_fabric(spec) if d.is_error]
+    if spec_errs:
+        raise RuntimeError('fabric spec failed verify_fabric: %s'
+                           % spec_errs)
+    raw = np.zeros((NT, NC), np.float32)
+    hdr = simple_header([-1, NC], 'f32', gulp_nframe=NT)
+
+    def build_cap(ctx):
+        ctx.sink('capture',
+                 NumpySourceBlock([raw.copy()], hdr, NT))
+
+    def build_reduce(ctx):
+        ctx.sink('spectra', ctx.source('capture'))
+
+    def build_leg(ctx):
+        GatherSink(ctx.source('spectra'))
+
+    pipelines = []
+    for host, builder in (('leg0', build_leg),
+                          ('reduce', build_reduce),
+                          ('cap0', build_cap), ('cap1', build_cap)):
+        fh = fabric.FabricHost(spec, host, builder, jitter=False)
+        pipelines.append(fh.build())
+    return pipelines
+
+
 def build_verify_topologies():
     """{name: builder} over every pipeline-shaped bench config.  Each
     builder returns a Pipeline, a list of Pipelines, or None when the
@@ -2847,6 +3404,7 @@ def build_verify_topologies():
         'config14_tune': _verify_config14,
         'config15_chaos': _verify_config15,
         'config16_segments': _verify_config16,
+        'config17_fabric': _verify_config17,
     }
 
 

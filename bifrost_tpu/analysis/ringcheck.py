@@ -386,7 +386,7 @@ class _Shadow(object):
             if rd.guarantee:
                 rd.pin = min(rd.opens)
 
-    def release(self, rseq, begin):
+    def release(self, rseq, begin, nbyte=0):
         with self.lock:
             self._check_deferred()
             rd = self.readers.get(id(rseq))
@@ -398,8 +398,12 @@ class _Shadow(object):
                     'release of a foreign span'
                     % (begin, rd.opens if rd is not None else None))
             rd.opens.remove(begin)
-            rd.release_high = begin if rd.release_high is None \
-                else max(rd.release_high, begin)
+            # the consumed frontier advances to the span's END (the
+            # core's release does the same): a released span's bytes
+            # were read, so the pin may move past them
+            rel = begin + max(int(nbyte or 0), 0)
+            rd.release_high = rel if rd.release_high is None \
+                else max(rd.release_high, rel)
             if rd.guarantee and rd.pin is not None:
                 rd.pin = min(rd.opens) if rd.opens \
                     else max(rd.pin, rd.release_high)

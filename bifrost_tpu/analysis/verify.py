@@ -37,9 +37,10 @@ import threading
 from copy import deepcopy
 
 __all__ = ['Diagnostic', 'PipelineValidationError', 'CODES',
-           'verify_pipeline', 'errors', 'warnings_', 'format_report',
-           'gate_run', 'lint_intercept', 'validate_mode',
-           'ring_capacity_floors', 'new_errors_vs', 'scope_overrides']
+           'verify_pipeline', 'verify_fabric', 'errors', 'warnings_',
+           'format_report', 'gate_run', 'lint_intercept',
+           'validate_mode', 'ring_capacity_floors', 'new_errors_vs',
+           'scope_overrides']
 
 #: stable diagnostic-code catalog: code -> one-line title.
 #: BF-Exxx = error (strict mode refuses to run), BF-Wxxx = warning,
@@ -69,6 +70,10 @@ CODES = {
     'BF-I171': 'gulp geometry unknown; ring sizing not proven',
     'BF-I190': 'device-ring boundary did not fuse into a compiled '
                'segment',
+    'BF-E200': 'fabric link endpoint mismatch',
+    'BF-E201': 'fabric port collision',
+    'BF-W202': 'fabric link window/stripe sizing hazard',
+    'BF-W203': 'fabric link quota smaller than one (macro-)span',
     'BF-I199': 'verifier check failed internally (diagnostic only)',
 }
 
@@ -1060,6 +1065,134 @@ def verify_pipeline(pipeline):
             diags.append(Diagnostic(
                 'BF-I199', 'check %s failed: %s: %s'
                 % (check.__name__, type(exc).__name__, exc)))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# fabric-spec verification (bifrost_tpu.fabric; docs/fabric.md)
+# ---------------------------------------------------------------------------
+
+def verify_fabric(spec):
+    """Statically check a whole multi-host fabric spec
+    (:class:`bifrost_tpu.fabric.FabricSpec` or its dict form) BEFORE
+    any host launches — the fabric-level sibling of
+    :func:`verify_pipeline`:
+
+    - **BF-E200** endpoint mismatch: a link names a host the spec
+      does not define, a fan with no members, or a link whose only
+      endpoint is itself;
+    - **BF-E201** port collision: two listening endpoints (bridge
+      data ports, including fan offsets, or membership control ports)
+      bound to the same address:port;
+    - **BF-W202** window/stripe sizing: a declared leg buffer smaller
+      than the credit window needs (``buffer_spans < window + 2`` —
+      the same ``window + 2`` rule BF-W110 enforces at ring level),
+      or a nonsensical stripe count;
+    - **BF-W203** quota vs macro-span: a per-stream quota smaller
+      than one span at the link's declared gulp size, so every span
+      overflows the token bucket (the spec-level BF-W181).
+
+    Returns a list of :class:`Diagnostic` anchored on
+    ``link:<name>`` / ``host:<name>``.  Window-below-one is reported
+    as the existing **BF-E150**."""
+    from ..fabric import FabricSpec
+    if isinstance(spec, dict):
+        spec = FabricSpec.from_dict(spec)
+    diags = []
+    # -- endpoints (BF-E200) ----------------------------------------------
+    for lname, link in sorted(spec.links.items()):
+        where = 'link:%s' % lname
+        members = list(link.src) + list(link.dst)
+        for host in members:
+            if host not in spec.hosts:
+                diags.append(Diagnostic(
+                    'BF-E200',
+                    'link %r references host %r, which the fabric '
+                    'spec does not define (hosts: %s)'
+                    % (lname, host, ', '.join(sorted(spec.hosts))
+                       or 'none'), block=where))
+        if not link.src or not link.dst:
+            diags.append(Diagnostic(
+                'BF-E200', 'link %r has an empty %s side'
+                % (lname, 'src' if not link.src else 'dst'),
+                block=where))
+        if link.kind == 'fanin' and len(link.src) < 2:
+            diags.append(Diagnostic(
+                'BF-E200',
+                'fan-in link %r has %d origin(s): a fan-in needs at '
+                'least 2 (use kind "pipe" for a point-to-point link)'
+                % (lname, len(link.src)), block=where))
+        if link.kind == 'fanout' and len(link.dst) < 1:
+            diags.append(Diagnostic(
+                'BF-E200', 'fan-out link %r has no legs' % lname,
+                block=where))
+        if set(link.src) == set(link.dst) and len(members) == 2:
+            diags.append(Diagnostic(
+                'BF-E200',
+                'link %r connects host %r to itself — a same-host '
+                'hop needs no bridge (use a ring)'
+                % (lname, link.src[0]), block=where))
+    # -- port collisions (BF-E201) ----------------------------------------
+    # keyed by ADDRESS, not host name: two spec hosts sharing one
+    # address (a single-machine loopback fabric — bf_fabric up) must
+    # collide on equal ports, or the lint passes what bind() rejects
+    bound = {}
+    for hname, host in sorted(spec.hosts.items()):
+        if host.control_port:
+            key = (host.address, host.control_port)
+            bound[key] = 'host:%s control port' % hname
+    for lname, link in sorted(spec.links.items()):
+        for rhost, off in link.receivers():
+            if rhost not in spec.hosts:
+                continue
+            key = (spec.hosts[rhost].address, link.port + off)
+            owner = 'link:%s endpoint +%d' % (lname, off)
+            if key in bound:
+                diags.append(Diagnostic(
+                    'BF-E201',
+                    'port %d on host %r is claimed by both %s and %s'
+                    % (key[1], rhost, bound[key], owner),
+                    block='link:%s' % lname))
+            else:
+                bound[key] = owner
+    # -- window / stripe sizing (BF-E150 / BF-W202) -----------------------
+    for lname, link in sorted(spec.links.items()):
+        where = 'link:%s' % lname
+        if link.window is not None and link.window < 1:
+            diags.append(Diagnostic(
+                'BF-E150',
+                'link %r configured with window=%d: the credit window '
+                'must be >= 1 span' % (lname, link.window),
+                block=where))
+        elif link.window is not None and link.buffer_spans is not None \
+                and link.buffer_spans < link.window + 2:
+            diags.append(Diagnostic(
+                'BF-W202',
+                'link %r declares buffer_spans=%d but its credit '
+                'window needs window+2=%d spans of ring depth (the '
+                'BF-W110 sizing rule): the window will self-cap below '
+                'the configured pipelining'
+                % (lname, link.buffer_spans, link.window + 2),
+                block=where))
+        if link.streams is not None and link.streams < 1:
+            diags.append(Diagnostic(
+                'BF-W202',
+                'link %r configured with streams=%d: striping needs '
+                'at least 1 connection' % (lname, link.streams),
+                block=where))
+    # -- quota vs span (BF-W203) ------------------------------------------
+    for lname, link in sorted(spec.links.items()):
+        quota = link.quota_mbps * 1e6
+        if quota > 0 and link.gulp_nbyte:
+            if link.gulp_nbyte > quota:
+                diags.append(Diagnostic(
+                    'BF-W203',
+                    'link %r per-stream quota (%.0f B/s) is smaller '
+                    'than one declared span (%d bytes): every span '
+                    'overflows the token bucket — a drop policy sheds '
+                    'the stream to zero throughput'
+                    % (lname, quota, link.gulp_nbyte),
+                    block='link:%s' % lname))
     return diags
 
 

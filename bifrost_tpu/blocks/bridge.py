@@ -149,7 +149,7 @@ class BridgeSink(_BridgeBlock):
                  crc=None, guarantee=True, protocol=None,
                  connect_timeout=10.0, reconnect_max=None,
                  quota_bytes_per_s=None, quota_gulps_per_s=None,
-                 *args, **kwargs):
+                 prime_early=None, *args, **kwargs):
         super(BridgeSink, self).__init__([iring], *args, **kwargs)
         self.orings = []
         self.iring = self.irings[0]
@@ -175,6 +175,13 @@ class BridgeSink(_BridgeBlock):
         #: env defaults; 0 = unlimited) — docs/robustness.md
         self.quota_bytes_per_s = quota_bytes_per_s
         self.quota_gulps_per_s = quota_gulps_per_s
+        #: pin the read guarantee BEFORE the init barrier (None =
+        #: auto: only when the producing block lives in this
+        #: pipeline).  A producer that creates its output sequences
+        #: LAZILY per stripe (fabric FanOutBlock) must pass False:
+        #: priming would wait for a sequence that can only appear
+        #: after the barrier this block is holding up.
+        self.prime_early = prime_early
         #: reading a drop-policy ring through the credit window is
         #: this block's JOB (sheds are counted, stamped, and surfaced
         #: through its own ledger): declare shed tolerance so the
@@ -186,6 +193,14 @@ class BridgeSink(_BridgeBlock):
         self._breaker = _CircuitBreaker()
         self._shed_recorded = False
         self._sender = None
+        #: fabric hooks (bifrost_tpu.fabric, docs/fabric.md):
+        #: ``on_span_acked(seq_name, frame_offset, nframe, nbyte)``
+        #: feeds the durable delivered-frames ledger a whole-host
+        #: rejoin resumes from; ``on_fabric_shed(reason, ngulps,
+        #: nbyte)`` mirrors sender-side sheds into the same ledger so
+        #: the loss audit survives a SIGKILL
+        self.on_span_acked = None
+        self.on_fabric_shed = None
         self.out_proclog = ProcLog(self.name + '/out')
         self.out_proclog.update({'nring': 0})
         self._publish_bridge_role('sink',
@@ -221,6 +236,11 @@ class BridgeSink(_BridgeBlock):
         the overload shows in pipeline history, not just counters —
         later sheds of the same run only count (one record per
         overload episode, not per gulp)."""
+        if self.on_fabric_shed is not None:
+            try:
+                self.on_fabric_shed(reason, ngulps, nbyte)
+            except Exception:
+                pass
         if self._shed_recorded:
             return
         self._shed_recorded = True
@@ -255,7 +275,8 @@ class BridgeSink(_BridgeBlock):
             overload_policy=resolve_overload_policy(self),
             quota_bytes_per_s=self.quota_bytes_per_s,
             quota_gulps_per_s=self.quota_gulps_per_s,
-            on_shed=self._record_shed)
+            on_shed=self._record_shed,
+            on_span_acked=self.on_span_acked)
         self._sender = sender
         # one 'degraded' supervisor record per RUN: a restarted main
         # (new overload episode) records again
@@ -269,7 +290,11 @@ class BridgeSink(_BridgeBlock):
         # there and accept the attach-to-live-stream race instead.
         base = getattr(self.iring, '_base_ring', self.iring)
         producer = getattr(base, 'owner', None)
-        if producer is not None and producer in self.pipeline.blocks:
+        prime = self.prime_early
+        if prime is None:
+            prime = producer is not None \
+                and producer in self.pipeline.blocks
+        if prime:
             sender.prime()
         self._release_init_barrier()
         try:
@@ -331,13 +356,18 @@ class BridgeSource(_BridgeBlock):
     """
 
     def __init__(self, address, port, space='system', crc=None,
-                 reconnect_max=None, *args, **kwargs):
+                 reconnect_max=None, adopt_sessions=False,
+                 *args, **kwargs):
         super(BridgeSource, self).__init__([], *args, **kwargs)
         self.orings = [self.create_ring(space=space)]
         self.listener = BridgeListener(address, port)
         self.address = self.listener.address
         self.port = self.listener.port
         self.crc = crc
+        #: whole-host rejoin (bifrost_tpu.fabric, docs/fabric.md):
+        #: accept a NEW sender session mid-stream (the old host died)
+        #: instead of raising, and answer resume probes
+        self.adopt_sessions = bool(adopt_sessions)
         self.reconnect_max = _reconnect_budget() if reconnect_max is None \
             else int(reconnect_max)
         self.out_proclog = ProcLog(self.name + '/out')
@@ -368,7 +398,8 @@ class BridgeSource(_BridgeBlock):
                 self.listener, self.orings[0], writer=orings[0],
                 crc=self.crc, poison_on_error=False,
                 heartbeat=self.heartbeat,
-                stop_event=self.shutdown_event, name=self.name)
+                stop_event=self.shutdown_event, name=self.name,
+                adopt_sessions=self.adopt_sessions)
         else:
             self._receiver.sock = self.listener
         receiver = self._receiver

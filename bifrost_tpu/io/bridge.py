@@ -63,14 +63,14 @@ from collections import OrderedDict
 import numpy as np
 
 from ..header_standard import (serialize_header, deserialize_header,
-                               trace_context)
+                               trace_context, TRACE_CONTEXT_KEY)
 from ..ring import EndOfDataStop, RingPoisonedError
 from .udp_socket import retry_transient
 
 __all__ = ['RingSender', 'RingReceiver', 'BridgeListener',
            'BridgeProtocolError', 'listen', 'connect', 'connect_striped',
            'bridge_streams', 'bridge_window', 'bridge_crc',
-           'WIRE_VERSION']
+           'query_resume', 'WIRE_VERSION']
 
 MSG_HEADER = 1
 MSG_SPAN = 2
@@ -449,15 +449,19 @@ class _Frame(object):
     receiver's cumulative ACK covers it, so a reconnect can retransmit
     it verbatim and the ring guarantee keeps the span's bytes alive."""
 
-    __slots__ = ('seq', 'mtype', 'head', 'lanes', 'span', 'nbyte')
+    __slots__ = ('seq', 'mtype', 'head', 'lanes', 'span', 'nbyte',
+                 'ack')
 
-    def __init__(self, seq, mtype, head, lanes=None, span=None, nbyte=0):
+    def __init__(self, seq, mtype, head, lanes=None, span=None, nbyte=0,
+                 ack=None):
         self.seq = seq
         self.mtype = mtype
         self.head = head          # outer frame hdr + seqno + meta bytes
         self.lanes = lanes        # payload buffer list (or None)
         self.span = span          # held ReadSpan (MSG_SPAN only)
         self.nbyte = nbyte        # payload bytes (telemetry)
+        self.ack = ack            # (seq_name, frame_offset, nframe,
+                                  # nbyte) for the on_span_acked hook
 
     def buffers(self):
         return [self.head] + list(self.lanes or ())
@@ -490,7 +494,7 @@ class RingSender(object):
                  reconnect_max=3, shutdown_event=None, heartbeat=None,
                  drain_timeout=60.0, name=None, overload_policy=None,
                  quota_bytes_per_s=None, quota_gulps_per_s=None,
-                 on_shed=None):
+                 on_shed=None, on_span_acked=None):
         self.ring = ring
         if sock is None:
             self.socks = []
@@ -569,6 +573,20 @@ class RingSender(object):
             quota_gulps_per_s if quota_gulps_per_s is not None
             else bridge_quota_gulps())
         self.on_shed = on_shed
+        #: ack-ledger hook (bifrost_tpu.fabric.AckLedger): called as
+        #: ``on_span_acked(seq_name, frame_offset, nframe, nbyte)``
+        #: for every span the receiver's cumulative ACK releases — the
+        #: durable "delivered" journal whole-host rejoin resumes from
+        self.on_span_acked = on_span_acked
+        #: wall-clock offset to the receiving host estimated by the
+        #: handshake ping (peer_wall_ns - our_wall_ns; None until a v2
+        #: handshake completes).  Stamped into shipped trace contexts
+        #: as the cumulative ``skew_ns`` so a downstream sink can age
+        #: data against the ORIGIN host's clock (telemetry.slo fabric
+        #: end-to-end age).
+        self.wall_offset_ns = None
+        self._wall_rtt_us = None
+        self._cur_seq_name = None
         self._quota_buckets = {}     # stream id -> (bytes_tb, gulps_tb)
         self._shed_gulps = 0
         self._shed_bytes = 0
@@ -907,7 +925,11 @@ class RingSender(object):
         self._seq_gen = seqs         # closed explicitly in close()/_abort
         try:
             first = next(seqs)
-        except StopIteration:
+        except (StopIteration, EndOfDataStop):
+            # a ring that ends with ZERO sequences is a valid (empty)
+            # stream: the pump still dials and ships a clean MSG_END —
+            # a fan-out leg that never received a stripe must not turn
+            # end-of-stream into a block failure
             return iter(())
         return itertools.chain([first], seqs)
 
@@ -988,6 +1010,28 @@ class RingSender(object):
                 _send_msg(sock, MSG_END)
             self._publish_stats(force=True)
 
+    def _stamp_hop(self, hdr):
+        """Mark one bridge hop on the shipped header's trace context:
+        ``hops`` counts host boundaries crossed, and ``skew_ns``
+        accumulates the handshake-measured wall-clock offset of each
+        hop — so ``origin_ns + skew_ns`` is the ORIGIN host's capture
+        instant expressed on the RECEIVING host's wall clock, and a
+        fabric sink can report a true cross-host end-to-end age
+        (telemetry.slo ``slo.fabric_exit_age_s``).  No-op for streams
+        without a trace context."""
+        ctx = trace_context(hdr)
+        if ctx is None:
+            return
+        ctx = dict(ctx)
+        ctx['hops'] = int(ctx.get('hops', 0) or 0) + 1
+        if self.wall_offset_ns is not None:
+            try:
+                ctx['skew_ns'] = (int(ctx.get('skew_ns', 0) or 0)
+                                  + int(self.wall_offset_ns))
+            except (TypeError, ValueError):
+                ctx['skew_ns'] = int(self.wall_offset_ns)
+        hdr[TRACE_CONTEXT_KEY] = ctx
+
     # -- v2 plumbing -------------------------------------------------------
     def _handshake(self, socks, timeout=30.0):
         """HELLO/HELLO_ACK exchange, bounded: a peer that accepted
@@ -1006,14 +1050,17 @@ class RingSender(object):
         for s in socks:
             s.settimeout(timeout)
         t_sent = {}
+        t_sent_wall = {}
         try:
             for i, s in enumerate(socks):
                 hello = {'version': WIRE_VERSION,
                          'session': self.session,
                          'stream_id': i, 'nstreams': len(socks),
                          'window': self.window, 'crc': bool(self.crc),
-                         'ts_us': round(spans_mod.now_us(), 3)}
+                         'ts_us': round(spans_mod.now_us(), 3),
+                         'wall_ns': time.time_ns()}
                 t_sent[i] = spans_mod.now_us()
+                t_sent_wall[i] = time.time_ns()
                 _send_msg(s, MSG_HELLO, serialize_header(hello))
             for i, s in enumerate(socks):
                 mtype, payload = _recv_msg(s)
@@ -1028,6 +1075,21 @@ class RingSender(object):
                 except Exception:
                     ack = {}
                 peer_ts = ack.get('ts_us')
+                wall_off = None
+                peer_wall = ack.get('wall_ns')
+                if isinstance(peer_wall, int):
+                    # same ping, wall clocks: the receiver stamped its
+                    # wall clock ~mid-flight, so the offset estimate is
+                    # accurate to ~RTT/2 — good enough to age data
+                    # against the ORIGIN host's capture instant across
+                    # the fabric (telemetry.slo fabric exit age)
+                    rtt_ns = max((t_ack - t_sent[i]) * 1e3, 0.0)
+                    wall_off = peer_wall - (t_sent_wall[i]
+                                            + rtt_ns / 2.0)
+                    if self._wall_rtt_us is None or \
+                            (t_ack - t_sent[i]) < self._wall_rtt_us:
+                        self._wall_rtt_us = t_ack - t_sent[i]
+                        self.wall_offset_ns = int(wall_off)
                 if isinstance(peer_ts, (int, float)):
                     rtt = max(t_ack - t_sent[i], 0.0)
                     # peer stamped its clock ~mid-flight: offset =
@@ -1035,7 +1097,8 @@ class RingSender(object):
                     offset = peer_ts - (t_sent[i] + rtt / 2.0)
                     spans_mod.note_peer_clock(self.session, 'tx',
                                               offset_us=offset,
-                                              rtt_us=rtt)
+                                              rtt_us=rtt,
+                                              wall_offset_ns=wall_off)
                 else:
                     spans_mod.note_peer_clock(self.session, 'tx')
         except socket.timeout as exc:
@@ -1123,6 +1186,7 @@ class RingSender(object):
         source ring's guarantee: this is where backpressure credit
         returns)."""
         released = []
+        acked_info = []
         popped = 0
         with self._credit:
             while self._unacked:
@@ -1134,6 +1198,8 @@ class RingSender(object):
                 if frame.span is not None:
                     self._inflight_spans -= 1
                     released.append(frame.span)
+                    if frame.ack is not None:
+                        acked_info.append(frame.ack)
             if popped:
                 # not just span releases: _drain waits for CONTROL
                 # frames (END_SEQ/END) too, and must wake on their acks
@@ -1143,6 +1209,14 @@ class RingSender(object):
                 span.release()
             except Exception:
                 pass
+        if self.on_span_acked is not None:
+            # the delivered-frames journal (fabric AckLedger): called
+            # outside the credit lock — the hook may touch the disk
+            for info in acked_info:
+                try:
+                    self.on_span_acked(*info)
+                except Exception:
+                    pass
 
     def _check_error(self):
         with self._credit:
@@ -1243,7 +1317,8 @@ class RingSender(object):
             return
         self._observe_tx(frame.nbyte, frame.mtype == MSG_SPAN)
 
-    def _emit(self, mtype, payload=b'', span=None, lanes=None, meta=b''):
+    def _emit(self, mtype, payload=b'', span=None, lanes=None, meta=b'',
+              ack=None):
         with self._credit:
             seq_no = self._seq_no
             self._seq_no += 1
@@ -1252,7 +1327,7 @@ class RingSender(object):
         nbyte = sum(len(b) for b in lanes)
         head = (_FRAME.pack(mtype, _SEQNO.size + len(meta) + nbyte)
                 + _SEQNO.pack(seq_no) + meta)
-        frame = _Frame(seq_no, mtype, head, lanes, span, nbyte)
+        frame = _Frame(seq_no, mtype, head, lanes, span, nbyte, ack)
         with self._credit:
             self._unacked[seq_no] = frame
             if span is not None:
@@ -1266,8 +1341,12 @@ class RingSender(object):
         ngulps = max(1, -(-span.nframe // max(gulp, 1)))
         spans_mod = _spans()
         t0 = spans_mod.now_us() if spans_mod.enabled() else None
+        ack_info = None
+        if self.on_span_acked is not None:
+            ack_info = (self._cur_seq_name, span.frame_offset,
+                        span.nframe, nbyte)
         self._emit(MSG_SPAN, span=span, lanes=lanes,
-                   meta=_SPAN2.pack(ngulps, crc))
+                   meta=_SPAN2.pack(ngulps, crc), ack=ack_info)
         if t0 is not None:
             # tx span under the stream's trace identity: the same
             # (trace, seq, gulp) triple the receiving host records,
@@ -1391,6 +1470,9 @@ class RingSender(object):
                 hdr_gulp = int(hdr.get('gulp_nframe', 1) or 1)
                 self._cur_trace = _trace_id(hdr)
                 self._cur_seq += 1
+                self._cur_seq_name = hdr.get('name') or \
+                    ('seq%d' % self._cur_seq)
+                self._stamp_hop(hdr)
                 self._emit(MSG_HEADER, serialize_header(hdr))
                 # reader-side buffering: the credit window pins the
                 # tail at the oldest unacked span, so the ring needs
@@ -1510,7 +1592,8 @@ class RingReceiver(object):
 
     def __init__(self, sock, ring, writer=None, crc=None,
                  poison_on_error=True, heartbeat=None,
-                 stop_event=None, naive=False, name=None):
+                 stop_event=None, naive=False, name=None,
+                 adopt_sessions=False):
         self.sock = sock
         self.ring = ring
         self.heartbeat = heartbeat
@@ -1518,6 +1601,16 @@ class RingReceiver(object):
         self.name = name or ring.name
         self.crc_forced = crc
         self.poison_on_error = poison_on_error
+        #: whole-host rejoin choreography (bifrost_tpu.fabric,
+        #: docs/fabric.md): accept a HELLO from a NEW session instead
+        #: of raising — the dead sender host's stream is truncated
+        #: (its open output sequence ends), the frame-sequence counter
+        #: resets, and the rejoined host's fresh session continues the
+        #: stream (counted on ``bridge.rx.sessions_adopted``).  The
+        #: receiver also answers resume PROBES (``query_resume``) with
+        #: its per-sequence committed-frame counts so the rejoined
+        #: sender replays only frames this side never committed.
+        self.adopt_sessions = bool(adopt_sessions)
         #: seed-implementation receive loop (chunked recv + b''.join +
         #: frombuffer scatter — two extra copies per span); kept as
         #: the measured baseline arm of bench_suite config 10
@@ -1551,6 +1644,11 @@ class RingReceiver(object):
         self._cur_trace = None
         self._cur_seq = -1
         self._cur_gulp_nframe = 1
+        #: cumulative committed frames per sequence NAME — the resume
+        #: map a rejoin probe reads (docs/fabric.md)
+        self._frames_by_seq = {}
+        self._cur_seq_key = None
+        self._sessions_adopted = 0
 
     # -- public ------------------------------------------------------------
     def run(self):
@@ -1563,17 +1661,30 @@ class RingReceiver(object):
         if self._writer is None:
             self._writer = RingWriter(self.ring)
         try:
-            socks = self._materialize_socks()
-            first = _recv_msg(socks[0])
-            if first[0] == MSG_HELLO:
-                socks = self._handshake(socks, first[1])
-                if len(socks) == 1:
-                    self._run_v2_single(socks[0])
+            while True:
+                socks = self._materialize_socks()
+                first = _recv_msg(socks[0])
+                if first[0] == MSG_HELLO:
+                    hello = deserialize_header(first[1])
+                    if hello.get('probe'):
+                        # resume probe (query_resume): answer with the
+                        # committed-frame map and keep listening — a
+                        # probe is a side question, not the stream
+                        self._answer_probe(socks[0])
+                        if isinstance(self.sock, BridgeListener):
+                            continue
+                        raise ConnectionError(
+                            "resume probe on a dedicated bridge "
+                            "socket (no listener to re-accept from)")
+                    socks = self._handshake(socks, hello)
+                    if len(socks) == 1:
+                        self._run_v2_single(socks[0])
+                    else:
+                        self._run_v2_striped(socks)
                 else:
-                    self._run_v2_striped(socks)
-            else:
-                self._protocol = 1
-                self._run_v1(socks[0], first)
+                    self._protocol = 1
+                    self._run_v1(socks[0], first)
+                break
         except BaseException as exc:
             self._close_accepted()
             if self.poison_on_error and not self._done:
@@ -1686,6 +1797,7 @@ class RingReceiver(object):
         self._cur_trace = _trace_id(hdr)
         self._cur_seq += 1
         self._cur_gulp_nframe = max(int(gulp), 1)
+        self._cur_seq_key = hdr.get('name') or ('seq%d' % self._cur_seq)
         # receive-side buffering stays at the classic 3 gulps: the
         # credit window's overlap lives on the SENDER side (spans in
         # flight) and in the kernel socket buffers — a window-scaled
@@ -1702,6 +1814,27 @@ class RingReceiver(object):
         if self._wseq is not None:
             self._wseq.end()
             self._wseq = None
+
+    #: retained per-sequence-name resume entries: rejoins only ever
+    #: resume RECENT sequences, so ancient history is dead weight in
+    #: both receiver memory and the handshake/probe payload that
+    #: ships the whole map — bound it (insertion-ordered eviction;
+    #: re-committing an evicted name simply restarts its count, which
+    #: a frontier max-merge on the sender side tolerates)
+    _MAX_SEQ_STATE = 256
+
+    def _note_committed(self, nframe):
+        """Advance the per-sequence-name committed-frame count — the
+        resume map rejoin probes read (``query_resume``)."""
+        if self._cur_seq_key is not None:
+            # pop + reinsert = move-to-end: the LIVE sequence is never
+            # the eviction victim, however long ago it was opened
+            total = self._frames_by_seq.pop(self._cur_seq_key, 0) \
+                + nframe
+            self._frames_by_seq[self._cur_seq_key] = total
+            while len(self._frames_by_seq) > self._MAX_SEQ_STATE:
+                self._frames_by_seq.pop(
+                    next(iter(self._frames_by_seq)))
 
     def _require_seq(self, mtype):
         if self._wseq is None:
@@ -1764,6 +1897,7 @@ class RingReceiver(object):
             span.close()
             raise
         span.close()
+        self._note_committed(nframe)
         if t0 is not None:
             self._record_rx_span(t0, len(payload), ngulps,
                                  frame_offset)
@@ -1800,6 +1934,7 @@ class RingReceiver(object):
             span.close()
             raise
         span.close()
+        self._note_committed(nframe)
         if t0 is not None:
             self._record_rx_span(t0, payload_nbyte, ngulps,
                                  frame_offset)
@@ -1857,14 +1992,43 @@ class RingReceiver(object):
                     "bytes)" % (mtype, len(payload)))
 
     # -- v2 ----------------------------------------------------------------
-    def _handshake(self, socks, hello_payload):
+    def _answer_probe(self, sock):
+        """Answer one resume probe (``query_resume``): the committed
+        frame count per sequence name — what a rejoining sender host
+        needs to replay ONLY the frames this side never committed —
+        then close the probe connection."""
+        ack = serialize_header({'version': WIRE_VERSION, 'probe': True,
+                                'session': self._session,
+                                'resume': dict(self._frames_by_seq),
+                                'wall_ns': time.time_ns()})
+        try:
+            _send_msg(sock, MSG_HELLO_ACK, ack)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handshake(self, socks, hello):
         self._protocol = 2
-        hello = deserialize_header(hello_payload)
+        if isinstance(hello, (bytes, bytearray, memoryview)):
+            hello = deserialize_header(hello)
         session = hello.get('session')
         if self._session is not None and session != self._session:
-            raise BridgeProtocolError(
-                "HELLO from a different session (%r, expected %r)"
-                % (session, self._session))
+            if not self.adopt_sessions:
+                raise BridgeProtocolError(
+                    "HELLO from a different session (%r, expected %r)"
+                    % (session, self._session))
+            # whole-host rejoin (docs/fabric.md): the old sender host
+            # is dead and a NEW process is continuing the stream.  End
+            # the truncated output sequence, reset the frame-sequence
+            # protocol for the fresh session, and let the rejoined
+            # sender resume (it probed the committed-frame map first,
+            # so only unacked frames are replayed).
+            self._end_seq()
+            self._expected = 0
+            self._sessions_adopted += 1
+            _counters().inc('bridge.rx.sessions_adopted')
         self._session = session
         if session:
             # register the session in this process's trace metadata so
@@ -1896,10 +2060,15 @@ class RingReceiver(object):
         for s in socks:
             # per-sock timestamp: the clock-ping echo must be stamped
             # at SEND time, not once for the batch (the sender halves
-            # its measured RTT around this instant)
-            ack = serialize_header({'version': WIRE_VERSION,
-                                    'ts_us': round(spans_mod.now_us(),
-                                                   3)})
+            # its measured RTT around this instant).  wall_ns rides
+            # along so the sender can estimate the WALL-clock offset
+            # too (the fabric end-to-end SLO's skew correction).
+            entry = {'version': WIRE_VERSION,
+                     'ts_us': round(spans_mod.now_us(), 3),
+                     'wall_ns': time.time_ns()}
+            if self.adopt_sessions:
+                entry['resume'] = dict(self._frames_by_seq)
+            ack = serialize_header(entry)
             _send_msg(s, MSG_HELLO_ACK, ack)
         return socks
 
@@ -2075,3 +2244,37 @@ class RingReceiver(object):
                     pass
             for t in threads:
                 t.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# rejoin resume probe (bifrost_tpu.fabric; docs/fabric.md)
+# ---------------------------------------------------------------------------
+
+def query_resume(address, port, timeout=5.0):
+    """Ask a listening bridge receiver how many frames per sequence
+    name it has COMMITTED — the rejoin handshake of the whole-host
+    failure choreography: a relaunched sender host replays only from
+    this frontier, so the rejoined stream is lossless without
+    duplicating frames the receiver already has.  Returns
+    ``{seq_name: committed_frames}`` (empty for a fresh receiver).
+    Raises ``ConnectionError``/``BridgeProtocolError`` when the
+    receiver is unreachable or not a v2 endpoint."""
+    sock = connect(address, port, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        hello = {'version': WIRE_VERSION, 'probe': True,
+                 'session': 'probe-%s' % uuid.uuid4().hex[:8]}
+        _send_msg(sock, MSG_HELLO, serialize_header(hello))
+        mtype, payload = _recv_msg(sock)
+        if mtype != MSG_HELLO_ACK:
+            raise BridgeProtocolError(
+                "resume probe expected HELLO_ACK, got type %d" % mtype)
+        ack = deserialize_header(payload)
+        resume = ack.get('resume') or {}
+        return {str(k): int(v) for k, v in resume.items()
+                if isinstance(v, (int, float))}
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass

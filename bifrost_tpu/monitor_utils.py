@@ -13,15 +13,28 @@ __all__ = ['list_pipelines', 'get_command_line', 'get_best_size',
 
 
 def list_pipelines():
-    """PIDs with a ProcLog tree, sorted."""
+    """Proclog instance entries with a ProcLog tree, sorted by PID.
+    Entries are bare PIDs (int) or fabric-identity strings
+    (``<pid>@<host>.<role>`` — see bifrost_tpu.proclog); both forms
+    feed straight into ``proclog.load_by_pid``."""
     base = proclog.proclog_dir()
     if not os.path.isdir(base):
         return []
-    return sorted(int(p) for p in os.listdir(base) if p.isdigit())
+    out = []
+    for entry in os.listdir(base):
+        pid = proclog.entry_pid(entry)
+        if pid is None:
+            continue
+        out.append(pid if entry.isdigit() else entry)
+    return sorted(out, key=lambda e: (proclog.entry_pid(e), str(e)))
 
 
 def get_command_line(pid):
-    """Full command line of ``pid`` (reference: like_top.py:210-224)."""
+    """Full command line of ``pid`` (reference: like_top.py:210-224).
+    Accepts a bare PID or a fabric instance entry."""
+    pid = proclog.entry_pid(pid)
+    if pid is None:
+        return ''
     try:
         with open('/proc/%d/cmdline' % pid) as fh:
             return fh.read().replace('\0', ' ').strip()

@@ -875,10 +875,18 @@ class Block(BlockScope):
     def _observe_exit_age(self, iheader, frame_end):
         """Capture->pipeline-exit SLO observation (sink blocks: the
         data is leaving the pipeline here).  No-op without a
-        trace-context origin in the input header."""
+        trace-context origin in the input header.  Streams that
+        crossed >= 1 bridge hop additionally record the FABRIC
+        end-to-end age (``slo.fabric_exit_age_s``): the same exit
+        instant aged against the ORIGIN host's capture timestamp,
+        skew-corrected by the per-hop handshake clock pings
+        (docs/fabric.md)."""
         age = _slo.capture_age_s(iheader, frame_end)
         if age is not None:
             _slo.observe_exit(self.name, age)
+            ctx = self._trace_ctx or {}
+            if ctx.get('hops'):
+                _slo.observe_fabric_exit(self.name, age)
 
     def _observe_gulp(self, acquire, reserve, process):
         """Record this gulp into the block's latency histograms

@@ -504,7 +504,14 @@ class Ring(object):
         #: acquire unlock bytes an earlier open span still exports
         #: zero-copy.
         self._open_reads = {}
-        #: id(ReadSequence) -> highest span begin that reader ever
+        #: id(ReadSequence) -> {span begin: span end} for OPEN spans:
+        #: a release advances the consumed frontier to the span's END
+        #: (the reader read those bytes), which keeps the drop_oldest
+        #: shed ledger exact — counting from the released BEGIN would
+        #: double-count an already-consumed span as shed when a
+        #: reserve-shed races the no-open-spans window
+        self._open_read_ends = {}
+        #: id(ReadSequence) -> highest span END that reader ever
         #: RELEASED: out-of-order releases (acquire 0 and 8, release
         #: 8 then 0) must advance the guarantee to the high-water
         #: mark once no span is open, not to the last-released begin
@@ -1192,6 +1199,8 @@ class Ring(object):
             if rseq.guarantee:
                 opens = self._open_reads.setdefault(id(rseq), [])
                 opens.append(begin)
+                ends = self._open_read_ends.setdefault(id(rseq), {})
+                ends[begin] = max(ends.get(begin, 0), end)
                 # guarantee = oldest open span (never jumps past a
                 # held span; no overwrite beyond it until released);
                 # an ADVANCE frees writer space, so notify
@@ -1211,12 +1220,18 @@ class Ring(object):
                         opens.remove(span_begin)
                     except ValueError:
                         pass
+                ends = self._open_read_ends.get(id(rseq), {})
+                span_end = span_begin
+                if span_begin not in (opens or ()):
+                    span_end = ends.pop(span_begin, span_begin)
                 rh = max(self._release_high.get(id(rseq), 0),
-                         span_begin)
+                         span_end)
                 self._release_high[id(rseq)] = rh
                 # advance to the oldest still-open span, else to the
-                # high-water released span (out-of-order releases must
-                # not park the guarantee at an already-released begin)
+                # high-water released span's END: the reader CONSUMED
+                # those bytes, so a drop_oldest shed racing the
+                # no-open-spans window must not count them again
+                # (delivered + shed would exceed produced)
                 g = min(opens) if opens else rh
                 self._guarantees[id(rseq)] = max(
                     self._guarantees[id(rseq)], g)
@@ -1236,6 +1251,7 @@ class Ring(object):
         with self._lock:
             self._guarantees.pop(id(rseq), None)
             self._open_reads.pop(id(rseq), None)
+            self._open_read_ends.pop(id(rseq), None)
             self._release_high.pop(id(rseq), None)
             self._write_cond.notify_all()
 
@@ -1434,11 +1450,18 @@ class WriteSequence(_SequenceAPI):
         policy = getattr(ring, 'overload_policy', 'block')
         if policy != 'block':
             stats = ring.shed_stats()
-            self._stored_header['_overload'] = {
+            # MERGE with any stamp already riding the header: an
+            # upstream hop's fields (e.g. the fabric fan-in's
+            # ``fabric_gapped`` origin map — docs/fabric.md) must
+            # survive this ring's own stamp, or a drop-policy hop
+            # would silently strip the upstream loss disclosure
+            stamp = dict(self._stored_header.get('_overload') or {})
+            stamp.update({
                 'policy': policy,
                 'shed_gulps': stats['shed_gulps'],
                 'shed_bytes': stats['shed_bytes'],
-            }
+            })
+            self._stored_header['_overload'] = stamp
         tensor = _tensor_info(self._stored_header)
         ring.resize(gulp_nframe * tensor['frame_nbyte'],
                     buf_nframe * tensor['frame_nbyte'],
@@ -1939,7 +1962,7 @@ class ReadSpan(_SpanAPI):
                 self._ring._storage.refresh_ghost(begin, nbyte)
             except BaseException:
                 if rc is not None:
-                    rc.release(sequence, begin)
+                    rc.release(sequence, begin, nbyte)
                 self._ring._release_span(sequence, begin)
                 raise
         self._data = None
@@ -2005,7 +2028,7 @@ class ReadSpan(_SpanAPI):
         # release is caught before it can unbalance core accounting
         rc = _ringcheck.hook(self._ring)
         if rc is not None:
-            rc.release(self._sequence, self._begin)
+            rc.release(self._sequence, self._begin, self._nbyte)
         self._ring._release_span(self._sequence, self._begin)
         if faults.armed('ring.corrupt.double_release',
                         self._ring.name):

@@ -476,7 +476,14 @@ class HealthMonitor(threading.Thread):
                 self._delta(snap, 'block_restarts') +
                 self._delta(snap, 'bridge.tx.reconnects') +
                 self._delta(snap, 'bridge.redial_attempts') +
-                self._delta(snap, 'bridge.circuit_open'))
+                self._delta(snap, 'bridge.circuit_open') +
+                # fabric choreography (bifrost_tpu.fabric): a fan-out
+                # leg re-striped onto survivors, a fan-in origin
+                # marked gapped, or a dead sender session adopted —
+                # the pipeline is degraded-but-running, not failed
+                self._delta(snap, 'fabric.fanout.restripes') +
+                self._delta(snap, 'fabric.fanin.gapped') +
+                self._delta(snap, 'bridge.rx.sessions_adopted'))
             stalls = self._delta(snap, 'watchdog_stalls')
 
             with sup._lock:

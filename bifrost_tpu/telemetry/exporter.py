@@ -202,12 +202,24 @@ def snapshot(pipeline=None, rates=False):
         counts['trace.dropped_spans'] = \
             counts.get('trace.dropped_spans', 0) + dropped
     hists = histograms.snapshot()
+    # host identity (docs/fabric.md): which host/launcher this
+    # process IS — N fabric processes aggregating snapshots (or
+    # Prometheus textfiles on a shared filesystem) stay attributable
+    import os as _os
+    import socket as _socket
+    from ..proclog import get_identity
+    ident = get_identity()
+    identity = {'hostname': _socket.gethostname(), 'pid': _os.getpid()}
+    if ident is not None:
+        identity['fabric_host'] = ident[0]
+        identity['fabric_role'] = ident[1]
     snap = {
         'counters': counts,
         'histograms': hists,
         'rings': _ring_occupancy(pipeline),
         'devices': _device_stats(),
         'mesh': _mesh_summary(counts),
+        'identity': identity,
     }
     if rates:
         tracker = rates if isinstance(rates, RateTracker) \

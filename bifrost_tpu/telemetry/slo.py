@@ -50,10 +50,18 @@ from ..header_standard import trace_context
 
 __all__ = ['budget_s', 'reset_budget', 'capture_age_s',
            'observe_commit', 'observe_exit', 'observe_shed',
-           'reset_block_ages', 'EXIT_HISTOGRAM', 'SHED_HISTOGRAM']
+           'observe_fabric_exit', 'reset_block_ages',
+           'EXIT_HISTOGRAM', 'SHED_HISTOGRAM',
+           'FABRIC_EXIT_HISTOGRAM']
 
 #: the merged pipeline-exit age histogram (all sink blocks)
 EXIT_HISTOGRAM = 'slo.exit_age_s'
+#: cross-host capture-to-sink age (docs/fabric.md): recorded by sink
+#: blocks whose stream crossed >= 1 bridge hop, against the ORIGIN
+#: host's trace-context ``origin_ns`` corrected by the cumulative
+#: handshake-measured wall-clock skew (``_trace.skew_ns``, stamped by
+#: each bridge sender) — THE fabric end-to-end SLO number
+FABRIC_EXIT_HISTOGRAM = 'slo.fabric_exit_age_s'
 #: age of data at the moment a drop_* overload policy shed it — how
 #: stale the stream had become when the pipeline chose loss over
 #: latency (docs/robustness.md "Overload & degradation")
@@ -100,6 +108,14 @@ def capture_age_s(header, frame_end=None, now=None):
         origin = float(ctx['origin_ns']) * 1e-9
     except (KeyError, TypeError, ValueError):
         return None
+    # cross-host correction (docs/fabric.md): each bridge hop
+    # accumulated its handshake-measured wall-clock offset into
+    # skew_ns, so origin + skew is the capture instant expressed on
+    # THIS host's clock — without it a skewed host would report the
+    # clock difference as transit latency (or a negative age)
+    skew = ctx.get('skew_ns')
+    if isinstance(skew, (int, float)):
+        origin += float(skew) * 1e-9
     if frame_end is not None:
         tsamp = header.get('tsamp')
         if isinstance(tsamp, (int, float)) and 0 < tsamp < 1e6:
@@ -133,6 +149,18 @@ def observe_exit(name, age_s):
     per-sink histogram and the merged ``slo.exit_age_s``."""
     histograms.observe(EXIT_HISTOGRAM, age_s)
     _observe('slo.%s.exit_age_s' % name,
+             'slo.%s.violations' % name, age_s)
+
+
+def observe_fabric_exit(name, age_s):
+    """Record a CROSS-HOST capture->sink age (docs/fabric.md): called
+    next to :func:`observe_exit` by sink blocks whose input stream's
+    trace context shows >= 1 bridge hop.  Records the merged
+    ``slo.fabric_exit_age_s`` plus a per-sink histogram; ages above
+    the ``BF_SLO_MS`` budget count on the shared violation counters
+    like any other SLO observation."""
+    histograms.observe(FABRIC_EXIT_HISTOGRAM, age_s)
+    _observe('slo.%s.fabric_exit_age_s' % name,
              'slo.%s.violations' % name, age_s)
 
 

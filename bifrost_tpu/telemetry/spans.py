@@ -273,7 +273,8 @@ def prune_dead_buffers():
 # cross-host clock correlation (tools/trace_merge.py)
 # ---------------------------------------------------------------------------
 
-def note_peer_clock(session, role, offset_us=None, rtt_us=None):
+def note_peer_clock(session, role, offset_us=None, rtt_us=None,
+                    wall_offset_ns=None):
     """Register a bridge session this process participated in.
 
     The SENDER side passes the ping-estimated clock offset from its
@@ -296,6 +297,11 @@ def note_peer_clock(session, role, offset_us=None, rtt_us=None):
             entry['offset_us'] = round(float(offset_us), 3)
         if rtt_us is not None:
             entry['rtt_us'] = round(float(rtt_us), 3)
+        if wall_offset_ns is not None:
+            # wall-clock (time.time) offset to the peer from the same
+            # ping — what the fabric end-to-end SLO corrects by, and
+            # what tools/trace_merge.py surfaces as host clock skew
+            entry['wall_offset_ns'] = int(wall_offset_ns)
         if cur is not None and 'offset_us' not in entry \
                 and 'offset_us' in cur:
             return                   # never downgrade an estimate

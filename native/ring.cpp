@@ -81,7 +81,13 @@ struct Reader {
     // would let a later acquire unlock bytes an earlier open span is
     // still exporting zero-copy.
     std::multiset<int64_t> open_spans;
-    // Highest span begin ever RELEASED: out-of-order releases must
+    // END offset per open-span begin (max over duplicates): a release
+    // advances the consumed frontier to the span's END — the reader
+    // READ those bytes, so a drop_oldest shed racing the
+    // no-open-spans window must not count them again (the shed ledger
+    // would otherwise exceed produced == delivered + shed).
+    std::map<int64_t, int64_t> open_span_ends;
+    // Highest span END ever RELEASED: out-of-order releases must
     // advance the guarantee to this high-water mark once no span is
     // open, not to the last-released begin.
     int64_t release_high = 0;
@@ -843,6 +849,8 @@ int bft_reader_acquire(void* ring_, long long reader_id, void* seq_,
     Reader* rd = find_reader();   // re-lookup: may have been destroyed
     if (rd && rd->guarantee) {
         rd->open_spans.insert(begin);
+        int64_t& e = rd->open_span_ends[begin];
+        if (end > e) e = end;
         // guarantee = oldest open span (never jumps past a held
         // span); an ADVANCE frees writer space, so notify
         int64_t g = *rd->open_spans.begin();
@@ -868,10 +876,21 @@ int bft_reader_release(void* ring_, long long reader_id,
         if (rd->guarantee) {
             auto os = rd->open_spans.find(span_begin);
             if (os != rd->open_spans.end()) rd->open_spans.erase(os);
-            if (span_begin > rd->release_high)
-                rd->release_high = span_begin;
+            // consumed frontier = the released span's END (the reader
+            // read those bytes); only forget the end once no
+            // duplicate-begin span remains open
+            int64_t span_end = span_begin;
+            auto ie = rd->open_span_ends.find(span_begin);
+            if (ie != rd->open_span_ends.end()) {
+                span_end = ie->second;
+                if (rd->open_spans.find(span_begin)
+                        == rd->open_spans.end())
+                    rd->open_span_ends.erase(ie);
+            }
+            if (span_end > rd->release_high)
+                rd->release_high = span_end;
             // advance to the oldest still-open span, else to the
-            // high-water RELEASED span (out-of-order releases must
+            // high-water RELEASED end (out-of-order releases must
             // not park the guarantee at an already-released begin)
             int64_t g = rd->open_spans.empty()
                         ? rd->release_high : *rd->open_spans.begin();

@@ -481,34 +481,92 @@ def test_pipeline_health_api_without_run():
 def test_health_live_during_shedding_pipeline():
     """End-to-end: a drop_oldest pipeline with a slow consumer sheds,
     and Pipeline.health() reflects SHEDDING during the run and OK-ish
-    terminal states after."""
+    terminal states after.
+
+    The slow consumer idles BETWEEN spans (release, then sleep): a
+    reader that sleeps while HOLDING its span clamps the guarantee
+    advance at the open span, so drop_oldest degrades to plain
+    backpressure there — only unread backlog can be shed, never data
+    the reader has consumed or is consuming.  (The windowed bridge
+    reader sheds the same way: its no-open-spans windows are where
+    the backlog skips happen.)  The ledger is byte-exact: produced ==
+    delivered + shed, with shed == the skips the reader observes."""
     hdr = simple_header([-1, 3], 'f32')
     hdr['gulp_nframe'] = 4
+    NG = 120
     gulps = [np.full((4, 3), float(k), np.float32)
-             for k in range(40)]
+             for k in range(NG)]
     states = []
+    got_frames = [0]
+    skipped_frames = [0]
+    done = threading.Event()
 
-    class SlowSink(GatherSink):
-        def on_data(self, ispan):
-            time.sleep(0.02)
-            return GatherSink.on_data(self, ispan)
+    class PacedSource(NumpySourceBlock):
+        # 2x faster than the consumer: the backlog (and the counted
+        # shedding) persists long enough for the 0.5 s health ticks
+        # to observe it
+        def on_data(self, reader, ospans):
+            time.sleep(0.01)
+            return NumpySourceBlock.on_data(self, reader, ospans)
 
     with bf.Pipeline() as p:
-        src = NumpySourceBlock(gulps, hdr, gulp_nframe=4,
-                               overload_policy='drop_oldest')
-        sink = SlowSink(src, shed_tolerant=True, buffer_factor=2)
+        src = PacedSource(gulps, hdr, gulp_nframe=4,
+                          overload_policy='drop_oldest',
+                          buffer_factor=2)
+        ring = src.orings[0]
+
+        def consume():
+            # external guaranteed reader, bridge-style explicit
+            # acquire/release: copy a span, RELEASE it, then idle —
+            # the no-open-spans idle window is where the unpaced
+            # producer sheds the unread backlog (counted)
+            from bifrost_tpu.ring import EndOfDataStop
+            try:
+                for seq in ring.read(guarantee=True):
+                    offset = 0
+                    while True:
+                        try:
+                            span = seq.acquire(offset, 4)
+                        except EndOfDataStop:
+                            break
+                        # the whole gap skipped in one hop counts
+                        # (nframe_skipped caps at the span size)
+                        skipped_frames[0] += \
+                            span.frame_offset - offset
+                        advanced = span.frame_offset + span.nframe
+                        nframe = span.nframe
+                        if nframe:
+                            got_frames[0] += nframe
+                            span.data.as_numpy()
+                        span.release()
+                        if nframe == 0:
+                            # lapped, not end-of-data: skip forward
+                            if advanced <= offset:
+                                break
+                        offset = advanced
+                        if nframe:
+                            time.sleep(0.02)
+            except Exception:
+                pass
+            finally:
+                done.set()
 
         def sample():
-            while not sink.shutdown_event.wait(0.05):
+            while not done.wait(0.05):
                 states.append(p.health()['state'])
 
-        t = threading.Thread(target=sample, daemon=True)
-        t.start()
+        ct = threading.Thread(target=consume, daemon=True)
+        st = threading.Thread(target=sample, daemon=True)
+        ct.start()
+        st.start()
         p.run()
-    shed = src.orings[0].shed_stats()
+        ct.join(timeout=30)
+    shed = ring.shed_stats()
     assert shed['shed_bytes'] > 0
     assert 'SHEDDING' in states
-    # the audit: shed + delivered == produced (skips are zero-filled
-    # by the sink's on_skip, so count delivered from the shed ledger)
-    res = sink.result()
-    assert res is not None
+    # byte-exact audit: every produced frame was either delivered or
+    # counted shed — and the shed ledger equals the reader's own skip
+    # observation (no double count of consumed spans)
+    frame_nbyte = 3 * 4
+    assert shed['shed_bytes'] == skipped_frames[0] * frame_nbyte
+    assert got_frames[0] + skipped_frames[0] == NG * 4

@@ -1,0 +1,179 @@
+#!/usr/bin/env python3
+"""Fabric CLI: lint, launch, and inspect multi-host fabric specs
+(bifrost_tpu.fabric; docs/fabric.md).
+
+Subcommands::
+
+    bf_fabric.py lint spec.json
+        Statically verify the spec (analysis.verify.verify_fabric:
+        BF-E200 endpoint mismatch, BF-E201 port collision, BF-W202
+        window/stripe sizing, BF-W203 quota-vs-span) and print the
+        report.  Exit codes match tools/bf_lint.py: 0 clean,
+        3 errors found, 2 the spec could not be read.
+
+    bf_fabric.py launch spec.json --host NAME --builder pkg.mod:fn
+        Materialize and run NAME's sub-pipeline: the builder callable
+        receives a FabricHostContext (ctx.source/ctx.sink wire the
+        spec's links).  Runs until the stream completes or SIGTERM
+        drains the fabric cleanly.  This is the per-host entry point
+        a process supervisor (systemd, k8s) runs on each machine.
+
+    bf_fabric.py up spec.json --builder pkg.mod:fn [--hosts a,b,...]
+        Local loopback demo/drill: spawn every host of the spec (or a
+        subset) as a subprocess of THIS machine running ``launch``,
+        forward SIGINT/SIGTERM, and exit when all hosts do.  The
+        builder must dispatch on ``ctx.host``.
+
+    bf_fabric.py status
+        One-shot fabric status from the local proclog tree: every
+        launcher's ``fabric/health`` row (state, peers, end-to-end
+        age p99).
+
+The builder spec ``pkg.mod:fn`` imports ``pkg.mod`` and calls ``fn``
+with the context; relative module paths resolve from the CWD.
+"""
+
+import argparse
+import importlib
+import json
+import os
+import signal
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _load_spec(path):
+    from bifrost_tpu.fabric import FabricSpec
+    return FabricSpec.load(path)
+
+
+def _load_builder(spec_str):
+    mod_name, _, fn_name = spec_str.partition(':')
+    if not fn_name:
+        raise ValueError("--builder must be 'module:function'")
+    sys.path.insert(0, os.getcwd())
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, fn_name)
+
+
+def cmd_lint(args):
+    from bifrost_tpu.analysis.verify import (verify_fabric,
+                                             format_report, errors)
+    try:
+        spec = _load_spec(args.spec)
+    except (OSError, ValueError) as exc:
+        print('bf_fabric: cannot read spec %s: %s' % (args.spec, exc))
+        return 2
+    diags = verify_fabric(spec)
+    print('bf_fabric: fabric %r: %d host(s), %d link(s), '
+          '%d diagnostic(s)' % (spec.name, len(spec.hosts),
+                                len(spec.links), len(diags)))
+    if diags:
+        print(format_report(diags))
+    else:
+        print('  (clean)')
+    return 3 if errors(diags) else 0
+
+
+def cmd_launch(args):
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    from bifrost_tpu.fabric import FabricHost
+    spec = _load_spec(args.spec)
+    builder = _load_builder(args.builder)
+    fh = FabricHost(spec, args.host, builder)
+    fh.build()
+    fh.run()
+    state = fh.health()['state']
+    print('bf_fabric: host %r finished in state %s'
+          % (args.host, state))
+    return 0 if state in ('OK', 'DEGRADED') else 3
+
+
+def cmd_up(args):
+    spec = _load_spec(args.spec)
+    hosts = args.hosts.split(',') if args.hosts \
+        else sorted(spec.hosts)
+    procs = {}
+    for host in hosts:
+        procs[host] = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), 'launch',
+             args.spec, '--host', host, '--builder', args.builder])
+
+    def forward(signum, _frame):
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signum)
+    signal.signal(signal.SIGINT, forward)
+    signal.signal(signal.SIGTERM, forward)
+    rc = 0
+    for host, p in procs.items():
+        p.wait()
+        print('bf_fabric: host %r exited rc=%d' % (host, p.returncode))
+        rc = rc or p.returncode
+    return rc
+
+
+def cmd_status(args):
+    from bifrost_tpu import proclog
+    from bifrost_tpu.monitor_utils import list_pipelines
+    rows = 0
+    for pid in list_pipelines():
+        contents = proclog.load_by_pid(pid)
+        row = contents.get('fabric', {}).get('health')
+        if not row:
+            continue
+        rows += 1
+        print('%-24s host %-12s role %-8s state %-9s peers %s/%s '
+              'dead=%s%s'
+              % (pid, row.get('host', '?'), row.get('role', '?'),
+                 row.get('state', '?'), row.get('peers_alive', '?'),
+                 row.get('peers_total', '?'),
+                 row.get('peers_dead', 'none'),
+                 ('  e2e_p99=%sms' % row['fabric_exit_age_p99_ms'])
+                 if row.get('fabric_exit_age_p99_ms') not in
+                 (None, '') else ''))
+        member = contents.get('fabric', {}).get('membership')
+        if member and args.verbose:
+            peers = ['%s=%s' % (k[len('peer.'):], v)
+                     for k, v in sorted(member.items())
+                     if k.startswith('peer.')]
+            if peers:
+                print('  peers: %s' % '  '.join(peers))
+    if not rows:
+        print('bf_fabric: no fabric launchers found in the proclog '
+              'tree (%s)' % proclog.proclog_dir())
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest='cmd', required=True)
+    p = sub.add_parser('lint', help='statically verify a fabric spec')
+    p.add_argument('spec')
+    p.set_defaults(fn=cmd_lint)
+    p = sub.add_parser('launch', help="run one host's sub-pipeline")
+    p.add_argument('spec')
+    p.add_argument('--host', required=True)
+    p.add_argument('--builder', required=True,
+                   help="builder callable as 'module:function'")
+    p.set_defaults(fn=cmd_launch)
+    p = sub.add_parser('up', help='spawn every host locally (demo)')
+    p.add_argument('spec')
+    p.add_argument('--builder', required=True)
+    p.add_argument('--hosts', default='',
+                   help='comma-separated subset (default: all)')
+    p.set_defaults(fn=cmd_up)
+    p = sub.add_parser('status', help='fabric status from proclogs')
+    p.add_argument('--verbose', action='store_true')
+    p.set_defaults(fn=cmd_status)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Fabric chaos gate: the multi-host fabric must survive a SIGKILL'd
+host with byte-exact loss accounting.
+
+Runs bench_suite config 17 (docs/fabric.md): a loopback fabric of four
+launcher processes — two capture hosts fan-in to one reduce host,
+which fans out through a chaos TCP proxy to one leg host — driven
+through an overload pause, a SIGKILL of a capture host, and a jittered
+rejoin.  Asserts the invariants:
+
+- ``no_deadlock``             — every launcher exited cleanly;
+- ``no_silent_loss``          — produced == delivered + shed,
+  byte-exact across all SURVIVING ledgers (the killed host's journal
+  is durable, so the audit covers the kill);
+- ``exactly_once``            — per-origin delivery has no duplicates
+  and preserves order (the rejoin replayed ONLY unacked frames);
+- ``shedding_engaged`` / ``health_traversal`` — the pause forced
+  counted shedding and reduce traversed SHEDDING -> OK;
+- ``host_death_observed``     — membership saw the killed host
+  alive -> dead -> alive;
+- ``rejoin_replayed_only_unacked`` — the relaunched host resumed from
+  the receiver's committed frontier through session adoption;
+- ``origin_gapped_not_stalled`` — the fan-in marked the dead origin
+  GAPPED via the ``_overload`` stamp instead of stalling the merge;
+- ``fabric_slo_measured``     — the cross-host capture-to-sink age
+  histogram recorded at the leg.
+
+The full config result is written to the ``--out`` JSON artifact
+(``FABRIC_CHAOS_${ROUND}.json``).  Exit codes: 0 pass, 3 an invariant
+failed, 2 the drill failed to run.  ``tools/watch_and_bench.sh`` runs
+this after the chaos gate (``BF_SKIP_FABRIC_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config17(timeout=900):
+    """One bench_suite --config 17 subprocess on the CPU backend;
+    returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # configured overload/fabric tuning would skew the scripted drill
+    for var in ('BF_OVERLOAD_POLICY', 'BF_FAULTS', 'BF_SLO_MS',
+                'BF_BRIDGE_WINDOW', 'BF_BRIDGE_STREAMS',
+                'BF_FABRIC_STATE', 'BF_FABRIC_IDENTITY',
+                'BF_FABRIC_HEARTBEAT_SECS', 'BF_FABRIC_DEADLINE_SECS',
+                'BF_FABRIC_GAP_SECS', 'BF_FABRIC_REJOIN_CAP'):
+        env.pop(var, None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '17'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'invariants' in d:
+            return d
+    raise RuntimeError(
+        'config 17 produced no invariants result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1200:], out.stderr[-1200:]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default='FABRIC_CHAOS.json',
+                    help='artifact path for the full config result')
+    ap.add_argument('--timeout', type=int, default=900)
+    args = ap.parse_args(argv)
+    try:
+        res = run_config17(timeout=args.timeout)
+    except Exception as exc:
+        print('fabric_gate: drill failed to run: %s: %s'
+              % (type(exc).__name__, exc))
+        return 2
+    with open(args.out, 'w') as f:
+        json.dump(res, f, indent=2, sort_keys=True)
+    inv = res.get('invariants', {})
+    for name in sorted(inv):
+        print('%-28s %s' % (name, 'ok' if inv[name] else 'FAIL'))
+    print('ledger: %s' % json.dumps(res.get('ledger', {}),
+                                    sort_keys=True))
+    ok = bool(inv) and all(inv.values())
+    print('fabric_gate: %s -> %s' % ('PASS' if ok else 'FAIL',
+                                     args.out))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

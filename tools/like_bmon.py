@@ -61,7 +61,7 @@ def get_transmit_receive():
             entry.update({'pid': pid, 'name': block, 'kind': kind,
                           'time': now,
                           'bridge': '_bridge_' in block})
-            found['%d-%s' % (pid, block)] = entry
+            found['%s-%s' % (pid, block)] = entry
     return found
 
 
@@ -136,7 +136,7 @@ def render_pid(pid, stats, history, width=78):
     out = []
     st = stats.get(pid)
     if st is None:
-        return ['(no capture/transmit stats for pid %d)' % pid]
+        return ['(no capture/transmit stats for pid %s)' % pid]
     for kind, label in (('rx', 'RX'), ('tx', 'TX')):
         agg = st[kind]
         if not agg['blocks']:
@@ -165,11 +165,11 @@ def render_pid(pid, stats, history, width=78):
 def render_summary(stats):
     out = ['%7s  %11s %10s  %11s %10s'
            % ('PID', 'RX Rate', 'RX pkt/s', 'TX Rate', 'TX pkt/s')]
-    for pid in sorted(stats):
+    for pid in sorted(stats, key=str):
         rx, tx = stats[pid]['rx'], stats[pid]['tx']
         rv, ru = set_units(rx['drate'])
         tv, tu = set_units(tx['drate'])
-        out.append('%7d  %6.1f %-4s %10.1f  %6.1f %-4s %10.1f'
+        out.append('%7s  %6.1f %-4s %10.1f  %6.1f %-4s %10.1f'
                    % (pid, rv, ru, rx['prate'], tv, tu, tx['prate']))
     return out
 
@@ -203,9 +203,9 @@ def main():
         print('like_bmon - %s' % host)
         for line in render_summary(stats):
             print(line)
-        for pid in sorted(stats):
+        for pid in sorted(stats, key=str):
             print()
-            print('PID %d:' % pid)
+            print('PID %s:' % pid)
             for line in render_pid(pid, stats, history):
                 print(line)
         return 0
@@ -228,7 +228,7 @@ def main():
             if time.time() - t_last > args.interval:
                 stats = poll()
                 t_last = time.time()
-            pids = sorted(stats)
+            pids = sorted(stats, key=str)
             sel = min(max(sel, 0), max(len(pids) - 1, 0))
             maxy, maxx = scr.getmaxyx()
             lines = ['like_bmon - %s   (up/down: select pid, q: quit)'
@@ -236,7 +236,7 @@ def main():
             lines += render_summary(stats)
             lines.append('')
             if pids:
-                lines.append('--- PID %d ---' % pids[sel])
+                lines.append('--- PID %s ---' % pids[sel])
                 lines += render_pid(pids[sel], stats, history,
                                     width=maxx)
             for y, line in enumerate(lines[:maxy - 1]):

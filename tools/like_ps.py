@@ -23,12 +23,14 @@ from bifrost_tpu.monitor_utils import (list_pipelines,  # noqa: E402
 
 def get_process_details(pid):
     """user/CPU%/mem%/etime/threads via ``ps``
-    (reference: like_ps.py:45-77)."""
+    (reference: like_ps.py:45-77).  Accepts a bare PID or a fabric
+    instance entry (``<pid>@<host>.<role>``)."""
     data = {'user': '', 'cpu': 0.0, 'mem': 0.0, 'etime': '00:00',
             'threads': 0}
     try:
         out = subprocess.check_output(
-            ['ps', 'o', 'user,pcpu,pmem,etime,nlwp', str(pid)],
+            ['ps', 'o', 'user,pcpu,pmem,etime,nlwp',
+             str(proclog.entry_pid(pid) or pid)],
             stderr=subprocess.DEVNULL).decode()
         fields = out.split('\n')[1].split(None, 4)
         data.update({'user': fields[0], 'cpu': float(fields[1]),
@@ -53,7 +55,7 @@ def describe_pid(pid):
     cmd = get_command_line(pid)
     if not cmd and not details['user'] and not contents:
         return []
-    out = ['PID: %i' % pid,
+    out = ['PID: %s' % pid,
            '  Command: %s' % cmd,
            '  User: %s' % details['user'],
            '  CPU Usage: %.1f%%' % details['cpu'],

@@ -173,13 +173,16 @@ def get_device_memory_usage(timeout=10.0):
     return data
 
 
-def collect_blocks(pids=None, autotune=None, health=None):
+def collect_blocks(pids=None, autotune=None, health=None, fabric=None):
     """Per-block rows across pipelines: pid/name/cmd/core and the perf
     times (reference: like_top.py:305-330).  Pass a dict as
     ``autotune`` to collect each process's ``analysis/autotune`` knob
-    panel — and as ``health`` its ``pipeline/health`` state row
-    (docs/robustness.md) — from the SAME proclog walk (a separate
-    collect pass would re-parse every proclog file per refresh)."""
+    panel — as ``health`` its ``pipeline/health`` state row
+    (docs/robustness.md) — and as ``fabric`` its ``fabric/health``
+    membership/end-to-end row (docs/fabric.md) — from the SAME proclog
+    walk (a separate collect pass would re-parse every proclog file
+    per refresh).  ``pids`` entries may be bare PIDs or fabric
+    instance strings (``<pid>@<host>.<role>``)."""
     rows = {}
     for pid in (pids if pids is not None else list_pipelines()):
         contents = proclog.load_by_pid(pid)
@@ -191,6 +194,10 @@ def collect_blocks(pids=None, autotune=None, health=None):
             hrow = contents.get('pipeline', {}).get('health')
             if hrow:
                 health[pid] = hrow
+        if fabric is not None:
+            frow = contents.get('fabric', {}).get('health')
+            if frow:
+                fabric[pid] = frow
         cmd = get_command_line(pid)
         for block, logs in contents.items():
             if block == 'rings':
@@ -202,8 +209,9 @@ def collect_blocks(pids=None, autotune=None, health=None):
             ac = max(0.0, _num(perf.get('acquire_time')))
             pr = max(0.0, _num(perf.get('process_time')))
             re = max(0.0, _num(perf.get('reserve_time')))
-            rows['%d-%s' % (pid, block)] = {
-                'pid': pid, 'name': block, 'cmd': cmd, 'core': core,
+            rows['%s-%s' % (pid, block)] = {
+                'pid': proclog.entry_pid(pid) or 0, 'name': block,
+                'cmd': cmd, 'core': core,
                 'acquire': ac, 'process': pr, 'reserve': re,
                 'total': ac + pr + re,
                 # latency-histogram columns (seconds; rendered as ms)
@@ -255,7 +263,7 @@ def collect_autotune(pids=None):
 
 def render_text(load, cpu, mem, dev, rows, tuners=None,
                 sort_key='process', sort_rev=True, width=140,
-                health=None):
+                health=None, fabric=None):
     """Render the full display as text lines (shared by --once and the
     curses loop)."""
     host = socket.gethostname()
@@ -317,7 +325,7 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
                    'amortization — docs/perf.md)')
     # pipeline health state machine (pipeline/health ProcLog —
     # docs/robustness.md "Overload & degradation")
-    for pid in sorted(health or {}):
+    for pid in sorted(health or {}, key=str):
         h = health[pid]
         out.append('')
         out.append('[health] pid %s  state %s  transitions %s  %s'
@@ -325,9 +333,27 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
                       h.get('transitions', '?'),
                       ('blocks: %s' % h['blocks'])[:max(width - 40, 0)]
                       if h.get('blocks') else ''))
+    # fabric membership + cross-host end-to-end SLO (fabric/health
+    # ProcLog — docs/fabric.md): one row per launcher process showing
+    # its fabric state, live/dead peers, and the capture-to-sink age
+    # p99 measured against the ORIGIN host's clock
+    for pid in sorted(fabric or {}, key=str):
+        f = fabric[pid]
+        e2e = f.get('fabric_exit_age_p99_ms')
+        out.append('')
+        out.append('[fabric] pid %s  host %s  role %s  state %s  '
+                   'peers %s/%s%s%s'
+                   % (pid, f.get('host', '?'), f.get('role', '?'),
+                      f.get('state', '?'), f.get('peers_alive', '?'),
+                      f.get('peers_total', '?'),
+                      ('  dead: %s' % f['peers_dead'])
+                      if f.get('peers_dead') not in (None, '', 'none')
+                      else '',
+                      ('  e2e_age_p99 %.1fms' % _num(e2e))
+                      if e2e not in (None, '') else ''))
     # live auto-tuner knob panel (analysis/autotune ProcLog, fed by
     # the autotune.* counters — docs/autotune.md)
-    for pid in sorted(tuners or {}):
+    for pid in sorted(tuners or {}, key=str):
         t = tuners[pid]
         out.append('')
         out.append('[autotune] pid %s  mode %s  ticks %s  retunes %s'
@@ -371,19 +397,19 @@ def run_curses(args):
                 sort_key = new_key
             now = time.time()
             if now - t_last > args.interval or state is None:
-                tuners, health = {}, {}
+                tuners, health, fab = {}, {}, {}
                 state = (get_load_average(), get_processor_usage(),
                          get_memory_swap_usage(),
                          get_device_memory_usage() if args.devices
                          else None,
                          collect_blocks(autotune=tuners,
-                                        health=health),
-                         tuners, health)
+                                        health=health, fabric=fab),
+                         tuners, health, fab)
                 t_last = now
             maxy, maxx = scr.getmaxyx()
             lines = render_text(*state[:6], sort_key=sort_key,
                                 sort_rev=sort_rev, width=maxx,
-                                health=state[6])
+                                health=state[6], fabric=state[7])
             for y, line in enumerate(lines[:maxy - 1]):
                 attr = curses.A_REVERSE if line.startswith('   PID') \
                     else curses.A_NORMAL
@@ -415,13 +441,13 @@ def main():
     if args.once:
         get_processor_usage()        # prime the delta state
         time.sleep(0.05)
-        tuners, health = {}, {}
+        tuners, health, fab = {}, {}, {}
         lines = render_text(
             get_load_average(), get_processor_usage(),
             get_memory_swap_usage(),
             get_device_memory_usage() if args.devices else None,
-            collect_blocks(autotune=tuners, health=health), tuners,
-            sort_key=args.sort, health=health)
+            collect_blocks(autotune=tuners, health=health, fabric=fab),
+            tuners, sort_key=args.sort, health=health, fabric=fab)
         print('\n'.join(lines))
         return 0
     run_curses(args)

@@ -108,6 +108,14 @@ def merge(paths):
         pid = idx + 1                # renumber: same-pid files collide
         clocks[path] = {'shift_us': round(shift, 3), 'host': host,
                         'orig_pid': other.get('pid')}
+        # wall-clock skew to each bridge peer (the fabric end-to-end
+        # SLO's correction term — docs/fabric.md): surfaced so an
+        # operator can see host clock drift directly from the traces
+        walls = {s: e['wall_offset_ns']
+                 for s, e in clock_sessions(data).items()
+                 if e.get('wall_offset_ns') is not None}
+        if walls:
+            clocks[path]['wall_offsets_ns'] = walls
         events.append({'ph': 'M', 'name': 'process_name', 'pid': pid,
                        'tid': 0,
                        'args': {'name': 'host=%s pid=%s (%s)'
@@ -137,6 +145,12 @@ def main():
     n = sum(1 for e in merged['traceEvents'] if e.get('ph') != 'M')
     print('trace_merge: %d event(s) from %d file(s) -> %s'
           % (n, len(args.inputs), args.out))
+    for path, info in merged['otherData']['bf_merged_from'].items():
+        for session, off in (info.get('wall_offsets_ns')
+                             or {}).items():
+            print('trace_merge: %s: wall-clock offset to bridge peer '
+                  '(session %s): %+0.3f ms'
+                  % (info.get('host', path), session[:8], off / 1e6))
     return 0
 
 

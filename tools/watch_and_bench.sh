@@ -47,6 +47,12 @@
 #                               no deadlock, no silent loss, health
 #                               SHEDDING->OK, p99 under BF_SLO_MS;
 #                               tools/chaos_gate.py)
+#   FABRIC_CHAOS_${ROUND}.json - fabric chaos gate (config 17 on CPU:
+#                               a 4-process loopback fabric survives a
+#                               SIGKILL'd capture host — rejoin replays
+#                               only unacked frames, dead origin gapped
+#                               not stalled, produced == delivered +
+#                               shed byte-exact; tools/fabric_gate.py)
 #   bench_watch.log           - probe/attempt history (gitignored)
 cd "$(dirname "$0")/.." || exit 1
 ROUND="${BF_BENCH_ROUND:-r$(date -u +%Y%m%d)}"
@@ -248,6 +254,26 @@ for i in $(seq 1 400); do
         if [ "$crc_gate" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) chaos/soak gate FAILED" >> "$LOG"
           exit "$crc_gate"
+        fi
+      fi
+      # Fabric chaos gate: config 17 on CPU — a 4-process loopback
+      # fabric (2 capture hosts fan-in to a reduce host, reduce
+      # fans out to a leg through a chaos proxy) must survive a
+      # SIGKILL'd capture host: survivors shed counted and recover
+      # (SHEDDING -> OK), the relaunched host rejoins and replays
+      # ONLY unacked frames (session adoption + resume probe), the
+      # dead origin is marked gapped not stalled on, and produced ==
+      # delivered + shed holds byte-exact across all surviving
+      # ledgers (tools/fabric_gate.py; docs/fabric.md).  Writes
+      # FABRIC_CHAOS_${ROUND}.json.
+      if [ "${BF_SKIP_FABRIC_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) fabric chaos gate (config 17, CPU)" >> "$LOG"
+        python tools/fabric_gate.py --out "FABRIC_CHAOS_${ROUND}.json" >> "$LOG" 2>&1
+        frc_gate=$?
+        echo "$(date -u +%FT%TZ) fabric gate rc=$frc_gate" >> "$LOG"
+        if [ "$frc_gate" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) fabric chaos gate FAILED" >> "$LOG"
+          exit "$frc_gate"
         fi
       fi
       # Mesh-resident pipeline gate: config 11 on an 8-device
