@@ -3046,6 +3046,266 @@ def bench_fabric_chaos(nseq=24, gulp_per_seq=10, tick_ms=15.0,
     }
 
 
+# ---------------------------------------------------------------------------
+# config 18: multi-tenant service tier — 3 concurrent tenant jobs
+# (replay + file ingest + synthetic capture) with quotas and a
+# BF_FAULTS-killed tenant, plus a warm-vs-cold job-start measurement
+# (bifrost_tpu.service; docs/service.md; gated by
+# tools/service_gate.py into SERVICE_cpu.json)
+# ---------------------------------------------------------------------------
+
+def bench_service(overlap_floor_s=0.3):
+    """Multi-tenant service drill (docs/service.md):
+
+    **Phase 1 — warm starts.**  A device fused-chain tenant (synthetic
+    -> quota gate -> copy(tpu) -> fused FFT/detect/reduce -> copy ->
+    gather) is submitted COLD, run to completion (its compiled plans
+    and tuned knobs are harvested into the warm registry), then the
+    SAME structural topology is resubmitted: the warm job must adopt
+    the plan depot (``fused.plan_depot_hits``; zero
+    ``fused.plan_builds``), adopt the knob profile
+    (``autotune.profile_adoptions``), start >= 2x faster, and produce
+    byte-identical output.
+
+    **Phase 2 — isolation + quotas.**  Three tenants run CONCURRENTLY
+    in one JobManager: ``replay`` (serialized recording, loop=3,
+    paced by a 'pace' token-bucket quota), ``filein`` (flat binary
+    ingest, paced quota), and ``synth`` (paced synthetic capture)
+    which a ``BF_FAULTS`` entry kills mid-run.  Invariants: the three
+    jobs actually overlapped; replay/filein outputs are byte-correct
+    (and synth delivered a clean prefix up to the kill); the killed
+    tenant is CONTAINED — the survivors finish DONE with health OK,
+    zero shed and zero poisoned rings; both paced quotas are enforced
+    within 10% of spec; and ``telemetry.snapshot()['tenants']``
+    carries every tenant's rollup."""
+    import shutil
+    import tempfile
+    _tests = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'tests')
+    if _tests not in sys.path:
+        sys.path.insert(0, _tests)
+    import bifrost_tpu as bf
+    from bifrost_tpu import service, telemetry
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    from bifrost_tpu.telemetry import counters
+    from bifrost_tpu.testing import faults
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    service.reset_registry()
+    service.reset_warm_registry()
+    tmpdir = tempfile.mkdtemp(prefix='bf_service_')
+    detail = {}
+    try:
+        # ---- phase 1: cold vs warm job start -------------------------
+        sinks = []
+
+        def build_device(gate):
+            b = bf.blocks.copy(gate, space='tpu')
+            fbk = bf.blocks.fused(
+                b, [FftStage('chan', axis_labels='freq'),
+                    DetectStage('scalar'),
+                    ReduceStage('freq', 3)])
+            sinks.append(GatherSink(bf.blocks.copy(fbk,
+                                                   space='system')))
+
+        def dev_spec(tid):
+            return service.TenantSpec(tid, source={
+                'kind': 'synthetic', 'nframe_total': 96,
+                'gulp_nframe': 32, 'nchan': 64, 'seed': 1})
+
+        mgr1 = service.JobManager(max_tenants=4)
+        cold = mgr1.submit(dev_spec('cold'), build=build_device)
+        cold.start()
+        cold.wait(120)
+        builds0 = counters.get('fused.plan_builds')
+        adopt0 = counters.get('autotune.profile_adoptions')
+        hits0 = counters.get('fused.plan_depot_hits')
+        warm = mgr1.submit(dev_spec('warm'), build=build_device)
+        warm.start()
+        warm.wait(120)
+        mgr1.shutdown()
+        warm_builds = counters.get('fused.plan_builds') - builds0
+        warm_hits = counters.get('fused.plan_depot_hits') - hits0
+        adoptions = counters.get('autotune.profile_adoptions') - adopt0
+        cold_lat = cold.start_latency_s or 0.0
+        warm_lat = warm.start_latency_s or float('inf')
+        speedup = cold_lat / warm_lat if warm_lat > 0 else 0.0
+        warm_identical = (sinks[0].result() is not None
+                          and sinks[1].result() is not None
+                          and np.array_equal(sinks[0].result(),
+                                             sinks[1].result()))
+        detail['warm'] = {
+            'cold_start_s': round(cold_lat, 6),
+            'warm_start_s': round(warm_lat, 6),
+            'speedup': round(speedup, 2),
+            'plan_builds_during_warm': warm_builds,
+            'plan_depot_hits': warm_hits,
+            'profile_adoptions': adoptions,
+            'warm_flagged': int(warm.warm),
+        }
+
+        # ---- phase 2 workloads ---------------------------------------
+        NCHAN, GULP = 16, 32
+        rng = np.random.RandomState(7)
+        rec = rng.randn(256, NCHAN).astype(np.float32)
+        hdr = simple_header([-1, NCHAN], 'f32', name='svc-src',
+                            gulp_nframe=GULP)
+        with bf.Pipeline() as prec:
+            src = NumpySourceBlock(
+                [rec[i:i + GULP] for i in range(0, 256, GULP)], hdr,
+                gulp_nframe=GULP)
+            bf.blocks.serialize(src, path=tmpdir)
+        prec.run()
+        base = os.path.join(tmpdir, 'svc-src')
+
+        FNFRAME, FSAMP = 640, 256
+        fdata = rng.randn(FNFRAME, FSAMP).astype(np.float32)
+        fpath = os.path.join(tmpdir, 'svc-ingest.bin')
+        with open(fpath, 'wb') as f:
+            f.write(fdata.tobytes())
+
+        LOOP = 3
+        rep_bytes = rec.nbytes * LOOP            # 48 KiB
+        rep_quota = rep_bytes / 2.0              # ~2 s paced
+        file_quota = fdata.nbytes / 2.0
+
+        gathers = {}
+
+        def make_gather(tid):
+            def build(gate):
+                gathers[tid] = GatherSink(gate)
+            return build
+
+        specs = [
+            service.TenantSpec(
+                'replay', priority=2,
+                quota_bytes_per_s=rep_quota, quota_policy='pace',
+                gulp_nframe=GULP,
+                source={'kind': 'replay', 'basenames': [base],
+                        'gulp_nframe': GULP, 'loop': LOOP,
+                        'restamp': True}),
+            service.TenantSpec(
+                'filein', quota_bytes_per_s=file_quota,
+                quota_policy='pace', gulp_nframe=GULP,
+                source={'kind': 'file', 'paths': [fpath],
+                        'gulp_size': FSAMP, 'gulp_nframe': GULP,
+                        'dtype': 'f32'}),
+            service.TenantSpec(
+                'synth', gulp_nframe=GULP,
+                source={'kind': 'synthetic', 'nframe_total': 1280,
+                        'gulp_nframe': GULP, 'nchan': NCHAN,
+                        'seed': 3, 'tick_s': 0.04}),
+        ]
+        # the BF_FAULTS-killed tenant: one injected failure inside
+        # tenant.synth's blocks mid-run, abort policy — the job FAILS
+        # and the blast radius must stop at its own rings
+        prev_faults = os.environ.get('BF_FAULTS')
+        os.environ['BF_FAULTS'] = 'block.on_data:tenant.synth:1:60:0'
+        faults.clear()
+        mgr2 = service.JobManager(max_tenants=4)
+        jobs = {s.id: mgr2.submit(s, build=make_gather(s.id))
+                for s in specs}
+        try:
+            mgr2.start()
+            mgr2.wait(180)
+        finally:
+            mgr2.shutdown()
+            faults.clear()
+            if prev_faults is None:
+                os.environ.pop('BF_FAULTS', None)
+            else:
+                os.environ['BF_FAULTS'] = prev_faults
+
+        # ---- invariants ----------------------------------------------
+        spans_ = {tid: (j.run_started_at, j.finished_at)
+                  for tid, j in jobs.items()}
+        overlap = (min(e for _s, e in spans_.values()) -
+                   max(s for s, _e in spans_.values()))
+        rep_out = gathers['replay'].result()
+        rep_exp = np.tile(rec, (LOOP, 1))
+        file_out = gathers['filein'].result()
+        synth_out = gathers['synth'].result()
+        synth_exp = service.SyntheticSource.payload(1280, NCHAN, 3)
+        synth_clean_prefix = (
+            synth_out is not None and len(synth_out) > 0
+            and np.array_equal(synth_out,
+                               synth_exp[:synth_out.shape[0]]))
+        stats = {tid: j.stats() for tid, j in jobs.items()}
+
+        def achieved(tid):
+            j = jobs[tid]
+            el = (j.finished_at - j.first_data_at) \
+                if j.first_data_at else 0.0
+            b = counters.get('service.%s.admitted_bytes' % tid)
+            return b / el if el > 0 else 0.0
+        quota_err = {
+            'replay': abs(achieved('replay') - rep_quota) / rep_quota,
+            'filein': abs(achieved('filein') - file_quota)
+                      / file_quota,
+        }
+        survivors = ('replay', 'filein')
+        invariants = {
+            'tenants_concurrent': bool(overlap >= overlap_floor_s),
+            'outputs_byte_correct': bool(
+                rep_out is not None and file_out is not None
+                and np.array_equal(rep_out, rep_exp)
+                and np.array_equal(
+                    file_out.reshape(-1, FSAMP), fdata)
+                and synth_clean_prefix),
+            'fault_tenant_failed': bool(
+                jobs['synth'].state == 'FAILED'
+                and 'FaultInjected' in stats['synth'].get('error',
+                                                          '')),
+            'fault_contained': bool(all(
+                jobs[t].state == 'DONE'
+                and stats[t]['health'] in ('OK', 'DEGRADED')
+                for t in survivors)),
+            'zero_cross_tenant_shed': bool(all(
+                stats[t]['ring_shed_gulps'] == 0
+                and stats[t]['quota_shed_gulps'] == 0
+                for t in survivors)),
+            'zero_cross_tenant_poison': bool(all(
+                stats[t]['rings_poisoned'] == 0
+                for t in survivors)),
+            'quota_within_10pct': bool(
+                max(quota_err.values()) <= 0.10),
+            'warm_speedup_ge2': bool(speedup >= 2.0
+                                     and warm.warm
+                                     and warm_identical),
+            'warm_zero_recompiles': bool(warm_builds == 0
+                                         and warm_hits >= 1),
+            'warm_profile_adopted': bool(adoptions >= 1),
+            'tenants_telemetry': bool(
+                all(t in telemetry.snapshot()['tenants']
+                    for t in ('replay', 'filein', 'synth'))),
+        }
+        detail.update({
+            'overlap_s': round(overlap, 3),
+            'quota_err_pct': {k: round(v * 100, 2)
+                              for k, v in quota_err.items()},
+            'achieved_bytes_per_s': {
+                'replay': round(achieved('replay'), 1),
+                'filein': round(achieved('filein'), 1)},
+            'quota_bytes_per_s': {'replay': rep_quota,
+                                  'filein': file_quota},
+            'tenants': stats,
+        })
+        return {
+            'config': 'multi-tenant service: 3 concurrent tenant '
+                      'jobs (replay loop=3 + file ingest + synthetic '
+                      'capture), paced quotas, BF_FAULTS-killed '
+                      'synth tenant, warm-vs-cold fused-chain start',
+            'value': round(speedup, 2),
+            'unit': 'x warm vs cold job-start latency '
+                    '(0 recompiles on the warm path)',
+            'invariants': invariants,
+            **detail,
+            'pass': all(invariants.values()),
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 ALL = {
     1: bench_sigproc_cpu,
     2: bench_spectroscopy,
@@ -3064,13 +3324,14 @@ ALL = {
     15: bench_chaos_soak,
     16: bench_segments,
     17: bench_fabric_chaos,
+    18: bench_service,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-17; 0 = all')
+                    help='config number 1-18; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -3080,7 +3341,7 @@ def main(argv=None):
                     help='flagship pipeline Msamples/s for config 7')
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
-    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16)
+    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16, 18)
                    for c in todo)
     if need_dev:
         from bench import _backend_alive
@@ -3389,6 +3650,39 @@ def _verify_config17():
     return pipelines
 
 
+def _verify_config18():
+    """The multi-tenant service topology (bench_service's phase-2
+    tenant set) as build-only pipelines: a JobManager admits the three
+    tenants — replay, file ingest, synthetic — (running verify_service
+    over the combined spec at submit time: no BF-E21x), and every
+    tenant pipeline (source -> quota gate -> sink) must lint clean.
+    Sources open their files lazily, so no recording needs to exist on
+    disk for the build."""
+    from bifrost_tpu import service
+
+    service.reset_registry()
+    mgr = service.JobManager(max_tenants=4, warm=False)
+    specs = [
+        service.TenantSpec(
+            'replay', priority=2, quota_bytes_per_s=64 * 1024,
+            quota_policy='pace', gulp_nframe=32,
+            source={'kind': 'replay', 'basenames': ['svc-src'],
+                    'gulp_nframe': 32, 'loop': 3, 'restamp': True}),
+        service.TenantSpec(
+            'filein', quota_bytes_per_s=256 * 1024,
+            quota_policy='pace', gulp_nframe=32,
+            source={'kind': 'file', 'paths': ['svc-ingest.bin'],
+                    'gulp_size': 256, 'gulp_nframe': 32,
+                    'dtype': 'f32'}),
+        service.TenantSpec(
+            'synth', gulp_nframe=32,
+            source={'kind': 'synthetic', 'nframe_total': 1280,
+                    'gulp_nframe': 32, 'nchan': 16, 'seed': 3}),
+    ]
+    jobs = [mgr.submit(s) for s in specs]
+    return [j.pipeline for j in jobs]
+
+
 def build_verify_topologies():
     """{name: builder} over every pipeline-shaped bench config.  Each
     builder returns a Pipeline, a list of Pipelines, or None when the
@@ -3405,6 +3699,7 @@ def build_verify_topologies():
         'config15_chaos': _verify_config15,
         'config16_segments': _verify_config16,
         'config17_fabric': _verify_config17,
+        'config18_service': _verify_config18,
     }
 
 

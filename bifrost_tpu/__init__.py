@@ -72,6 +72,11 @@ from . import trace
 from . import telemetry
 from . import supervision
 from . import autotune
+# NOTE: the service tier (bifrost_tpu.service, docs/service.md) and
+# the fabric (bifrost_tpu.fabric) are imported on demand — telemetry
+# snapshots gate their sections on the module being loaded, so a
+# plain pipeline process never pays for (or reports) the layers it
+# does not use.
 from . import testing
 from .utils import EnvVars, ObjectCache, enable_compilation_cache
 from .header_standard import enforce_header_standard

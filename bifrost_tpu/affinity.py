@@ -9,7 +9,18 @@ import os
 
 __all__ = ['get_core', 'set_core', 'set_openmp_cores',
            'numa_node_of_core', 'bind_memory_to_node',
-           'bind_memory_to_core']
+           'bind_memory_to_core', 'available_cores',
+           'partition_cores']
+
+
+def available_cores():
+    """The cores this process may schedule on (its affinity mask), or
+    every host core where the mask is unreadable — the ONE source of
+    the host core pool (service tier, verify_service, partitioning)."""
+    try:
+        return sorted(os.sched_getaffinity(0))
+    except AttributeError:                  # pragma: no cover
+        return list(range(os.cpu_count() or 1))
 
 _MBIND_SYSCALL = {'x86_64': 237, 'aarch64': 235}
 _MPOL_BIND = 2
@@ -57,6 +68,59 @@ def set_core(core):
 def set_openmp_cores(cores):
     os.environ['OMP_NUM_THREADS'] = str(len(cores)) \
         if not isinstance(cores, int) else str(cores)
+
+
+def partition_cores(weights, cores=None):
+    """Partition a host core pool across tenants, priority-weighted
+    (the multi-tenant service tier's scheduler primitive —
+    bifrost_tpu.service, docs/service.md).
+
+    ``weights`` maps tenant -> positive weight (priority x requested
+    cores; <= 0 is clamped to 1); iteration order breaks ties, so an
+    ordered mapping gives deterministic assignments.  ``cores`` is an
+    explicit core list, else this process's affinity mask, else all
+    host cores.
+
+    Returns ``{tenant: [core, ...]}``.  Shares are apportioned by
+    largest remainder with a one-core floor per tenant; when there
+    are MORE tenants than cores (oversubscription — the BF-W212
+    case), cores are shared round-robin so every tenant still gets a
+    core to pin to (shared, not exclusive)."""
+    if cores is None:
+        cores = available_cores()
+    cores = list(cores)
+    tenants = list(weights)
+    if not tenants:
+        return {}
+    if not cores:
+        return {t: [] for t in tenants}
+    w = {t: max(float(weights[t] or 0), 1.0) for t in tenants}
+    total = sum(w.values())
+    ncore = len(cores)
+    if ncore < len(tenants):
+        # oversubscribed: round-robin core sharing, one core each
+        return {t: [cores[i % ncore]]
+                for i, t in enumerate(tenants)}
+    # largest-remainder apportionment with a 1-core floor
+    ideal = {t: w[t] / total * ncore for t in tenants}
+    share = {t: max(int(ideal[t]), 1) for t in tenants}
+    # trim overflow from the most-over-served (floor inflation), then
+    # hand out the remainder by largest fractional part
+    while sum(share.values()) > ncore:
+        victim = max((t for t in tenants if share[t] > 1),
+                     key=lambda t: share[t] - ideal[t])
+        share[victim] -= 1
+    order = sorted(tenants, key=lambda t: (share[t] - ideal[t],
+                                           tenants.index(t)))
+    i = 0
+    while sum(share.values()) < ncore:
+        share[order[i % len(order)]] += 1
+        i += 1
+    out, pos = {}, 0
+    for t in tenants:
+        out[t] = cores[pos:pos + share[t]]
+        pos += share[t]
+    return out
 
 
 def numa_node_of_core(core):

@@ -37,7 +37,8 @@ import threading
 from copy import deepcopy
 
 __all__ = ['Diagnostic', 'PipelineValidationError', 'CODES',
-           'verify_pipeline', 'verify_fabric', 'errors', 'warnings_',
+           'verify_pipeline', 'verify_fabric', 'verify_service',
+           'errors', 'warnings_',
            'format_report', 'gate_run', 'lint_intercept',
            'validate_mode', 'ring_capacity_floors', 'new_errors_vs',
            'scope_overrides']
@@ -74,6 +75,9 @@ CODES = {
     'BF-E201': 'fabric port collision',
     'BF-W202': 'fabric link window/stripe sizing hazard',
     'BF-W203': 'fabric link quota smaller than one (macro-)span',
+    'BF-E210': 'duplicate tenant id in a service spec',
+    'BF-E211': 'tenant quota smaller than one gulp span',
+    'BF-W212': 'tenant core requests oversubscribe the host',
     'BF-I199': 'verifier check failed internally (diagnostic only)',
 }
 
@@ -1193,6 +1197,79 @@ def verify_fabric(spec):
                     'the stream to zero throughput'
                     % (lname, quota, link.gulp_nbyte),
                     block='link:%s' % lname))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# service-spec verification (bifrost_tpu.service; docs/service.md)
+# ---------------------------------------------------------------------------
+
+def verify_service(specs, ncores=None):
+    """Statically check a whole multi-tenant service spec (a list of
+    :class:`bifrost_tpu.service.TenantSpec` or their dict forms)
+    BEFORE any job builds — the service-level sibling of
+    :func:`verify_fabric`; ``JobManager.submit`` runs it at admission
+    time:
+
+    - **BF-E210** duplicate tenant id: two tenants share an id — the
+      per-tenant counter namespaces, ProcLog panes, and the job
+      registry would silently merge;
+    - **BF-E211** quota below one gulp span: a 'shed'-policy tenant
+      whose ``quota_bytes_per_s`` is smaller than its declared
+      ``gulp_nbyte`` sheds EVERY gulp (the token bucket can never
+      cover one span) — zero throughput by construction (the
+      service-tier BF-W181/BF-W203; 'pace' policy is exempt, its
+      debt-based bucket admits oversized spans at full refill cost);
+    - **BF-W212** core oversubscription: the tenants' summed
+      ``ncores`` requests exceed the host pool — tenants will SHARE
+      cores round-robin (``affinity.partition_cores``) instead of
+      owning them.
+
+    ``ncores`` is the schedulable core count (default: this process's
+    affinity mask).  Returns :class:`Diagnostic` s anchored on
+    ``tenant:<id>``."""
+    from ..service import TenantSpec
+    specs = [TenantSpec.coerce(s) for s in specs]
+    diags = []
+    seen = {}
+    for s in specs:
+        if s.id in seen:
+            diags.append(Diagnostic(
+                'BF-E210',
+                'tenant id %r is declared %d times: tenant ids key '
+                'the counter namespaces, the [tenants] telemetry '
+                'section, and the job registry — they must be unique '
+                'per service' % (s.id, seen[s.id] + 1),
+                block='tenant:%s' % s.id))
+        seen[s.id] = seen.get(s.id, 0) + 1
+    for s in specs:
+        if s.quota_bytes_per_s > 0 and s.gulp_nbyte and \
+                s.quota_policy == 'shed' and \
+                s.gulp_nbyte > s.quota_bytes_per_s:
+            diags.append(Diagnostic(
+                'BF-E211',
+                'tenant %r quota (%.0f B/s, policy shed) is smaller '
+                'than one declared gulp span (%d bytes): refilling '
+                'the bucket for a single gulp takes over a second, '
+                'so the gate sheds all but a trickle of the stream — '
+                'raise the quota above one span per second, shrink '
+                'the gulp, or use the pace policy'
+                % (s.id, s.quota_bytes_per_s, s.gulp_nbyte),
+                block='tenant:%s' % s.id))
+    if ncores is None:
+        from ..affinity import available_cores
+        ncores = len(available_cores())
+    want = sum(max(s.ncores, 1) for s in specs)
+    if ncores and want > ncores:
+        diags.append(Diagnostic(
+            'BF-W212',
+            'tenants request %d core(s) but the host pool has %d: '
+            'the scheduler will share cores round-robin '
+            '(affinity.partition_cores) instead of giving each '
+            'tenant exclusive cores — lower ncores/priorities or '
+            'shrink the tenant set for isolation'
+            % (want, ncores),
+            block='tenant:%s' % specs[0].id if specs else None))
     return diags
 
 

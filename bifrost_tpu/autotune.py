@@ -73,7 +73,7 @@ import time
 from .supervision import _env_float, _env_int
 
 __all__ = ['AutoTuner', 'maybe_start', 'resolve_mode', 'apply_profile',
-           'load_profile', 'topology_signature']
+           'adopt_profile', 'load_profile', 'topology_signature']
 
 #: controller tick period (seconds)
 DEFAULT_INTERVAL = 0.5
@@ -240,6 +240,20 @@ def apply_profile(pipeline, profile):
                 except Exception:
                     pass
     return knobs
+
+
+def adopt_profile(pipeline, knobs):
+    """Pin a NEW pipeline's tunables to a knob set harvested from a
+    previous converged/finished run — the multi-tenant service tier's
+    warm start (bifrost_tpu.service, docs/service.md): the job starts
+    AT the converged configuration instead of re-converging.  A thin
+    wrapper over :func:`apply_profile` that makes the adoption
+    observable: every call counts on ``autotune.profile_adoptions``
+    (the warm-start test's assertion signal)."""
+    applied = apply_profile(pipeline, {'knobs': dict(knobs or {})})
+    from .telemetry import counters
+    counters.inc('autotune.profile_adoptions')
+    return applied
 
 
 def _pipeline_rings(pipeline):

@@ -43,6 +43,13 @@ class FusedBlock(TransformBlock):
         #: aliasing differs), cached side by side
         self._plans = {}
         self._plan_impls = {}   # same key -> impl info recorded at build
+        #: warm-start plan depot (bifrost_tpu.service; docs/service.md):
+        #: a dict shared ACROSS job instances with the same structural
+        #: topology + plan signature — builds deposit into it, and a
+        #: warm-started job's blocks replay deposits instead of
+        #: re-tracing/re-compiling (fused.plan_depot_hits).  None (the
+        #: default) disables the seam entirely.
+        self._plan_depot = None
         self._donate_on = None
         #: configuration of the path the LAST EXECUTED plan runs
         #: (published to ProcLog ``<name>/impl`` so benchmarks and
@@ -56,6 +63,53 @@ class FusedBlock(TransformBlock):
 
     def define_valid_input_spaces(self):
         return ('tpu',)
+
+    # -- warm-start plan sharing (bifrost_tpu.service) --------------------
+    def plan_signature(self):
+        """Stable identity of the math this block's compiled plans
+        implement: the stage chain's types + scalar construction
+        parameters.  Two FusedBlocks with equal signatures compile
+        byte-identical programs for equal plan keys, so their plans
+        may be shared through a depot.  Returns None when any stage
+        carries non-scalar state (e.g. a weights array) — such plans
+        are never shared (the service counts the resulting warm-start
+        rejection on ``service.warm.rejected_stale``)."""
+        chain = []
+        for s in self.stages:
+            items = []
+            for k, v in sorted(vars(s).items()):
+                if isinstance(v, (int, float, str, bool, bytes,
+                                  type(None))):
+                    items.append((k, v))
+                elif isinstance(v, (tuple, list)) and all(
+                        isinstance(x, (int, float, str, bool,
+                                       type(None))) for x in v):
+                    items.append((k, tuple(v)))
+                else:
+                    return None
+            chain.append((type(s).__name__, tuple(items)))
+        return (type(self).__name__, tuple(chain))
+
+    def _depot_fetch(self, key):
+        """A previously deposited compiled plan for ``key``, installed
+        into this block's plan cache, or None."""
+        depot = self._plan_depot
+        if depot is None:
+            return None
+        got = depot.get(key)
+        if got is None:
+            return None
+        plan, info = got
+        self._plans[key] = plan
+        self._plan_impls[key] = info
+        from ..telemetry import counters
+        counters.inc('fused.plan_depot_hits')
+        return plan
+
+    def _depot_store(self, key):
+        if self._plan_depot is not None:
+            self._plan_depot[key] = (self._plans[key],
+                                     self._plan_impls.get(key))
 
     def verify_header(self, ihdr):
         """Static-verification protocol (bifrost_tpu.analysis.verify):
@@ -178,6 +232,10 @@ class FusedBlock(TransformBlock):
         import jax
         from ..stages import compose_stages
         from ..ops.common import donating_jit
+        from ..telemetry import counters as _counters
+        # every plan build (trace + compile) is counted: the service
+        # tier's warm-start gate asserts a warm job's delta is ZERO
+        _counters.inc('fused.plan_builds')
         mesh = self.mesh
         if mesh is None:
             # compose_stages applies the whole-chain kernel
@@ -350,10 +408,13 @@ class FusedBlock(TransformBlock):
         key = (tuple(x.shape), str(x.dtype), bool(donate))
         plan = self._plans.get(key)
         if plan is None:
+            plan = self._depot_fetch(key)
+        if plan is None:
             self._last_built_impl = None
             plan = self._build_plan(x.shape, x.dtype, donate=donate)
             self._plans[key] = plan
             self._plan_impls[key] = self._last_built_impl
+            self._depot_store(key)
         info = self._plan_impls.get(key)
         if info is not None:
             self._publish_impl(info, key)
@@ -386,6 +447,10 @@ class FusedBlock(TransformBlock):
                int(gulp_nframe), mode)
         plan = self._plans.get(key)
         if plan is None:
+            plan = self._depot_fetch(key)
+        if plan is None:
+            from ..telemetry import counters as _counters
+            _counters.inc('fused.plan_builds')
             taxis_in = self._headers[0]['_tensor']['shape'].index(-1)
             taxis_out = self._headers[-1]['_tensor']['shape'].index(-1)
             info_box = {}
@@ -464,6 +529,7 @@ class FusedBlock(TransformBlock):
             plan = (fn, shard_taxis)
             self._plans[key] = plan
             self._plan_impls[key] = info
+            self._depot_store(key)
         info = self._plan_impls.get(key)
         if info is not None:
             self._publish_impl(info, key)

@@ -171,18 +171,83 @@ class _DeserializeReader(object):
         return chunks, nread
 
 
+#: sourcename suffix marking a looped replay pass (never a legal
+#: filename character sequence in the serialize format)
+_LOOP_SEP = '#loop'
+
+
 class DeserializeBlock(SourceBlock):
-    def __init__(self, filenames, gulp_nframe, *args, **kwargs):
-        names = [f[:-len('.bf.json')] if f.endswith('.bf.json') else f
-                 for f in filenames]
+    """Replay a serialized stream — the multi-tenant service tier's
+    canonical tenant workload (bifrost_tpu.service, docs/service.md).
+
+    ``loop=N`` (N > 1) replays the whole file set N times: each pass
+    re-opens the readers (fresh segment state) and EVERY sequence is
+    renumbered ``time_tag = pass * nfiles + ordinal`` — unique and
+    strictly increasing regardless of what tags the recording carried
+    (recorded tags may be timestamps; reusing them on pass 0 while
+    assigning counters later would collide or interleave).  Later
+    passes additionally suffix the sequence name with ``.loopN`` so
+    downstream sinks/serializers keep the passes apart.
+
+    ``restamp=True`` strips the RECORDED trace context from every
+    replayed header so the source stamps a fresh one at commit
+    (``ensure_trace_context``): each pass becomes its own traceable
+    stream whose capture-to-exit SLO ages measure THIS replay, not
+    the age of the recording.  Off by default for checkpoint/resume
+    fidelity (the replay then carries the original identity); the
+    service tier turns it on."""
+
+    def __init__(self, filenames, gulp_nframe, *args, loop=1,
+                 restamp=False, **kwargs):
+        base = [f[:-len('.bf.json')] if f.endswith('.bf.json') else f
+                for f in filenames]
+        self.loop = max(int(loop or 1), 1)
+        self.restamp = bool(restamp)
+        self._nbase = len(base)
+        # loop == 1 keeps the bare names (checkpoint/resume fidelity:
+        # headers pass through verbatim); looped replay tags every
+        # sourcename with (pass, ordinal) so renumbering is
+        # deterministic even when the same file repeats in the set
+        if self.loop == 1:
+            names = list(base)
+        else:
+            names = ['%s%s%d.%d' % (n, _LOOP_SEP, i, j)
+                     for i in range(self.loop)
+                     for j, n in enumerate(base)]
         super(DeserializeBlock, self).__init__(names, gulp_nframe,
                                                *args, **kwargs)
 
+    @staticmethod
+    def _split_loop(sourcename):
+        """(basename, pass_index, ordinal) from a (possibly suffixed)
+        sourcename."""
+        if _LOOP_SEP in sourcename:
+            base, _, idx = sourcename.rpartition(_LOOP_SEP)
+            i, _, j = idx.partition('.')
+            if i.isdigit() and j.isdigit():
+                return base, int(i), int(j)
+        return sourcename, 0, 0
+
     def create_reader(self, sourcename):
-        return _DeserializeReader(sourcename)
+        return _DeserializeReader(self._split_loop(sourcename)[0])
 
     def on_sequence(self, reader, sourcename):
-        return [dict(reader.header)]
+        hdr = dict(reader.header)
+        _base, i, j = self._split_loop(sourcename)
+        if self.loop > 1:
+            # renumber EVERY pass (recorded tags may be arbitrary
+            # timestamps — mixing them with assigned counters could
+            # collide or go backwards): pass-major, strictly
+            # increasing, unique
+            hdr['time_tag'] = i * self._nbase + j
+            if i:
+                hdr['name'] = '%s.loop%d' % (hdr.get('name',
+                                                     'sequence'), i)
+        if self.restamp:
+            # fresh per-loop trace context: the source stamps a new id
+            # + origin timestamp at commit (header_standard)
+            hdr.pop('_trace', None)
+        return [hdr]
 
     def on_data(self, reader, ospans):
         ospan = ospans[0]

@@ -210,6 +210,40 @@ Distributed-observability counters (docs/observability.md
 - ``jaxprof.captures``                     one-shot BF_JAX_PROFILE
                                            gulp captures taken
                                            (telemetry.profiling)
+
+Multi-tenant service counters (bifrost_tpu.service — docs/service.md):
+
+- ``service.submitted`` /
+  ``service.admission.rejected``           tenant jobs admitted /
+                                           refused at submit time
+                                           (capacity, duplicate id,
+                                           BF-E21x spec errors)
+- ``service.<id>.admitted_gulps`` /
+  ``service.<id>.admitted_bytes``          traffic the tenant's quota
+                                           gate admitted (the
+                                           per-tenant throughput
+                                           ledger)
+- ``service.<id>.quota_shed_gulps`` /
+  ``service.<id>.quota_shed_bytes``        gulps a 'shed'-policy quota
+                                           refused (counted loss at
+                                           the ingest boundary)
+- ``service.warm.hits`` /
+  ``service.warm.rejected_stale``          warm starts granted /
+                                           refused for a stale plan-
+                                           signature mismatch
+- ``service.affinity.applied`` /
+  ``service.affinity.skipped``             per-block core assignments
+                                           the partitioner applied /
+                                           could not (empty pool)
+- ``fused.plan_builds`` /
+  ``fused.plan_depot_hits``                FusedBlock plan traces+
+                                           compiles vs warm-start
+                                           depot replays (a warm job's
+                                           build delta is ZERO)
+- ``autotune.profile_adoptions``           knob profiles pinned onto a
+                                           new pipeline by
+                                           autotune.adopt_profile
+                                           (service warm starts)
 """
 
 from __future__ import annotations

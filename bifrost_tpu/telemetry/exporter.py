@@ -151,6 +151,22 @@ def _device_stats():
     return out
 
 
+def _tenant_section():
+    """The multi-tenant service tier's per-tenant rollups
+    (bifrost_tpu.service.telemetry_section — docs/service.md), or {}
+    when no service is live in this process.  Gated on the module
+    already being imported, like the jax device stats: a snapshot
+    must not drag the service layer in."""
+    import sys
+    if 'bifrost_tpu.service' not in sys.modules:
+        return {}
+    try:
+        from .. import service
+        return service.telemetry_section()
+    except Exception:
+        return {}
+
+
 #: mesh counter prefixes folded into the snapshot's 'mesh' summary
 _MESH_KEYS = ('mesh.reshards', 'mesh.reshard_bytes',
               'mesh.sharded_commits', 'mesh.layout_mismatch',
@@ -179,6 +195,8 @@ def snapshot(pipeline=None, rates=False):
          'rings':      {name: {tail,head,size,...,fill}},
          'devices':    {index: {platform,bytes_in_use,bytes_limit,...}},
          'mesh':       {reshards,sharded_commits,collectives,...},
+         'tenants':    {tenant_id: {state,health,gulps,bytes,
+                        quota_shed_*,ring_shed_*,slo,...}},
          'rates':      {dt, counters: {name: per_s},
                         histograms: {name: {count_per_s, sum_per_s}}}}
 
@@ -219,6 +237,7 @@ def snapshot(pipeline=None, rates=False):
         'rings': _ring_occupancy(pipeline),
         'devices': _device_stats(),
         'mesh': _mesh_summary(counts),
+        'tenants': _tenant_section(),
         'identity': identity,
     }
     if rates:
@@ -291,6 +310,39 @@ def prometheus_text(snap=None):
                 lines.append('bifrost_tpu_device_bytes{device="%s",'
                              'kind="%s"} %d' % (_esc(idx), kind,
                                                 d[key]))
+    # tenant-labeled series (the multi-tenant service tier,
+    # docs/service.md): one gauge family keyed {tenant,kind} plus a
+    # one-hot health-state family, so per-tenant dashboards need no
+    # name parsing
+    tenants = snap.get('tenants', {})
+    if tenants:
+        lines.append('# TYPE bifrost_tpu_tenant gauge')
+        lines.append('# TYPE bifrost_tpu_tenant_health gauge')
+    for tid in sorted(tenants):
+        d = tenants[tid]
+        label = _esc(tid)
+        for key in ('gulps', 'bytes', 'quota_shed_gulps',
+                    'quota_shed_bytes', 'ring_shed_gulps',
+                    'ring_shed_bytes', 'warm'):
+            v = d.get(key)
+            if isinstance(v, (int, float)):
+                # ledger counters are exact integers — %d like every
+                # other counter series (%g would quantize past ~6
+                # significant digits and stair-step rate() queries)
+                lines.append('bifrost_tpu_tenant{tenant="%s",'
+                             'kind="%s"} %d' % (label, key, int(v)))
+        slo = d.get('slo') or {}
+        p99 = slo.get('exit_age_p99_s')
+        if isinstance(p99, (int, float)):
+            lines.append('bifrost_tpu_tenant{tenant="%s",'
+                         'kind="exit_age_p99_s"} %g' % (label, p99))
+        if isinstance(slo.get('violations'), (int, float)):
+            lines.append('bifrost_tpu_tenant{tenant="%s",'
+                         'kind="slo_violations"} %g'
+                         % (label, slo['violations']))
+        lines.append('bifrost_tpu_tenant_health{tenant="%s",'
+                     'state="%s"} 1' % (label,
+                                        _esc(d.get('health', '?'))))
     return '\n'.join(lines) + '\n'
 
 

@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""bf_serve: run N tenant pipelines as one multi-tenant service
+(bifrost_tpu.service — docs/service.md).
+
+    python tools/bf_serve.py spec.json [--duration S] [--validate]
+    python tools/bf_serve.py spec.json --validate     # static only
+
+The spec file is JSON::
+
+    {"max_tenants": 8,                     # optional
+     "tenants": [
+       {"id": "replay0",
+        "source": {"kind": "replay", "basenames": ["rec/pulses"],
+                   "gulp_nframe": 256, "loop": 4,
+                   "restamp": true},
+        "priority": 2, "ncores": 2,
+        "quota_bytes_per_s": 50e6, "quota_policy": "pace",
+        "slo_ms": 250,
+        "sink": "discard"},
+       {"id": "cap0",
+        "source": {"kind": "udp", "port": 12345, "nsrc": 4,
+                   "payload": 4096, "buffer_ntime": 512},
+        "gulp_nframe": 256, "overload_policy": "drop_oldest",
+        "quota_bytes_per_s": 100e6}
+     ]}
+
+Source kinds: ``replay`` (blocks/serialize.py recordings, looped with
+per-loop renumbering + trace restamp), ``file`` (flat binary),
+``synthetic`` (paced deterministic stream), ``udp`` (live packet
+capture — the service owns the capture pump).  Sinks: ``discard``
+(default) or ``serialize`` (re-record the admitted stream).
+
+``--validate`` runs the static service verifier
+(``analysis.verify.verify_service``: BF-E210 duplicate tenant /
+BF-E211 quota below one gulp / BF-W212 core oversubscription), builds
+every tenant pipeline, and lints each with the pipeline verifier —
+without running anything.  Exit 3 on any BF-E.
+
+Without ``--validate`` the service runs until every tenant finishes
+(or ``--duration`` elapses), then prints the final per-tenant rollup
+(the same dict ``telemetry.snapshot()['tenants']`` carries) as JSON.
+Watch it live in another terminal: ``tools/like_top.py`` renders the
+``[tenants]`` pane from the ``service/tenants`` ProcLog.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from bifrost_tpu import service  # noqa: E402
+from bifrost_tpu.analysis import verify  # noqa: E402
+
+
+def load_spec(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or not doc.get('tenants'):
+        raise SystemExit('bf_serve: spec must be a JSON object with a '
+                         'non-empty "tenants" list')
+    specs = [service.TenantSpec.coerce(t) for t in doc['tenants']]
+    return doc, specs
+
+
+def validate(doc, specs):
+    diags = verify.verify_service(specs)
+    for d in diags:
+        print('bf_serve: %r' % d)
+    nerr = sum(1 for d in diags if d.is_error)
+    if nerr:
+        print('bf_serve: %d spec error(s); not building' % nerr)
+        return 3
+    mgr = service.JobManager(
+        max_tenants=int(doc.get('max_tenants', 0) or
+                        max(len(specs), 8)),
+        warm=False)
+    total_err = 0
+    try:
+        for s in specs:
+            try:
+                job = mgr.submit(s)
+            except service.ServiceError as exc:
+                # a spec-level admission refusal (capacity, duplicate)
+                # is a lint finding here, not a crash
+                total_err += 1
+                print('bf_serve: tenant %-16s REJECTED: %s'
+                      % (s.id, exc))
+                continue
+            pdiags = job.pipeline.validate()
+            errs = [d for d in pdiags if d.is_error]
+            total_err += len(errs)
+            print('bf_serve: tenant %-16s %d diagnostic(s), '
+                  '%d error(s)' % (s.id, len(pdiags), len(errs)))
+            for d in pdiags:
+                if d.severity != 'info':
+                    print('    %r' % d)
+    finally:
+        # release build side effects (a 'udp' tenant binds its
+        # capture port at build time) — validation must leave nothing
+        # behind
+        for job in mgr.jobs():
+            try:
+                job.stop(0)
+            except Exception:
+                pass
+    print('bf_serve: validate %s (%d tenant(s), %d error(s))'
+          % ('PASS' if total_err == 0 else 'FAIL', len(specs),
+             total_err))
+    return 0 if total_err == 0 else 3
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('spec', help='service spec JSON file')
+    ap.add_argument('--duration', type=float, default=None,
+                    help='stop the service after this many seconds '
+                         '(default: run until every tenant finishes)')
+    ap.add_argument('--validate', action='store_true',
+                    help='static spec + pipeline verification only')
+    args = ap.parse_args()
+
+    doc, specs = load_spec(args.spec)
+    if args.validate:
+        return validate(doc, specs)
+
+    mgr = service.JobManager(
+        max_tenants=int(doc.get('max_tenants', 0) or
+                        max(len(specs), 8)))
+    for s in specs:
+        try:
+            job = mgr.submit(s)
+        except service.ServiceError as exc:
+            print('bf_serve: tenant %r rejected: %s' % (s.id, exc))
+            mgr.shutdown()
+            return 3
+        print('bf_serve: admitted tenant %-16s cores=%s warm=%s'
+              % (s.id, job.cores, 'yes' if job.warm else 'no'))
+    mgr.start()
+    try:
+        if args.duration:
+            deadline = time.monotonic() + args.duration
+            while time.monotonic() < deadline and any(
+                    j.state == 'RUNNING' for j in mgr.jobs()):
+                time.sleep(0.25)
+        else:
+            mgr.wait()
+    except KeyboardInterrupt:
+        print('bf_serve: interrupted; shutting tenants down')
+    finally:
+        mgr.shutdown()
+    out = service.telemetry_section()
+    print(json.dumps(out, indent=1, sort_keys=True, default=str))
+    failed = [tid for tid, d in out.items()
+              if d.get('state') == 'FAILED']
+    if failed:
+        print('bf_serve: %d tenant(s) FAILED: %s'
+              % (len(failed), ', '.join(failed)))
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

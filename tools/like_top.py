@@ -173,16 +173,18 @@ def get_device_memory_usage(timeout=10.0):
     return data
 
 
-def collect_blocks(pids=None, autotune=None, health=None, fabric=None):
+def collect_blocks(pids=None, autotune=None, health=None, fabric=None,
+                   tenants=None):
     """Per-block rows across pipelines: pid/name/cmd/core and the perf
     times (reference: like_top.py:305-330).  Pass a dict as
     ``autotune`` to collect each process's ``analysis/autotune`` knob
     panel — as ``health`` its ``pipeline/health`` state row
-    (docs/robustness.md) — and as ``fabric`` its ``fabric/health``
-    membership/end-to-end row (docs/fabric.md) — from the SAME proclog
-    walk (a separate collect pass would re-parse every proclog file
-    per refresh).  ``pids`` entries may be bare PIDs or fabric
-    instance strings (``<pid>@<host>.<role>``)."""
+    (docs/robustness.md) — as ``fabric`` its ``fabric/health``
+    membership/end-to-end row (docs/fabric.md) — and as ``tenants``
+    its ``service/tenants`` multi-tenant pane (docs/service.md) —
+    from the SAME proclog walk (a separate collect pass would
+    re-parse every proclog file per refresh).  ``pids`` entries may be
+    bare PIDs or fabric instance strings (``<pid>@<host>.<role>``)."""
     rows = {}
     for pid in (pids if pids is not None else list_pipelines()):
         contents = proclog.load_by_pid(pid)
@@ -198,6 +200,10 @@ def collect_blocks(pids=None, autotune=None, health=None, fabric=None):
             frow = contents.get('fabric', {}).get('health')
             if frow:
                 fabric[pid] = frow
+        if tenants is not None:
+            trow = contents.get('service', {}).get('tenants')
+            if trow:
+                tenants[pid] = trow
         cmd = get_command_line(pid)
         for block, logs in contents.items():
             if block == 'rings':
@@ -263,7 +269,7 @@ def collect_autotune(pids=None):
 
 def render_text(load, cpu, mem, dev, rows, tuners=None,
                 sort_key='process', sort_rev=True, width=140,
-                health=None, fabric=None):
+                health=None, fabric=None, tenants=None):
     """Render the full display as text lines (shared by --once and the
     curses loop)."""
     host = socket.gethostname()
@@ -351,6 +357,31 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
                       else '',
                       ('  e2e_age_p99 %.1fms' % _num(e2e))
                       if e2e not in (None, '') else ''))
+    # multi-tenant service pane (service/tenants ProcLog, published by
+    # the JobManager — docs/service.md): one row per tenant job with
+    # its state, health, admitted gulps, quota sheds, warm-start flag
+    # and exit-age p99
+    for pid in sorted(tenants or {}, key=str):
+        t = tenants[pid]
+        ids = sorted({k.split('.', 2)[1] for k in t
+                      if k.startswith('t.') and k.count('.') >= 2})
+        out.append('')
+        out.append('[tenants] pid %s  %s tenant(s)'
+                   % (pid, t.get('ntenants', len(ids))))
+        if ids:
+            out.append('   %-16s %-9s %-9s %8s  %8s  %4s  %9s'
+                       % ('tenant', 'state', 'health', 'gulps',
+                          'q_shed', 'warm', 'age99(ms)'))
+        for tid in ids:
+            def f(field, default=''):
+                return t.get('t.%s.%s' % (tid, field), default)
+            age = f('age99_ms', None)
+            out.append('   %-16s %-9s %-9s %8s  %8s  %4s  %9s'
+                       % (tid[:16], f('state', '?'), f('health', '?'),
+                          f('gulps', 0), f('q_shed', 0),
+                          'yes' if _num(f('warm', 0)) else 'no',
+                          ('%.1f' % _num(age)) if age not in
+                          (None, '') else '-'))
     # live auto-tuner knob panel (analysis/autotune ProcLog, fed by
     # the autotune.* counters — docs/autotune.md)
     for pid in sorted(tuners or {}, key=str):
@@ -397,19 +428,21 @@ def run_curses(args):
                 sort_key = new_key
             now = time.time()
             if now - t_last > args.interval or state is None:
-                tuners, health, fab = {}, {}, {}
+                tuners, health, fab, tens = {}, {}, {}, {}
                 state = (get_load_average(), get_processor_usage(),
                          get_memory_swap_usage(),
                          get_device_memory_usage() if args.devices
                          else None,
                          collect_blocks(autotune=tuners,
-                                        health=health, fabric=fab),
-                         tuners, health, fab)
+                                        health=health, fabric=fab,
+                                        tenants=tens),
+                         tuners, health, fab, tens)
                 t_last = now
             maxy, maxx = scr.getmaxyx()
             lines = render_text(*state[:6], sort_key=sort_key,
                                 sort_rev=sort_rev, width=maxx,
-                                health=state[6], fabric=state[7])
+                                health=state[6], fabric=state[7],
+                                tenants=state[8])
             for y, line in enumerate(lines[:maxy - 1]):
                 attr = curses.A_REVERSE if line.startswith('   PID') \
                     else curses.A_NORMAL
@@ -441,13 +474,15 @@ def main():
     if args.once:
         get_processor_usage()        # prime the delta state
         time.sleep(0.05)
-        tuners, health, fab = {}, {}, {}
+        tuners, health, fab, tens = {}, {}, {}, {}
         lines = render_text(
             get_load_average(), get_processor_usage(),
             get_memory_swap_usage(),
             get_device_memory_usage() if args.devices else None,
-            collect_blocks(autotune=tuners, health=health, fabric=fab),
-            tuners, sort_key=args.sort, health=health, fabric=fab)
+            collect_blocks(autotune=tuners, health=health, fabric=fab,
+                           tenants=tens),
+            tuners, sort_key=args.sort, health=health, fabric=fab,
+            tenants=tens)
         print('\n'.join(lines))
         return 0
     run_curses(args)

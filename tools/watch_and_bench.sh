@@ -47,6 +47,15 @@
 #                               no deadlock, no silent loss, health
 #                               SHEDDING->OK, p99 under BF_SLO_MS;
 #                               tools/chaos_gate.py)
+#   SERVICE_${ROUND}.json     - multi-tenant service gate (config 18 on
+#                               CPU: 3 concurrent tenant jobs — replay
+#                               + file ingest + synthetic capture —
+#                               with paced quotas enforced within 10%,
+#                               a BF_FAULTS-killed tenant contained
+#                               (survivors DONE/OK, zero cross-tenant
+#                               shed/poison), and a warm job start
+#                               >= 2x faster than cold with 0
+#                               recompiles; tools/service_gate.py)
 #   FABRIC_CHAOS_${ROUND}.json - fabric chaos gate (config 17 on CPU:
 #                               a 4-process loopback fabric survives a
 #                               SIGKILL'd capture host — rejoin replays
@@ -274,6 +283,24 @@ for i in $(seq 1 400); do
         if [ "$frc_gate" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) fabric chaos gate FAILED" >> "$LOG"
           exit "$frc_gate"
+        fi
+      fi
+      # Multi-tenant service gate: config 18 on CPU — the JobManager
+      # must run 3 concurrent tenant jobs with byte-correct outputs,
+      # contain a BF_FAULTS-killed tenant (survivors DONE with health
+      # OK, zero cross-tenant shed/poison), enforce the paced
+      # per-tenant quotas within 10% of spec, and warm-start a
+      # resubmitted topology >= 2x faster than cold with ZERO
+      # recompiles (tools/service_gate.py; docs/service.md).  Writes
+      # SERVICE_${ROUND}.json.
+      if [ "${BF_SKIP_SERVICE_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) multi-tenant service gate (config 18, CPU)" >> "$LOG"
+        python tools/service_gate.py --out "SERVICE_${ROUND}.json" >> "$LOG" 2>&1
+        src_gate=$?
+        echo "$(date -u +%FT%TZ) service gate rc=$src_gate" >> "$LOG"
+        if [ "$src_gate" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) multi-tenant service gate FAILED" >> "$LOG"
+          exit "$src_gate"
         fi
       fi
       # Mesh-resident pipeline gate: config 11 on an 8-device
