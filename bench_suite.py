@@ -3306,6 +3306,367 @@ def bench_service(overlap_floor_s=0.3):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# config 19: distributed FX correlator flagship (quantized X-engine +
+# cross-chip channelizer + corner-turn collective — docs/perf.md "FX
+# correlator"); gated by tools/fxcorr_gate.py into BENCH_FXCORR_*.json
+# and the MULTICHIP_*_fxcorr.json mesh-scaling row
+# ---------------------------------------------------------------------------
+
+def bench_fxcorr(reps=3, ngulp=12):
+    """End-to-end FX correlator: ci8 stations -> F (fft fine->freq) ->
+    requantize ci8 -> X (CorrelateStageBlock, raced X-engine) ->
+    accumulate -> host, run four ways:
+
+    - ``f32``     — X-engine forced onto the complex64 XLA baseline
+                    (impl='xla'), segments off;
+    - ``quant``   — accuracy='int8': the exact-int32 candidates
+                    (int8_3mm / int8_wide / pallas) race under mprobe
+                    against the float lowerings; segments off;
+    - ``segment`` — the quant chain under BF_SEGMENTS=force: capture
+                    -> F -> quantize -> X -> accumulate as ONE
+                    compiled program, member blocks dispatching ZERO
+                    times (config-16 accounting);
+    - ``mesh``    — the stateful CorrelateBlock striped over a device
+                    mesh, psum plan vs the corner-turn collective
+                    (BF_XCORR_CORNER_TURN=xla), both byte-compared to
+                    the single-device run.  Skipped below 2 devices.
+
+    Every arm must be BYTE-IDENTICAL to the sequential oracle: the
+    same eager jnp.fft + quantize math, then an int64 numpy
+    correlation — the X step's integer sums (<= R*2*127^2 per
+    integration) are exactly representable in complex64, so even the
+    f32 arm admits no tolerance.  Per-arm minima over ``reps``
+    interleaved repetitions, order alternating (configs 9/11/16)."""
+    import sys as _sys
+    import os as _os
+    _tests = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests')
+    if _tests not in _sys.path:
+        _sys.path.insert(0, _tests)
+    import jax
+    import bifrost_tpu as bf
+    from bifrost_tpu.telemetry import counters
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    bf.enable_compilation_cache()
+    NT, NW, NS, NP = 32, 64, 32, 2      # frames/gulp, window, stations, pols
+    R, A, K = 8, 4, 4                   # frames/vis, vis/output, macro K
+    n = NS * NP
+    nbl = NS * (NS + 1) // 2
+    scale = 1. / NW
+    rng = np.random.RandomState(19)
+    raw = np.zeros((NT, NW, NS, NP), dtype=np.dtype([('re', 'i1'),
+                                                     ('im', 'i1')]))
+    raw['re'] = rng.randint(-64, 64, raw.shape)
+    raw['im'] = rng.randint(-64, 64, raw.shape)
+    hdr = simple_header([-1, NW, NS, NP], 'ci8',
+                        labels=['time', 'fine', 'station', 'pol'])
+
+    def oracle():
+        """Sequential reference: eager F + quantize (the same XLA fft
+        custom-call the pipeline lowers to, so rounding ties agree),
+        then the X step in numpy int64 — no pipeline, no segments."""
+        import jax.numpy as jnp
+        v = raw['re'].astype(np.float32) + \
+            1j * raw['im'].astype(np.float32)
+        F = np.asarray(jnp.fft.fft(jnp.asarray(v), axis=1)) * \
+            np.float32(scale)
+        qr = np.clip(np.round(F.real), -128, 127).astype(np.int64)
+        qi = np.clip(np.round(F.imag), -128, 127).astype(np.int64)
+        qr = qr.reshape(NT // R, R, NW, n)
+        qi = qi.reshape(NT // R, R, NW, n)
+        re = np.einsum('grfi,grfj->gfij', qr, qr) + \
+            np.einsum('grfi,grfj->gfij', qi, qi)
+        im = np.einsum('grfi,grfj->gfij', qi, qr) - \
+            np.einsum('grfi,grfj->gfij', qr, qi)
+        vis = (re + 1j * im).astype(np.complex64)
+        nvis = vis.shape[0]
+        vis = vis.reshape(nvis // A, A, NW, n, n).sum(axis=1)
+        vis = vis.astype(np.complex64).reshape(
+            nvis // A, NW, NS, NP, NS, NP)
+        return np.concatenate([vis] * ngulp, axis=0)
+
+    arm_specs = ('f32', 'quant', 'segment')
+
+    def engine_microbench():
+        """The flagship X-engine number: every candidate timed on int8
+        voltage planes at the bench channel count (config-5's chained
+        fori_loop policy, so the GEMMs carry a true loop dependency
+        and the tunnel dispatch amortizes).  The chain arms above time
+        the PIPELINE (their walls fold in the mprobe race, ring
+        handoffs and host copies); the race verdict itself — does the
+        quantized-class winner beat the complex64 baseline — is
+        measured here, at the engine, where the claim lives."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from bifrost_tpu.ops.linalg import _XENGINE_IMPLS
+        on_tpu = jax.default_backend() == 'tpu'
+        T = 256 if on_tpu else 64
+        Kc = 4 if on_tpu else 2
+        mrng = np.random.RandomState(5)
+        mre = jnp.asarray(mrng.randint(-64, 64,
+                                       (T, NW, n)).astype(np.int8))
+        mim = jnp.asarray(mrng.randint(-64, 64,
+                                       (T, NW, n)).astype(np.int8))
+        per_impl = {}
+        for name, impl in sorted(_XENGINE_IMPLS.items()):
+            if name == 'pallas' and not on_tpu:
+                per_impl[name] = {'skipped': 'tpu-only'}
+                continue
+
+            def body(i, carry, impl=impl):
+                # float 0*x is not foldable: true loop dependency
+                r = mre + (carry[0, 0, 0] *
+                           jnp.float32(0.0)).astype(jnp.int8)
+                vis = impl(r, mim)
+                return 0.5 * carry + vis.real + vis.imag
+
+            x0 = jnp.zeros((NW, n, n), jnp.float32)
+            fn = jax.jit(lambda x, body=body:
+                         lax.fori_loop(0, Kc, body, x))
+            try:
+                t = _bench_fn(fn, x0, iters=3) / Kc
+            except Exception as e:
+                per_impl[name] = {'error': '%s: %s'
+                                  % (type(e).__name__, str(e)[:120])}
+                continue
+            per_impl[name] = {
+                'ms': round(t * 1e3, 3),
+                'gops_per_s': round(8.0 * T * NW * n * n / t / 1e9,
+                                    2)}
+        timed = {k: v for k, v in per_impl.items() if 'ms' in v}
+        if not timed:
+            return {'per_impl': per_impl, 'error': 'all impls failed'}
+        best = min(timed, key=lambda k: timed[k]['ms'])
+        out = {'per_impl': per_impl, 'winner': best,
+               'gops_per_s': timed[best]['gops_per_s'],
+               'frames_per_call': T}
+        if 'xla' in timed:
+            out['xla_gops_per_s'] = timed['xla']['gops_per_s']
+            out['quant_beats_f32'] = bool(
+                best != 'xla' and
+                timed[best]['ms'] < timed['xla']['ms'])
+        return out
+
+    def run_arm(arm):
+        counters.reset()
+        seg_mode = 'force' if arm == 'segment' else 'off'
+        acc = 'f32' if arm == 'f32' else 'int8'
+        impl = 'xla' if arm == 'f32' else None
+        probe_prev = os.environ.get('BF_LINALG_PROBE')
+        if arm != 'f32':
+            os.environ['BF_LINALG_PROBE'] = '1'
+        try:
+            with bf.Pipeline(gulp_batch=K, sync_depth=4,
+                             segments=seg_mode) as p:
+                src = NumpySourceBlock(
+                    [raw.copy() for _ in range(ngulp)], hdr,
+                    gulp_nframe=NT)
+                b = bf.blocks.copy(src, space='tpu')
+                b = bf.blocks.fft(b, axes='fine', axis_labels='freq')
+                b = bf.blocks.quantize(b, 'ci8', scale=scale)
+                corr = bf.blocks.correlate(b, R, accuracy=acc,
+                                           impl=impl, fusable=True)
+                b = bf.blocks.accumulate(corr, A, fusable=True)
+                b2 = bf.blocks.copy(b, space='system')
+                sink = GatherSink(b2)
+                t0 = time.perf_counter()
+                p.run()
+                dt = time.perf_counter() - t0
+        finally:
+            if probe_prev is None:
+                os.environ.pop('BF_LINALG_PROBE', None)
+            else:
+                os.environ['BF_LINALG_PROBE'] = probe_prev
+        snap = counters.snapshot()
+        chain = ('FftBlock', 'QuantizeBlock', 'CorrelateStageBlock',
+                 'AccumulateStageBlock', 'Segment')
+        disp = member_disp = 0
+        for name, v in snap.items():
+            if name.startswith('block.') and \
+                    name.endswith('.dispatches') and \
+                    any(c in name for c in chain):
+                disp += v
+                if 'Segment' not in name:
+                    member_disp += v
+        winner = None
+        try:
+            winner = sorted(set(corr.engine.chosen.values()))
+        except Exception:
+            pass
+        stats = {
+            'device_chain_dispatches': disp,
+            'member_dispatches': member_disp,
+            'segment_dispatches': snap.get('segment.dispatches', 0),
+            'segment_elided_rings': snap.get('segment.elided_rings',
+                                             0),
+            'segments_compiled': snap.get('segment.compiled', 0),
+        }
+        return dt, stats, sink.result(), winner
+
+    times = {a: [] for a in arm_specs}
+    stats = {a: None for a in arm_specs}
+    outputs, winners = {}, {}
+    for rep in range(max(reps, 1)):
+        order = list(arm_specs) if rep % 2 == 0 \
+            else list(reversed(arm_specs))
+        for arm in order:
+            dt, st, out, win = run_arm(arm)
+            times[arm].append(dt)
+            stats[arm] = st
+            outputs.setdefault(arm, out)
+            if win:
+                winners[arm] = win
+    want = oracle()
+    nframes = ngulp * NT
+    ops_total = 8.0 * NW * n * n * nframes      # cmac = 8 real ops
+    bl_chan = ngulp * (NT // R) * nbl * NW      # baseline-channels out
+    arms = {}
+    for arm in arm_specs:
+        tmin = min(times[arm])
+        arms[arm] = dict(stats[arm],
+                         ms_min=round(tmin * 1e3, 1),
+                         ms_all=[round(t * 1e3, 1)
+                                 for t in times[arm]],
+                         gops_per_s=round(ops_total / tmin / 1e9, 2),
+                         bl_chan_per_s=round(bl_chan / tmin, 0),
+                         oracle_identical=bool(np.array_equal(
+                             outputs[arm], want)))
+        if arm in winners:
+            arms[arm]['winner'] = winners[arm]
+    seg = stats['segment']
+    t_f32, t_q = min(times['f32']), min(times['quant'])
+    paired_quant = float(np.median(
+        [q / f for q, f in zip(times['quant'], times['f32'])]))
+    deterministic = bool(
+        np.array_equal(outputs['f32'], outputs['quant']) and
+        np.array_equal(outputs['quant'], outputs['segment']))
+    micro = engine_microbench()
+    res = {
+        'config': 'FX correlator: %d stations x %d pols, %d channels, '
+                  '%d-frame integrations x%d accumulated, %d x '
+                  '%d-frame gulps at macro K=%d'
+                  % (NS, NP, NW, R, A, ngulp, NT, K),
+        'value': micro.get('gops_per_s',
+                           round(ops_total / t_q / 1e9, 2)),
+        'unit': 'GOP/s (X-engine race winner at %d channels x n=%d)'
+                % (NW, n),
+        'arms': arms,
+        'xengine': dict(
+            micro,
+            chain_winner=winners.get('quant'),
+            chain_paired_quant_vs_f32=round(paired_quant, 3)),
+        'segment': {
+            'dispatches': seg['member_dispatches'],
+            'segments_compiled': seg['segments_compiled'],
+            'elided_rings': seg['segment_elided_rings'],
+        },
+        'bl_chan_per_s_per_chip': round(bl_chan / t_q, 0),
+        'oracle_identical': bool(all(
+            arms[a]['oracle_identical'] for a in arm_specs)),
+        'deterministic': deterministic,
+        'quant_beats_f32': bool(micro.get('quant_beats_f32', False)),
+        'zero_member_dispatches': bool(
+            seg['member_dispatches'] == 0 and
+            seg['segments_compiled'] >= 1),
+        'devices': 1,
+        'backend': jax.default_backend(),
+        'roofline': {
+            'bound': 'X step is n^2 int8 cmacs/channel against an '
+                     'O(n) F step: compute-bound on the MXU once '
+                     'quantized; the segment arm removes every '
+                     'interior dispatch and ring handoff — docs/'
+                     'perf.md "FX correlator"',
+        },
+    }
+    mesh = _fxcorr_mesh_arm(raw, hdr, NT, NW, NS, NP, nbl, reps)
+    if mesh is not None:
+        res['mesh'] = mesh
+    return res
+
+
+def _fxcorr_mesh_arm(raw, hdr, NT, NW, NS, NP, nbl, reps):
+    """Config-19 mesh arm: the stateful CorrelateBlock (ci8 planes in)
+    striped over all devices — psum meeting point vs the corner-turn
+    collective — byte-compared to the single-device run.  Returns None
+    (arm skipped) below 2 devices or on a non-dividing geometry."""
+    import jax
+    import bifrost_tpu as bf
+    from bifrost_tpu.parallel import create_mesh
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    ndev = jax.device_count()
+    if ndev < 2 or NT % ndev or NW % ndev:
+        return None
+    scale = 1. / NW
+    ngulp = 6
+
+    def run(mesh, corner=None):
+        prev = {k: os.environ.get(k) for k in
+                ('BF_XCORR_CORNER_TURN', 'BF_LINALG_PROBE')}
+        if corner is not None:
+            os.environ['BF_XCORR_CORNER_TURN'] = corner
+        try:
+            with bf.Pipeline() as p:
+                src = NumpySourceBlock(
+                    [raw.copy() for _ in range(ngulp)], hdr,
+                    gulp_nframe=NT)
+                b = bf.blocks.copy(src, space='tpu')
+                b = bf.blocks.fft(b, axes='fine', axis_labels='freq')
+                b = bf.blocks.quantize(b, 'ci8', scale=scale)
+                with bf.block_scope(mesh=mesh):
+                    b = bf.blocks.correlate(
+                        b, nframe_per_integration=NT, accuracy='int8')
+                b = bf.blocks.copy(b, space='system')
+                sink = GatherSink(b)
+                t0 = time.perf_counter()
+                p.run()
+                dt = time.perf_counter() - t0
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return dt, sink.result()
+
+    variants = {'single': lambda: run(None),
+                'psum': lambda: run(create_mesh({'sp': ndev})),
+                'corner': lambda: run(create_mesh({'sp': ndev}),
+                                      corner='xla')}
+    times = {v: [] for v in variants}
+    outputs = {}
+    for rep in range(max(reps, 1)):
+        order = list(variants) if rep % 2 == 0 \
+            else list(reversed(list(variants)))
+        for v in order:
+            dt, out = variants[v]()
+            times[v].append(dt)
+            outputs.setdefault(v, out)
+    bl_chan = ngulp * nbl * NW          # one integration per gulp
+    arms = {}
+    for v in variants:
+        tmin = min(times[v])
+        arms[v] = {
+            'ms_min': round(tmin * 1e3, 1),
+            'bl_chan_per_s': round(bl_chan / tmin, 0),
+            'matches_single': bool(np.array_equal(outputs[v],
+                                                  outputs['single'])),
+        }
+    t_best = min(min(times['psum']), min(times['corner']))
+    return {
+        'n_devices': ndev,
+        'arms': arms,
+        'outputs_match': bool(arms['psum']['matches_single'] and
+                              arms['corner']['matches_single']),
+        'bl_chan_per_s_per_chip': round(bl_chan / t_best / ndev, 0),
+        'corner_vs_psum': round(min(times['corner']) /
+                                min(times['psum']), 3),
+    }
+
+
 ALL = {
     1: bench_sigproc_cpu,
     2: bench_spectroscopy,
@@ -3325,13 +3686,14 @@ ALL = {
     16: bench_segments,
     17: bench_fabric_chaos,
     18: bench_service,
+    19: bench_fxcorr,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-18; 0 = all')
+                    help='config number 1-19; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -3341,7 +3703,7 @@ def main(argv=None):
                     help='flagship pipeline Msamples/s for config 7')
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
-    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16, 18)
+    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16, 18, 19)
                    for c in todo)
     if need_dev:
         from bench import _backend_alive
@@ -3683,6 +4045,39 @@ def _verify_config18():
     return [j.pipeline for j in jobs]
 
 
+def _verify_config19():
+    """The FX-correlator chain (bench_fxcorr): ci8 stations -> F ->
+    requantize -> X (stage-backed, raced X-engine) -> accumulate, at
+    macro K=4.  Built without segments (lint validates the raw graph):
+    the verifier must prove it clean — in particular NO BF-W170, since
+    the X-engine's exact int candidates race at every accuracy class —
+    and report a BF-I190 'disabled' reason at the fusable interior
+    boundaries."""
+    import sys as _sys
+    import os as _os
+    _tests = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), 'tests')
+    if _tests not in _sys.path:
+        _sys.path.insert(0, _tests)
+    import bifrost_tpu as bf
+    from util import NumpySourceBlock, GatherSink, simple_header
+
+    NT, NW, NS, NP = 32, 64, 32, 2
+    raw = np.zeros((NT, NW, NS, NP), dtype=np.dtype([('re', 'i1'),
+                                                     ('im', 'i1')]))
+    hdr = simple_header([-1, NW, NS, NP], 'ci8',
+                        labels=['time', 'fine', 'station', 'pol'])
+    with bf.Pipeline(sync_depth=4, gulp_batch=4) as p:
+        src = NumpySourceBlock([raw.copy()], hdr, gulp_nframe=NT)
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fft(b, axes='fine', axis_labels='freq')
+        b = bf.blocks.quantize(b, 'ci8', scale=1. / NW)
+        b = bf.blocks.correlate(b, 8, accuracy='int8', fusable=True)
+        b = bf.blocks.accumulate(b, 4, fusable=True)
+        GatherSink(bf.blocks.copy(b, space='system'))
+    return p
+
+
 def build_verify_topologies():
     """{name: builder} over every pipeline-shaped bench config.  Each
     builder returns a Pipeline, a list of Pipelines, or None when the
@@ -3700,6 +4095,7 @@ def build_verify_topologies():
         'config16_segments': _verify_config16,
         'config17_fabric': _verify_config17,
         'config18_service': _verify_config18,
+        'config19_fxcorr': _verify_config19,
     }
 
 

@@ -71,6 +71,8 @@ CODES = {
     'BF-I171': 'gulp geometry unknown; ring sizing not proven',
     'BF-I190': 'device-ring boundary did not fuse into a compiled '
                'segment',
+    'BF-I191': 'boundary kept by a cross-device collective schedule '
+               '(correlator corner turn / psum meeting point)',
     'BF-E200': 'fabric link endpoint mismatch',
     'BF-E201': 'fabric port collision',
     'BF-W202': 'fabric link window/stripe sizing hazard',
@@ -787,13 +789,15 @@ def _check_quantization(g, diags):
     ring the header declares as ci8/ci4 — int8 (re, im) planes on
     device, the MXU's ~7x fast path (docs/perf.md ceilings table) —
     but configured so only FLOAT candidates can run: the quantization
-    win is left on the table.  Two ways to get here: the engine's
-    accuracy class excludes the int8 candidates from the race
-    ('f32'/'bf16'), or BF_BEAM_IMPL / ``impl=`` forces a float
-    candidate outright.  (CorrelateBlock engages exact-int xcorr on
-    ci8 automatically, so only engine-carrying beamform stages are
-    checked.)"""
+    win is left on the table.  For a BEAMFORM engine two ways to get
+    here: the accuracy class excludes the int8 candidates from the
+    race ('f32'/'bf16'), or BF_BEAM_IMPL / ``impl=`` forces a float
+    candidate.  For the correlator X-ENGINE the int candidates are
+    EXACT (no weight quantization) and race under every class, so
+    only a forced float impl (BF_XCORR_IMPL / ``impl=``) can disable
+    them — that is the one X-engine misconfiguration flagged."""
     from ..ops import beamform as _beam
+    from ..ops import linalg as _linalg
     for b in g.blocks:
         irings = getattr(b, 'irings', None)
         if not irings:
@@ -811,11 +815,33 @@ def _check_quantization(g, diags):
         stages = list(getattr(b, 'stages', None) or ())
         if getattr(b, '_stage', None) is not None:
             stages.append(b._stage)
+        engines = []
         for s in stages:
             eng = getattr(s, 'engine', None)
-            if eng is None or not hasattr(eng, 'accuracy'):
-                continue
+            if eng is not None and hasattr(eng, 'accuracy'):
+                engines.append(eng)
+        beng = getattr(b, 'engine', None)    # stateful CorrelateBlock
+        if beng is not None and hasattr(beng, 'accuracy') and \
+                beng not in engines:
+            engines.append(beng)
+        for eng in engines:
             forced = getattr(eng, '_force', None)
+            if isinstance(eng, _linalg.XEngine):
+                # exact-int candidates are in the race at EVERY
+                # accuracy class; only a float force disables them
+                if forced and forced not in _linalg._XENGINE_INT_IMPLS:
+                    diags.append(Diagnostic(
+                        'BF-W170',
+                        'block %r X-engine is forced to the %r float '
+                        'candidate on a ring declared %s: the EXACT '
+                        'int32 correlation path (bit-identical to the '
+                        'int64 oracle, docs/perf.md) never engages — '
+                        'force an int candidate (int8_3mm/int8_wide/'
+                        'pallas) or drop the override'
+                        % (b.name, forced, dtype),
+                        block=b.name,
+                        ring=_ring_name(_base(irings[0]))))
+                continue
             if forced in _beam._INT_IMPLS:
                 continue
             if forced is not None:
@@ -1031,8 +1057,13 @@ def _check_segments(g, diags):
                                           None))
     _chains, boundaries = _segments.plan(g.pipeline, mode)
     for b in boundaries:
+        # the collective reason gets its own code: it is not the
+        # generic "one side is host math" story — the block IS device
+        # math but owns a cross-device collective schedule (the
+        # correlator corner turn), so the boundary is structural
+        code = 'BF-I191' if b['reason'] == 'collective' else 'BF-I190'
         diags.append(Diagnostic(
-            'BF-I190',
+            code,
             'ring %r boundary %s -> %s did not fuse into a compiled '
             'segment (reason: %s — %s)'
             % (b['ring'], b['producer'], b['consumer'], b['reason'],

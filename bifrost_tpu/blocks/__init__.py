@@ -10,7 +10,8 @@ from .fft import FftBlock, fft
 from .fftshift import FftShiftBlock, fftshift
 from .detect import DetectBlock, detect
 from .reduce import ReduceBlock, reduce
-from .accumulate import AccumulateBlock, accumulate
+from .accumulate import (AccumulateBlock, AccumulateStageBlock,
+                         accumulate)
 from .scrunch import ScrunchBlock, scrunch
 from .reverse import ReverseBlock, reverse
 from .quantize import QuantizeBlock, quantize
@@ -19,7 +20,7 @@ from .print_header import PrintHeaderBlock, print_header
 from .fused import FusedBlock, fused
 from .beamform import BeamformBlock, beamform
 from .fdmt import FdmtBlock, fdmt
-from .correlate import CorrelateBlock, correlate
+from .correlate import CorrelateBlock, CorrelateStageBlock, correlate
 from .fir import FirBlock, fir
 from .sigproc import (SigprocSourceBlock, SigprocSinkBlock, read_sigproc,
                       write_sigproc)

@@ -3,6 +3,11 @@
 
 On TPU the accumulator is carried as a jax array in the block (functional
 update each gulp); the output span is only published on the commit gulp.
+
+:class:`AccumulateStageBlock` (``accumulate(..., fusable=True)``) is
+the stateless form: it sums ``nframe``-frame groups WITHIN each gulp
+(stages.AccumulateStage), so it is macro-gulp eligible and
+segment-fusable — the FX-correlator chain's visibility integrator.
 """
 
 from __future__ import annotations
@@ -12,8 +17,10 @@ from copy import deepcopy
 from ..pipeline import TransformBlock
 from ..dtype import DataType
 from ..ops.common import complexify
+from ..stages import AccumulateStage
+from .fft import _StageBlock
 
-__all__ = ['AccumulateBlock', 'accumulate']
+__all__ = ['AccumulateBlock', 'AccumulateStageBlock', 'accumulate']
 
 
 class AccumulateBlock(TransformBlock):
@@ -70,6 +77,24 @@ class AccumulateBlock(TransformBlock):
         return 0
 
 
-def accumulate(iring, nframe, dtype=None, *args, **kwargs):
-    """Block: accumulate ``nframe`` frames before outputting one."""
+class AccumulateStageBlock(_StageBlock):
+    """Stage-backed integrator: sums ``nframe``-frame groups WITHIN
+    each gulp (requires nframe | gulp) — macro-gulp eligible and
+    segment-fusable, unlike the stateful AccumulateBlock whose
+    cross-gulp carry pins gulp_nframe=1."""
+
+    def __init__(self, iring, nframe, op='sum', *args, **kwargs):
+        super(AccumulateStageBlock, self).__init__(
+            iring, AccumulateStage(nframe, op=op), *args, **kwargs)
+
+
+def accumulate(iring, nframe, dtype=None, fusable=False, *args,
+               **kwargs):
+    """Block: accumulate ``nframe`` frames before outputting one.
+    ``fusable=True`` returns the stage-backed in-gulp integrator
+    (:class:`AccumulateStageBlock`; ``dtype`` must be None — the
+    stage keeps the input dtype)."""
+    if fusable:
+        assert dtype is None, 'fusable accumulate keeps the input dtype'
+        return AccumulateStageBlock(iring, nframe, *args, **kwargs)
     return AccumulateBlock(iring, nframe, dtype, *args, **kwargs)

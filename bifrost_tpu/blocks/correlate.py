@@ -2,20 +2,43 @@
 (reference: python/bifrost/blocks/correlate.py:36-108, backed by the
 xGPU-style cherk kernel in src/linalg.cu:210-226).
 
-On TPU the per-channel a·a^H rides the MXU; ci8 voltages stay int8 and
-use three int8 matmuls with int32 accumulation (see ops.linalg).  The
+On TPU the per-channel a·a^H rides the MXU through the raced X-engine
+(:class:`bifrost_tpu.ops.linalg.XEngine`): ci8 voltages stay int8 on
+exact-int32 candidates, float voltages race planar layouts against the
+XLA complex64 baseline, all accuracy-gated per the declared class.  The
 output matrix is fully filled (header ``matrix_fill_mode='full'``; the
 reference fills the lower triangle only, a CUDA-kernel economy that a
 systolic matmul does not need).
+
+Two block forms:
+
+- :class:`CorrelateBlock` — stateful: integrates ``nframe_per_integration``
+  frames ACROSS gulps, one output frame per integration.  Under a mesh
+  it runs one of two measured plans: time-parallel partial visibilities
+  met in a ``psum``, or the CORNER TURN — redistribute the voltages
+  from time-sharded to channel-sharded with an on-chip collective
+  (``jax.lax.all_to_all``, or the Pallas ring-permute kernel on TPU)
+  and correlate each channel shard over the full gulp with zero
+  further collectives (``BF_XCORR_CORNER_TURN`` forces a plan; by
+  default the plans race under ops.mprobe at prewarm).
+- :class:`CorrelateStageBlock` — stage-backed
+  (:class:`bifrost_tpu.stages.CorrelateStage`): integrates whole
+  groups WITHIN each gulp, which makes it macro-gulp eligible and
+  segment-fusable (capture -> F -> X -> accumulate as ONE compiled
+  program, bifrost_tpu.segments).
 """
 
 from __future__ import annotations
 
+import os
+
 from copy import deepcopy
 
 from ..pipeline import TransformBlock
+from ..stages import CorrelateStage
+from .fft import _StageBlock
 
-__all__ = ['CorrelateBlock', 'correlate']
+__all__ = ['CorrelateBlock', 'CorrelateStageBlock', 'correlate']
 
 
 def _cross_block(x, xg, reim):
@@ -42,14 +65,40 @@ def _cross_block(x, xg, reim):
     return vis.reshape(f, sr, p, s, p)
 
 
+def _corner_turn_mode():
+    """BF_XCORR_CORNER_TURN: 'auto' (default — race the psum and
+    corner-turn mesh plans at prewarm where probing is on), 'off'
+    (always the psum plan), 'xla' / 'pallas' (force the corner-turn
+    plan with that redistribution primitive)."""
+    v = os.environ.get('BF_XCORR_CORNER_TURN', 'auto').strip().lower()
+    return v if v in ('auto', 'off', 'xla', 'pallas') else 'auto'
+
+
 class CorrelateBlock(TransformBlock):
-    def __init__(self, iring, nframe_per_integration, *args, **kwargs):
+    def __init__(self, iring, nframe_per_integration, accuracy='f32',
+                 impl=None, *args, **kwargs):
         super(CorrelateBlock, self).__init__(iring, *args, **kwargs)
+        from ..ops.linalg import XEngine
         self.nframe_per_integration = nframe_per_integration
+        self.engine = XEngine(accuracy=accuracy, impl=impl)
+        self.accuracy = self.engine.accuracy
         self._fn = {}
+        #: mesh plan the measured prewarm selected ('psum' or
+        #: 'corner:xla' / 'corner:pallas'); published to ProcLog via
+        #: impl_info so monitors read what ran
+        self._mesh_plan = 'psum'
 
     def define_valid_input_spaces(self):
         return ('tpu',)
+
+    @property
+    def _collective_boundary(self):
+        """Segment-planner protocol (bifrost_tpu.segments): under a
+        mesh this block schedules its own cross-device collective
+        (the corner turn or the psum meeting point), so its ring
+        boundaries report reason 'collective' (BF-I191) instead of
+        fusing."""
+        return self.mesh is not None
 
     def define_output_nframes(self, input_nframe):
         return 1
@@ -57,6 +106,7 @@ class CorrelateBlock(TransformBlock):
     def on_sequence(self, iseq):
         self.nframe_integrated = 0
         self._acc = None
+        self._fn = {}
         ihdr = iseq.header
         itensor = ihdr['_tensor']
         assert itensor['labels'] == ['time', 'freq', 'station', 'pol']
@@ -91,140 +141,248 @@ class CorrelateBlock(TransformBlock):
         self._gemm_ops = 8 * gulp_actual * f * (s * p) ** 2
         return ohdr
 
+    # -- mesh plan selection --------------------------------------------
+
+    def _corner_eligible(self, shape, ndev):
+        """The corner-turn plan applies to a purely time-sharded mesh
+        whose device count divides BOTH the frame axis and the channel
+        axis (the all_to_all swaps one for the other)."""
+        return (shape[0] % ndev == 0 and shape[1] % ndev == 0
+                and ndev > 1)
+
+    def _mesh_geometry(self, shape):
+        """(tname, ndev, shard_stations, sname) for this gulp shape, or
+        None when the mesh cannot shard it."""
+        from ..parallel.scope import (time_axis_name, station_axis_name,
+                                      shardable_nframe)
+        mesh = self.mesh
+        if mesh is None or not shardable_nframe(mesh, shape[0]):
+            return None
+        sname = station_axis_name(mesh)
+        shard_stations = (sname is not None and mesh.shape[sname] > 1
+                          and shape[2] % mesh.shape[sname] == 0)
+        tname = time_axis_name(mesh)
+        return tname, mesh.shape[tname], shard_stations, sname
+
+    def _select_mesh_plan(self, shape, dtype, reim):
+        """Choose between the psum and corner-turn mesh plans for this
+        sequence: an explicit BF_XCORR_CORNER_TURN wins; otherwise the
+        two plans race on synthetic data under the mprobe policy (the
+        measurement runs at prewarm, never as first-gulp latency).
+        The psum plan is the unmeasured default."""
+        import numpy as np
+        geo = self._mesh_geometry(shape)
+        if geo is None:
+            return 'psum'
+        tname, ndev, shard_stations, _ = geo
+        if shard_stations or not self._corner_eligible(shape, ndev):
+            return 'psum'
+        mode = _corner_turn_mode()
+        if mode == 'off':
+            return 'psum'
+        from ..ops.beamform import Beamformer
+        pallas_ok = Beamformer._pallas_raceable()
+        if mode in ('xla', 'pallas'):
+            return 'corner:%s' % mode
+        from ..ops.linalg import _probe_wanted
+        if not _probe_wanted():
+            return 'psum'
+        from ..ops import mprobe
+        key = 'v=%s %s ndev=%d acc=%s' % (tuple(shape), dtype, ndev,
+                                          self.accuracy)
+        cached = mprobe.peek('corner_turn', key)
+        names = ['psum', 'corner:xla'] + \
+            (['corner:pallas'] if pallas_ok else [])
+        if cached is not None and cached[0] in names:
+            return cached[0]
+        rng = np.random.RandomState(17)
+        if reim:
+            x = rng.randint(-64, 64, shape).astype(np.int8)
+        else:
+            x = (rng.randn(*shape) +
+                 1j * rng.randn(*shape)).astype(np.complex64)
+        fns = {}
+        for name in names:
+            try:
+                fns[name] = self._build_mesh(tuple(shape), dtype, reim,
+                                             acc_is_none=True, plan=name)
+            except Exception:
+                pass
+        if len(fns) < 2:
+            return 'psum'
+        winner, _ms, _err = mprobe.select(
+            'corner_turn', key, {n: (lambda f: lambda a: f(a, None))(f)
+                                 for n, f in fns.items()},
+            lambda: (x,))
+        return winner or 'psum'
+
     def _prewarm_xcorr(self, itensor, gulp_nframe):
-        """Probe the xcorr layout winner for this sequence's gulp shape
-        now, so on_data's jit trace (where measuring is impossible)
-        finds it in the cache — probe cost must not land as first-gulp
-        latency in a capture pipeline."""
+        """Probe the X-engine winner (and, under a mesh, the mesh-plan
+        winner) for this sequence's gulp shape now, so on_data's jit
+        trace (where measuring is impossible) finds them in the cache —
+        probe cost must not land as first-gulp latency in a capture
+        pipeline."""
         from ..dtype import DataType
         dt = DataType(itensor['dtype'])
-        if not (dt.kind == 'ci' and dt.nbits == 8):
-            return
-        from ..ops.linalg import xcorr_prewarm
+        int_input = dt.kind == 'ci' and dt.nbits == 8
         _, f, s, p = itensor['shape'][:4]
         n = s * p
+        shape = tuple([gulp_nframe] + list(itensor['shape'][1:4]) +
+                      ([2] if int_input else []))
+        dtype = 'int8' if int_input else 'complex64'
         try:
             mesh = self.mesh
-            t_eff = gulp_nframe
-            if mesh is None:
-                xcorr_prewarm(t_eff, f, n)
-                return
-            # mirror _build's mesh sharding: inside shard_map the
-            # traced xcorr sees the per-shard time slice (and, with a
-            # station axis, the per-shard row block vs the gathered
-            # column axis)
-            from ..parallel.scope import (time_axis_name,
-                                          station_axis_name,
-                                          shardable_nframe)
-            if not shardable_nframe(mesh, gulp_nframe):
-                # _build falls through to the plain path: auto shape
-                # at the full gulp
-                xcorr_prewarm(t_eff, f, n)
-                return
-            t_eff = gulp_nframe // mesh.shape[time_axis_name(mesh)]
-            sname = station_axis_name(mesh)
-            if sname is not None and mesh.shape[sname] > 1 \
-                    and s % mesh.shape[sname] == 0:
-                sr = s // mesh.shape[sname]
-                xcorr_prewarm(t_eff, f, sr * p, n)
-            else:
-                xcorr_prewarm(t_eff, f, n)
+            t_eff, f_eff = gulp_nframe, f
+            if mesh is not None:
+                self._mesh_plan = self._select_mesh_plan(shape, dtype,
+                                                         int_input)
+                geo = self._mesh_geometry(shape)
+                if geo is not None:
+                    tname, ndev, shard_stations, sname = geo
+                    if self._mesh_plan.startswith('corner'):
+                        # channel-sharded: full gulp, F/ndev channels
+                        f_eff = f // ndev
+                    else:
+                        t_eff = gulp_nframe // ndev
+                    if shard_stations:
+                        # station-ROW block against the gathered
+                        # column axis rides the 4-operand xcorr race
+                        from ..ops.linalg import xcorr_prewarm
+                        sr = s // mesh.shape[sname]
+                        xcorr_prewarm(t_eff, f, sr * p, n)
+                        return
+            self.engine.prewarm(t_eff, f_eff, n, int_input=int_input)
         except Exception:
             pass    # probing is best-effort; the traced default works
 
-    def _build(self, shape, dtype, reim, acc_is_none):
-        import jax
-        import jax.numpy as jnp
+    def _local_vis_fn(self, reim):
+        engine = self.engine
 
         def local_vis(x):
+            import jax.numpy as jnp
             if reim:
-                # int8 MXU path: x (T, F, S, P, 2); layout/kernel
-                # choice (einsum / pre-transposed GEMM / widened gram)
-                # is measured, see ops.linalg.xcorr_int8
-                from ..ops.linalg import xcorr_int8
                 t, f, s, p = x.shape[:4]
                 re = x[..., 0].reshape(t, f, s * p)
                 im = x[..., 1].reshape(t, f, s * p)
-                vis = xcorr_int8(re, im)
-                vis = vis.reshape(f, s, p, s, p)
             else:
                 t, f, s, p = x.shape
                 xm = x.reshape(t, f, s * p)
-                vis = jnp.einsum('tfi,tfj->fij', xm, jnp.conj(xm),
-                                 preferred_element_type=jnp.complex64)
-                vis = vis.reshape(f, s, p, s, p)
-            return vis
+                re, im = jnp.real(xm), jnp.imag(xm)
+            vis = engine(re, im)
+            return vis.reshape(f, s, p, s, p)
+        return local_vis
+
+    def _build_mesh(self, shape, dtype, reim, acc_is_none, plan):
+        """One sharded mesh plan: 'psum' (time-parallel partial
+        visibilities met in a psum; stations shard too on a 2-D mesh)
+        or 'corner:<impl>' (corner-turn the voltages time-sharded ->
+        channel-sharded, correlate each channel shard over the full
+        gulp, gather the channel axis once).  Returns
+        mesh_fn(x, acc) -> vis, or raises when the plan cannot be
+        built at this geometry."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.ops import _shard_map
+        local_vis = self._local_vis_fn(reim)
+        mesh = self.mesh
+        geo = self._mesh_geometry(shape)
+        if geo is None:
+            raise ValueError('mesh cannot shard gulp %r' % (shape,))
+        tname, ndev, shard_stations, sname = geo
+        spec = [None] * len(shape)
+        spec[0] = tname
+        if plan.startswith('corner'):
+            if shard_stations or not self._corner_eligible(shape, ndev):
+                raise ValueError('corner-turn plan ineligible at %r'
+                                 % (shape,))
+            ct_impl = plan.split(':', 1)[1]
+            from ..parallel.corner_turn import corner_turn_local
+
+            def local_fn(x, acc):
+                # (T/D, F, ...) -> (T, F/D, ...): the on-chip
+                # collective; then a channel-local correlation over
+                # the FULL gulp with no further collectives, and one
+                # gather of the finished channel rows
+                xc = corner_turn_local(x, tname, impl=ct_impl)
+                vis = local_vis(xc)
+                vis = jax.lax.all_gather(vis, tname, axis=0,
+                                         tiled=True)
+                return vis if acc is None else acc + vis
+            out_spec = P()
+        else:
+            if shard_stations:
+                spec[2] = sname
+
+            def local_fn(x, acc):
+                if shard_stations:
+                    # gather the antenna COLUMN axis; rows stay local
+                    xg = jax.lax.all_gather(x, sname, axis=2,
+                                            tiled=True)
+                    vis = _cross_block(x, xg, reim)
+                else:
+                    vis = local_vis(x)
+                vis = jax.lax.psum(vis, tname)
+                return vis if acc is None else acc + vis
+            # output (F, S_row, P, S, P): rows sharded over sname
+            out_spec = P(None, sname, None, None, None) \
+                if shard_stations else P()
+        in_spec = P(*spec)
+        in_sharding = NamedSharding(mesh, in_spec)
+        acc_spec = out_spec
+        shard_map = _shard_map()
+        kw = {}
+        if plan.startswith('corner'):
+            # replication of the all_gathered rows can't be statically
+            # inferred through the corner-turn collective; disable the
+            # check under either shard_map API generation (scope.py
+            # frame_local_plan idiom)
+            import inspect as _inspect
+            params = _inspect.signature(shard_map).parameters
+            if 'check_vma' in params:
+                kw['check_vma'] = False
+            elif 'check_rep' in params:
+                kw['check_rep'] = False
+        if acc_is_none:
+            sharded = jax.jit(shard_map(
+                lambda x: local_fn(x, None), mesh=mesh,
+                in_specs=in_spec, out_specs=out_spec, **kw))
+
+            def mesh_fn(x, acc):
+                return sharded(jax.device_put(x, in_sharding))
+        else:
+            sharded = jax.jit(shard_map(
+                local_fn, mesh=mesh,
+                in_specs=(in_spec, acc_spec),
+                out_specs=out_spec, **kw))
+            acc_sharding = NamedSharding(mesh, acc_spec)
+
+            def mesh_fn(x, acc):
+                acc = jax.device_put(acc, acc_sharding)
+                return sharded(jax.device_put(x, in_sharding),
+                               acc)
+        return mesh_fn
+
+    def _build(self, shape, dtype, reim, acc_is_none):
+        import jax
+        local_vis = self._local_vis_fn(reim)
 
         def fn(x, acc):
             vis = local_vis(x)
             return vis if acc is None else acc + vis
 
         mesh = self.mesh
-        if mesh is not None:
-            # Time-parallel integration over the mesh: each shard
-            # cross-multiplies its time slice, partial visibilities meet
-            # in a psum over the time axis.  On a 2-D mesh with a
-            # station axis ('tp') that divides the station count, the
-            # stations shard too: each rank computes its antenna-ROW
-            # block against the all_gathered antenna axis, so the
-            # visibility matrix itself is distributed (the pattern of
-            # parallel.ops._local_correlate; reference per-GPU
-            # correlator analogue: src/linalg.cu:210-226).
-            from ..parallel.ops import _shard_map
-            from ..parallel.scope import (time_axis_name,
-                                          station_axis_name,
-                                          shardable_nframe)
-            sname = station_axis_name(mesh)
-            nstation = shape[2]
-            shard_stations = (sname is not None and
-                              mesh.shape[sname] > 1 and
-                              nstation % mesh.shape[sname] == 0)
-            if shardable_nframe(mesh, shape[0]):
-                from jax.sharding import NamedSharding
-                from jax.sharding import PartitionSpec as P
-                tname = time_axis_name(mesh)
-                spec = [None] * len(shape)
-                spec[0] = tname
-                if shard_stations:
-                    spec[2] = sname
-                in_spec = P(*spec)
-                in_sharding = NamedSharding(mesh, in_spec)
-                # output (F, S_row, P, S, P): rows sharded over sname
-                out_spec = P(None, sname, None, None, None) \
-                    if shard_stations else P()
-                acc_spec = out_spec
-                shard_map = _shard_map()
-
-                def local_fn(x, acc):
-                    if shard_stations:
-                        # gather the antenna COLUMN axis; rows stay local
-                        xg = jax.lax.all_gather(x, sname, axis=2,
-                                                tiled=True)
-                        vis = _cross_block(x, xg, reim)
-                    else:
-                        vis = local_vis(x)
-                    vis = jax.lax.psum(vis, tname)
-                    return vis if acc is None else acc + vis
-
-                if acc_is_none:
-                    sharded = jax.jit(shard_map(
-                        lambda x: local_fn(x, None), mesh=mesh,
-                        in_specs=in_spec, out_specs=out_spec))
-
-                    def mesh_fn(x, acc):
-                        return sharded(jax.device_put(x, in_sharding))
-                else:
-                    sharded = jax.jit(shard_map(
-                        local_fn, mesh=mesh,
-                        in_specs=(in_spec, acc_spec),
-                        out_specs=out_spec))
-                    acc_sharding = NamedSharding(mesh, acc_spec)
-
-                    def mesh_fn(x, acc):
-                        acc = jax.device_put(acc, acc_sharding)
-                        return sharded(jax.device_put(x, in_sharding),
-                                       acc)
-                return mesh_fn
+        if mesh is not None and self._mesh_geometry(shape) is not None:
+            plan = self._mesh_plan
+            try:
+                return self._build_mesh(shape, dtype, reim,
+                                        acc_is_none, plan)
+            except Exception:
+                if plan != 'psum':      # measured plan failed to
+                    self._mesh_plan = 'psum'   # build: fall back
+                    return self._build_mesh(shape, dtype, reim,
+                                            acc_is_none, 'psum')
+                raise
 
         jfn = jax.jit(fn)
         if mesh is None:
@@ -263,7 +421,56 @@ class CorrelateBlock(TransformBlock):
         return 0
 
 
-def correlate(iring, nframe_per_integration, *args, **kwargs):
+class CorrelateStageBlock(_StageBlock):
+    """Stage-backed X step (:class:`bifrost_tpu.stages.CorrelateStage`):
+    one visibility per ``nframe_per_vis`` frames WITHIN each gulp.
+    Macro-gulp eligible and segment-fusable — the FX flagship chain
+    (capture -> F -> X -> accumulate) compiles to ONE program through
+    the segment compiler when the verifier proves every boundary safe.
+    """
+
+    def __init__(self, iring, nframe_per_vis, accuracy='f32',
+                 impl=None, *args, **kwargs):
+        super(CorrelateStageBlock, self).__init__(
+            iring, CorrelateStage(nframe_per_vis, accuracy=accuracy,
+                                  impl=impl), *args, **kwargs)
+
+    @property
+    def engine(self):
+        return self._stage.engine
+
+    def on_sequence(self, iseq):
+        ohdr = super(CorrelateStageBlock, self).on_sequence(iseq)
+        # eager engine prewarm at the per-group shape (r, f, n): the
+        # vmapped trace inside the stage sees exactly this shape at
+        # EVERY macro factor K, so one probe covers all gulp modes
+        from ..dtype import DataType
+        itensor = iseq.header['_tensor']
+        dt = DataType(itensor['dtype'])
+        _, f, s, p = itensor['shape'][:4]
+        try:
+            self._stage.engine.prewarm(
+                self._stage.nframe_per_vis, f, s * p,
+                int_input=(dt.kind == 'ci' and dt.nbits == 8))
+        except Exception:
+            pass    # probing is best-effort; the traced default works
+        gulp_actual = self.gulp_nframe or iseq.header['gulp_nframe']
+        self._gemm_ops = 8 * gulp_actual * f * (s * p) ** 2
+        return ohdr
+
+
+def correlate(iring, nframe_per_integration, accuracy='f32', impl=None,
+              fusable=False, *args, **kwargs):
     """Block: the X step of an FX correlator (reference docstring:
-    blocks/correlate.py:106-136; xGPU reference arXiv:1107.4264)."""
-    return CorrelateBlock(iring, nframe_per_integration, *args, **kwargs)
+    blocks/correlate.py:106-136; xGPU reference arXiv:1107.4264).
+
+    ``accuracy`` / ``impl`` configure the raced X-engine
+    (ops.linalg.XEngine).  ``fusable=True`` returns the stage-backed
+    :class:`CorrelateStageBlock` (integration within each gulp —
+    macro-gulp eligible, segment-fusable); the default is the
+    stateful :class:`CorrelateBlock` (integration across gulps)."""
+    if fusable:
+        return CorrelateStageBlock(iring, nframe_per_integration,
+                                   accuracy, impl, *args, **kwargs)
+    return CorrelateBlock(iring, nframe_per_integration, accuracy,
+                          impl, *args, **kwargs)

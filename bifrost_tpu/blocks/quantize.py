@@ -1,51 +1,42 @@
 """Quantization block: float -> (packed) int with scale
-(reference: python/bifrost/blocks/quantize.py)."""
+(reference: python/bifrost/blocks/quantize.py).
+
+Device math lives in stages.QuantizeStage, so the block is
+segment-fusable: in the FX-correlator chain the channelizer's cf32
+spectra requantize to ci8 INSIDE the fused program, between the F and
+X steps, and never land in HBM as float.  Host rings use the numpy
+ops.quantize path.
+"""
 
 from __future__ import annotations
 
-from copy import deepcopy
-
-from ..pipeline import TransformBlock
-from ..dtype import DataType
+from ..stages import QuantizeStage
 from .. import ops
-from .copy import to_device_rep
+from .fft import _StageBlock
 
 __all__ = ['QuantizeBlock', 'quantize']
 
 
-class QuantizeBlock(TransformBlock):
+class QuantizeBlock(_StageBlock):
     def __init__(self, iring, dtype, scale=1., *args, **kwargs):
-        super(QuantizeBlock, self).__init__(iring, *args, **kwargs)
-        self.dtype = DataType(dtype)
-        self.scale = scale
+        super(QuantizeBlock, self).__init__(
+            iring, QuantizeStage(dtype, scale), *args, **kwargs)
 
-    def on_sequence(self, iseq):
-        ohdr = deepcopy(iseq.header)
-        ohdr['_tensor']['dtype'] = str(self.dtype)
-        return ohdr
+    @property
+    def dtype(self):
+        return self._stage.dtype
+
+    @property
+    def scale(self):
+        return self._stage.scale
+
+    def define_valid_input_spaces(self):
+        return ('tpu', 'system')
 
     def on_data(self, ispan, ospan):
         if ispan.ring.space == 'tpu':
-            import jax.numpy as jnp
-            from ..ops.quantize import _clip_limits
-            x = ispan.data
-            dt = self.dtype
-            lo, hi = _clip_limits(dt)
-            y = x * self.scale
-            if dt.kind == 'ci':
-                re = jnp.clip(jnp.round(jnp.real(y)), lo, hi)
-                im = jnp.clip(jnp.round(jnp.imag(y)), lo, hi)
-                comp = jnp.int8 if dt.nbits <= 8 else (
-                    jnp.int16 if dt.nbits == 16 else jnp.int32)
-                ospan.set(jnp.stack([re, im], axis=-1).astype(comp))
-            else:
-                if lo is not None:
-                    y = jnp.clip(jnp.round(jnp.real(y) if
-                                           jnp.iscomplexobj(y) else y,),
-                                 lo, hi)
-                ospan.set(y.astype(dt.as_jax_dtype()))
-        else:
-            ops.quantize(ispan.data, ospan.data, self.scale)
+            return super(QuantizeBlock, self).on_data(ispan, ospan)
+        ops.quantize(ispan.data, ospan.data, self.scale)
 
 
 def quantize(iring, dtype, scale=1., *args, **kwargs):

@@ -53,7 +53,8 @@ from ..dtype import DataType
 from .common import as_jax, logical_dtype
 from .fft import _writeback
 
-__all__ = ['LinAlg', 'matmul', 'xcorr_int8', 'xcorr_prewarm']
+__all__ = ['LinAlg', 'matmul', 'xcorr_int8', 'xcorr_prewarm',
+           'XEngine', 'XCORR_CLASSES', 'xcorr_class_rtol']
 
 
 def _reim_planes(x, kind, nbits, dev_dtype):
@@ -746,6 +747,281 @@ def xcorr_prewarm(t, f, n_i, n_j=None):
     else:
         zj = jnp.zeros((t, f, n_j), jnp.int8)
         xcorr_int8(z, z, zj, zj)
+
+
+# ---------------------------------------------------------------------------
+# XEngine: the raced, accuracy-classed X-engine (FX correlator X-step;
+# blocks.correlate and bench config 19 route here).  The beamform-side
+# twin is ops.beamform.Beamformer — same selection machinery, but the
+# correlation has NO weight-quantization step: on ci8 voltage planes
+# the int8 candidates are EXACT (pure int32 accumulation, bit-identical
+# to the numpy int64 oracle — tests/test_correlate.py asserts this), so
+# they are admitted under EVERY accuracy class, not just 'int8'.
+# ---------------------------------------------------------------------------
+
+#: accuracy class -> gate rtol vs the XLA complex64 baseline (the
+#: Beamformer BEAM_CLASSES ladder).  For the X-engine the classes bound
+#: only the FLOAT candidates: planar's hi-lo truncation (~2^-16) passes
+#: 'f32'; the one-pass bf16 candidate (~2^-8) needs 'bf16' or wider.
+XCORR_CLASSES = {'f32': 1e-3, 'bf16': 8e-3, 'int8': 4e-2}
+
+
+def xcorr_class_rtol(accuracy):
+    """Effective gate rtol for an accuracy class, honoring an explicit
+    BF_XCORR_GATE_RTOL override (mirrors BF_BEAM_GATE_RTOL)."""
+    try:
+        env = os.environ.get('BF_XCORR_GATE_RTOL', '').strip()
+        if env:
+            return float(env)
+    except ValueError:
+        pass
+    return XCORR_CLASSES[accuracy]
+
+
+def _xe_xla(re, im):
+    """The exactness baseline: interleaved complex64 einsum of
+    x @ x^H over the time axis, (T, F, n) -> (F, n, n)."""
+    import jax.numpy as jnp
+    x = (re.astype(jnp.float32) +
+         1j * im.astype(jnp.float32)).astype(jnp.complex64)
+    return jnp.einsum('tfi,tfj->fij', x, jnp.conj(x),
+                      preferred_element_type=jnp.complex64)
+
+
+def _xe_planar_with(mm):
+    """Hermitian 3-matmul on (re, im) planes in the pre-transposed
+    (F, n, T) @ (F, T, n) batched-GEMM layout (the _xcorr_fmt3 shape),
+    with ``mm`` setting the precision: hi-lo (f32 class at the bf16
+    MXU rate) or one-pass bf16 (lossy)."""
+    def fn(re, im):
+        import jax.numpy as jnp
+        ar = jnp.transpose(re.astype(jnp.float32), (1, 2, 0))
+        ai = jnp.transpose(im.astype(jnp.float32), (1, 2, 0))
+        br = jnp.swapaxes(ar, -1, -2)
+        bi = jnp.swapaxes(ai, -1, -2)
+        rr = mm(ar, br)
+        ii = mm(ai, bi)
+        k = mm(ai, br)
+        return (rr + ii).astype(jnp.complex64) + \
+            1j * (k - jnp.swapaxes(k, -1, -2)).astype(jnp.complex64)
+    return fn
+
+
+#: engine candidates over (T, F, n) voltage planes -> (F, n, n) c64.
+#: The int candidates reuse the raced xcorr layouts verbatim: einsum3
+#: is the Hermitian 3-einsum, gram the ONE widened (F, 2n, T) int8
+#: matmul ("widened-int8 einsum"), pallas the fused VMEM kernel.
+_XENGINE_IMPLS = {
+    'xla': _xe_xla,
+    'planar': _xe_planar_with(_mm_hilo),
+    'planar_bf16': _xe_planar_with(_mm_bf16),
+    'int8_3mm': lambda re, im: _xcorr_einsum3(re, im, re, im),
+    'int8_wide': lambda re, im: _xcorr_gram(re, im, re, im),
+    'pallas': lambda re, im: _xcorr_pallas(re, im, re, im),
+}
+
+#: candidates below the f32 accuracy class by construction — never
+#: admitted without a passing gate measurement (Beamformer._LOSSY
+#: policy).  The int candidates are NOT here: exact on int planes.
+_XENGINE_LOSSY = frozenset(['planar_bf16'])
+
+#: candidates that consume the int8 voltage planes directly (exact
+#: int32 accumulation; the verifier's quantization check keys on this)
+_XENGINE_INT_IMPLS = frozenset(['int8_3mm', 'int8_wide', 'pallas'])
+
+
+class XEngine(object):
+    """Plan-style raced X-engine (PR 9 engine pattern).
+
+    ``accuracy``: 'f32' (default) | 'bf16' | 'int8' — the class float
+    candidates must stay inside to race; int candidates are exact on
+    ci8 planes and race under every class.  ``impl`` (or
+    ``BF_XCORR_IMPL``) forces a candidate, bypassing gate and race;
+    ``BF_XCORR_GATE_RTOL`` widens/narrows the class bound and becomes
+    part of the probe-cache key (the LinAlg gate-key policy).
+
+    Calls take (re, im) voltage planes shaped (T, F, n) — int8 (the
+    ci8 ring device rep, n = station*pol flattened) or float — and
+    return (F, n, n) complex64 visibilities integrated over T.
+    """
+
+    def __init__(self, accuracy='f32', impl=None):
+        if accuracy not in XCORR_CLASSES:
+            raise ValueError('accuracy must be one of %s, got %r'
+                             % (sorted(XCORR_CLASSES), accuracy))
+        self.accuracy = accuracy
+        self._force = impl or _force_env('BF_XCORR_IMPL',
+                                         set(_XENGINE_IMPLS))
+        self.chosen = {}
+        self.probe_ms = {}
+        self._jits = {}
+
+    # -- selection -------------------------------------------------------
+
+    def _build(self, name):
+        return _XENGINE_IMPLS[name]
+
+    def _jit(self, name):
+        import jax
+        fn = self._jits.get(name)
+        if fn is None:
+            fn = self._jits[name] = jax.jit(self._build(name))
+        return fn
+
+    def _candidates(self, int_input):
+        """Candidate names eligible at this input dtype + accuracy
+        class.  Float voltages cannot feed the int8 kernels; on int
+        planes the int candidates are exact and race at every class."""
+        rtol = xcorr_class_rtol(self.accuracy)
+        names = ['xla', 'planar']
+        if rtol >= XCORR_CLASSES['bf16']:
+            names.append('planar_bf16')
+        if int_input:
+            names += ['int8_3mm', 'int8_wide']
+            if self._pallas_raceable():
+                names.append('pallas')
+        return names
+
+    @staticmethod
+    def _pallas_raceable():
+        """The Pallas kernel races only where it compiles natively
+        (the _xcorr_race_impls policy); a forced impl still
+        dispatches it regardless."""
+        try:
+            import jax
+            if jax.default_backend() != 'tpu':
+                return False
+        except Exception:
+            return False
+        from .pallas_kernels import available
+        return available()
+
+    def _default(self, int_input):
+        """Winner when no measurement is available: on int planes the
+        Hermitian 3-einsum — exact and the historical xcorr_int8
+        default, so unprobed sessions keep byte-identical lowering;
+        the XLA baseline otherwise."""
+        return 'int8_3mm' if int_input else 'xla'
+
+    def _key(self, shape, dtype, int_input):
+        rtol = xcorr_class_rtol(self.accuracy)
+        key = 'acc=%s v=%s %s' % (self.accuracy, tuple(shape), dtype)
+        if rtol != XCORR_CLASSES[self.accuracy]:
+            key += '|gate_rtol=%g' % rtol
+        return key
+
+    def _gate(self, names, make_args):
+        """(keep, had_errors): candidates within the class rtol of the
+        XLA baseline at the actual shape (Beamformer._gate contract)."""
+        import jax.numpy as jnp
+        args = make_args()
+        outs = {}
+        had_errors = False
+        for name in names:
+            try:
+                outs[name] = self._jit(name)(*args)
+            except Exception:
+                had_errors = True
+        if 'xla' not in outs:
+            return [n for n in outs if n not in _XENGINE_LOSSY], \
+                had_errors
+        ref = outs['xla']
+        scale = float(jnp.max(jnp.abs(ref))) or 1.0
+        rtol = xcorr_class_rtol(self.accuracy)
+        keep = []
+        for name, y in outs.items():
+            if float(jnp.max(jnp.abs(y - ref))) / scale <= rtol:
+                keep.append(name)
+        return keep, had_errors
+
+    def _select(self, shape, dtype, int_input, make_args):
+        key = self._key(shape, dtype, int_input)
+        if self._force:
+            self.chosen[key] = self._force
+            return self._force
+        default = self._default(int_input)
+        names = self._candidates(int_input)
+        if key in self.chosen:
+            return self.chosen[key]
+        if not (_probe_wanted() and len(names) > 1):
+            self.chosen[key] = default
+            return default
+        from . import mprobe
+        cached = mprobe.peek('xengine', key)
+        if cached is not None and cached[0] in names:
+            self.chosen[key] = cached[0]
+            self.probe_ms[key] = cached[1]
+            return cached[0]
+        keep, had_errors = self._gate(names, make_args)
+        fns = {n: self._jit(n) for n in keep}
+        winner, ms, _err = mprobe.select('xengine', key, fns,
+                                         make_args,
+                                         persist=not had_errors)
+        self.chosen[key] = winner or default
+        if winner is not None:
+            self.probe_ms[key] = ms
+        return self.chosen[key]
+
+    # -- public API ------------------------------------------------------
+
+    def prewarm(self, t, f, n, int_input=True, seed=11):
+        """Eagerly gate + race the candidates at the actual shape so a
+        later jit-traced __call__ finds the winner in the cache —
+        probe cost lands at on_sequence, never as first-gulp latency
+        (the xcorr_prewarm policy).  Returns the winner name."""
+        import jax.numpy as jnp
+        shape = (t, f, n)
+        rng = np.random.RandomState(seed)
+        if int_input:
+            re = rng.randint(-64, 64, shape).astype(np.int8)
+            im = rng.randint(-64, 64, shape).astype(np.int8)
+            dtype = 'int8'
+        else:
+            re = rng.randn(*shape).astype(np.float32)
+            im = rng.randn(*shape).astype(np.float32)
+            dtype = 'float32'
+        if not _probe_wanted() and not self._force:
+            name = self._default(int_input)
+            self.chosen[self._key(shape, dtype, int_input)] = name
+            return name
+        rej = jnp.asarray(re)
+        imj = jnp.asarray(im)
+        return self._select(shape, dtype, int_input,
+                            lambda: (rej, imj))
+
+    def __call__(self, re, im):
+        """Correlate (T, F, n) voltage planes -> (F, n, n) complex64
+        on the selected candidate.  Trace-safe: under an outer jit the
+        winner comes from the in-process cache (a prewarm at this
+        shape), the mprobe disk cache, or the class default — never a
+        measurement."""
+        import jax
+        int_input = jax.numpy.issubdtype(re.dtype, jax.numpy.integer)
+        shape = tuple(re.shape)
+        key = self._key(shape, str(re.dtype), int_input)
+        name = self._force or self.chosen.get(key)
+        if name is None:
+            if isinstance(re, jax.core.Tracer):
+                from . import mprobe
+                cached = mprobe.peek('xengine', key)
+                names = self._candidates(int_input)
+                if cached is not None and cached[0] in names:
+                    self.chosen[key] = name = cached[0]
+                else:
+                    name = self._default(int_input)
+            else:
+                name = self._select(
+                    shape, str(re.dtype), int_input,
+                    lambda: (re, im)) if _probe_wanted() \
+                    else self._default(int_input)
+        if isinstance(re, jax.core.Tracer):
+            return self._build(name)(re, im)
+        return self._jit(name)(re, im)
+
+    def ops_per_frame(self, nfreq, n):
+        """Real ops per time frame of the correlation GEMM (one
+        complex MAC = 8 real ops) — the bench ops-accounting unit."""
+        return 8 * nfreq * n * n
 
 
 _default = None

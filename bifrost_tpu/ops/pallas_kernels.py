@@ -17,7 +17,8 @@ from __future__ import annotations
 import os
 
 __all__ = ['available', 'stokes_detect', 'xcorr_herm', 'xcorr_cross',
-           'beamform_int8', 'beamform_bf16', 'beamform_detect_int8']
+           'beamform_int8', 'beamform_bf16', 'beamform_detect_int8',
+           'ring_permute']
 
 _checked = None
 
@@ -465,3 +466,46 @@ def fdmt_step(d1, d2, passthrough, rows_hi_max, sgn, T, interpret=False):
           jnp.asarray(passthrough, jnp.int32), state, state)
 
     return fn
+
+
+def ring_permute(x, axis_name, ndev):
+    """One correlator corner-turn ring hop as an explicit remote DMA:
+    this device's whole block is DMA'd to its right neighbour
+    ((i+1) % D over the ``axis_name`` ring), following the classic
+    Pallas right-permute collective (SNIPPETS.md [3]).  Call inside
+    shard_map over ``axis_name`` on a real TPU mesh; the send and
+    receive ride dedicated DMA semaphores so hops can overlap the
+    X-engine compute of already-landed chunks.
+
+    parallel.corner_turn composes D-1 of these hops into the full
+    time-sharded -> channel-sharded redistribution and races the
+    composition against XLA's native all_to_all lowering (family
+    ``corner_turn``) — the ring form wins when the all_to_all's
+    packetization fights the gulp layout, and loses silently (it is
+    never the unmeasured default) when it doesn't.
+    """
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(in_ref, out_ref, send_sem, recv_sem):
+        my_id = jax.lax.axis_index(axis_name)
+        dst = jax.lax.rem(my_id + 1, ndev)
+        copy = pltpu.make_async_remote_copy(
+            src_ref=in_ref, dst_ref=out_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=(dst,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        copy.start()
+        copy.wait()
+
+    params_cls = getattr(pltpu, 'CompilerParams', None) or \
+        getattr(pltpu, 'TPUCompilerParams')
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[pltpu.SemaphoreType.DMA,
+                        pltpu.SemaphoreType.DMA],
+        compiler_params=params_cls(has_side_effects=True,
+                                   collective_id=1),
+    )(x)

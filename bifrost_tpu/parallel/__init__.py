@@ -16,7 +16,9 @@ from .mesh import create_mesh, mesh_axes, local_mesh
 from .ops import (sharded_spectrometer, sharded_beamform,
                   sharded_correlate, sharded_fdmt, sharded_fir,
                   spectrometer_step)
-from .fft import sharded_fft, distributed_fft_local
+from .fft import (sharded_fft, distributed_fft_local,
+                  freq_sharded_dft)
+from .corner_turn import corner_turn, corner_turn_local
 from .scope import (time_axis_name, station_axis_name, time_axis_size,
                     time_sharding, replicated_sharding, shardable_nframe,
                     shard_gulp, sharding_descriptor, descriptor_matches,

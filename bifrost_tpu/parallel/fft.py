@@ -28,7 +28,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ['sharded_fft', 'distributed_fft_local']
+__all__ = ['sharded_fft', 'distributed_fft_local',
+           'freq_sharded_dft', 'freq_chunk_dft_local']
 
 from .ops import _shard_map, _P, axis_size as _axis_size
 # reuse the cached four-step factor matrices and the re/im-plane
@@ -115,3 +116,72 @@ def sharded_fft(mesh, n, axis_name='sp', inverse=False,
 
     spec = _P(*([None] * nbatch + [axis_name]))
     return shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+
+
+def freq_chunk_dft_local(x, n1, n2, axis_name, ndev, inverse=False):
+    """Per-shard body of the CROSS-CHIP CHANNELIZER: from a REPLICATED
+    (..., N) frame, device d computes ONLY its contiguous channel
+    chunk k in [d*N/D, (d+1)*N/D) via the decomposed DFT — with ZERO
+    collectives inside the frame ("Large-Scale DFT on TPUs",
+    PAPERS.md).
+
+    N = n1*n2, n = n2*p + q, k = n1*s + r: the n1-point DFT over p and
+    the twiddle are k-chunk independent, and a contiguous k chunk is
+    exactly an s-column chunk of the n2-point factor matrix (requires
+    D | n2) — so the only per-device specialization is a column slice,
+    and the F-stage shards over the mesh frequency axis for free.
+    Contrast distributed_fft_local, which shards the INPUT and pays
+    three all_to_alls; here the input is replicated (committed once,
+    outside the compiled frame) and the mesh buys you an N*D-channel
+    F-engine per N channels of per-chip work."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if n2 % ndev:
+        raise ValueError("freq-sharded dft needs D | N2 "
+                         "(N2=%d, D=%d)" % (n2, ndev))
+    lead = x.shape[:-1]
+    f1h, f2h, twh = _dft_matrices(n1, n2, inverse, 'c64')
+    xt = x.reshape(lead + (n1, n2))     # x[n2*p + q] -> [p, q]
+    inner = jnp.einsum('...pq,pr->...rq', xt,
+                       _const_complex(f1h, jnp.complex64))
+    inner = inner * _const_complex(twh, jnp.complex64).astype(
+        inner.dtype)
+    # this device's s-columns of the n2-point factor matrix
+    sc = n2 // ndev
+    s0 = lax.axis_index(axis_name) * sc
+    f2 = lax.dynamic_slice(_const_complex(f2h, jnp.complex64),
+                           (0, s0), (n2, sc))
+    chunk = jnp.einsum('...rq,qs->...rs', inner, f2)
+    # k = n1*s + r: s-major flatten gives the contiguous k chunk
+    chunk = jnp.swapaxes(chunk, -1, -2)
+    return chunk.reshape(lead + (n1 * sc,))
+
+
+def freq_sharded_dft(mesh, n, axis_name='sp', inverse=False, n1=None,
+                     nbatch=0):
+    """jit-ready frequency-sharded channelizer: input (..., N) complex
+    REPLICATED over ``axis_name`` (``nbatch`` leading axes), output
+    (..., N) with the channel axis sharded — device d holds channels
+    [d*N/D, (d+1)*N/D) — and no collective anywhere in the lowered
+    program (asserted by tests/test_correlate.py via the HLO-stats
+    counters).  Returns a function over global arrays (shard_map'd)."""
+    shard_map = _shard_map()
+    ndev = int(mesh.shape[axis_name])
+    if n1 is None:
+        import math
+        h = int(math.log2(n))
+        if 1 << h != n:
+            raise ValueError("freq_sharded_dft requires power-of-two N")
+        n1 = 1 << (h // 2)
+    n2 = n // n1
+
+    def local(x):
+        return freq_chunk_dft_local(x, n1, n2, axis_name, ndev,
+                                    inverse=inverse)
+
+    in_spec = _P()      # replicated: the frame is committed whole,
+    #                     before the compiled program runs
+    out_spec = _P(*([None] * nbatch + [axis_name]))
+    return shard_map(local, mesh=mesh, in_specs=in_spec,
+                     out_specs=out_spec)

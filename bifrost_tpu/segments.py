@@ -86,6 +86,11 @@ REASONS = {
     'supervision': 'a block pins its own failure policy (restart/skip '
                    'blast radius must stay per-block)',
     'unguaranteed': 'the consumer reads unguaranteed',
+    'collective': 'the block owns a cross-device collective schedule '
+                  '(e.g. the correlator corner turn): its dispatch '
+                  'boundary is the collective\'s synchronization '
+                  'point and cannot be folded into a neighbour\'s '
+                  'program',
     'disabled': 'segment compilation is off (BF_SEGMENTS)',
 }
 
@@ -230,6 +235,12 @@ def _boundary_reason(producer, oring, consumers, mode):
         return 'tap'
     if not getattr(c, 'guarantee', True):
         return 'unguaranteed'
+    if getattr(producer, '_collective_boundary', False) or \
+            getattr(c, '_collective_boundary', False):
+        # more specific than 'host': the block WOULD be device math,
+        # but it schedules its own cross-device collective (corner
+        # turn / psum meeting point) and must keep the dispatch
+        return 'collective'
     if not _eligible(producer) or not _eligible(c):
         return 'host'
     ov = _static_overlap(c)

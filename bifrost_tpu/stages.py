@@ -28,7 +28,8 @@ from .units import transform_units
 
 __all__ = ['Stage', 'FftStage', 'DetectStage', 'ReduceStage',
            'FftShiftStage', 'ReverseStage', 'TransposeStage',
-           'ScrunchStage', 'MapStage', 'BeamformStage']
+           'ScrunchStage', 'MapStage', 'BeamformStage',
+           'QuantizeStage', 'CorrelateStage', 'AccumulateStage']
 
 
 class Stage(object):
@@ -572,6 +573,161 @@ class BeamformStage(Stage):
             y = engine(re, im)
             return y if mode == 'perpol' else y[:, :, 0, :]
         return fn
+
+
+class QuantizeStage(Stage):
+    """Requantize float data to a narrower (possibly complex-int)
+    dtype INSIDE a fused chain (the device math of
+    blocks.quantize.QuantizeBlock as a stage).
+
+    The FX-correlator use: the channelizer's cf32 output requantizes
+    to ci8 between the F and X steps, so inside a fused segment the
+    float spectra live only in registers/VMEM — no f32 voltage array
+    ever lands in HBM — and the X-engine consumes int8 planes on its
+    exact int32 path.
+    """
+
+    batch_safe = True
+
+    def __init__(self, dtype, scale=1.):
+        self.dtype = DataType(dtype)
+        self.scale = scale
+
+    def transform_header(self, hdr):
+        ohdr = deepcopy(hdr)
+        ohdr['_tensor']['dtype'] = str(self.dtype)
+        return ohdr
+
+    def build(self, in_meta):
+        import jax.numpy as jnp
+        from .ops.quantize import _clip_limits
+        pre = _complexify_fn(in_meta)
+        dt, scale = self.dtype, self.scale
+        lo, hi = _clip_limits(dt)
+
+        def fn(x):
+            y = pre(x) * scale
+            if dt.kind == 'ci':
+                re = jnp.clip(jnp.round(jnp.real(y)), lo, hi)
+                im = jnp.clip(jnp.round(jnp.imag(y)), lo, hi)
+                comp = jnp.int8 if dt.nbits <= 8 else (
+                    jnp.int16 if dt.nbits == 16 else jnp.int32)
+                return jnp.stack([re, im], axis=-1).astype(comp)
+            if lo is not None:
+                y = jnp.clip(jnp.round(jnp.real(y) if
+                                       jnp.iscomplexobj(y) else y),
+                             lo, hi)
+            return y.astype(dt.as_jax_dtype())
+        return fn
+
+
+class CorrelateStage(Stage):
+    """FX-correlator X step as a fusable stage: one visibility matrix
+    per ``nframe_per_vis`` input frames, computed by the raced
+    X-engine (:class:`bifrost_tpu.ops.linalg.XEngine` — candidates
+    raced + accuracy-gated per the declared ``accuracy`` class;
+    ``BF_XCORR_IMPL`` forces one).
+
+    Input tensor: ``['time', 'freq', 'station', 'pol']``, dtype ci8
+    (int planes ride the exact int32 MXU path directly) or complex
+    float.  Output: ``['time', 'freq', 'station_i', 'pol_i',
+    'station_j', 'pol_j']`` cf32, the full visibility matrix
+    (``matrix_fill_mode='full'``), one output frame per integration.
+
+    Unlike the stateful :class:`bifrost_tpu.blocks.correlate
+    .CorrelateBlock` (which integrates ACROSS gulps), the stage
+    integrates whole groups WITHIN each gulp — ``nframe_per_vis`` must
+    divide the gulp — which is exactly what makes it time-concat
+    equivariant (``batch_safe``): macro-gulp block mode and segment
+    fusion (capture -> F -> X -> accumulate as ONE compiled program)
+    both apply unchanged.
+    """
+
+    batch_safe = True
+
+    def __init__(self, nframe_per_vis, accuracy='f32', impl=None):
+        from .ops.linalg import XEngine
+        self.nframe_per_vis = int(nframe_per_vis)
+        if self.nframe_per_vis < 1:
+            raise ValueError('nframe_per_vis must be >= 1')
+        self.nframe_ratio = (1, self.nframe_per_vis)
+        self.engine = XEngine(accuracy=accuracy, impl=impl)
+        self.accuracy = self.engine.accuracy
+
+    def transform_header(self, hdr):
+        itensor = hdr['_tensor']
+        labels = itensor.get('labels')
+        if labels != ['time', 'freq', 'station', 'pol']:
+            raise ValueError(
+                "correlate requires ['time', 'freq', 'station', "
+                "'pol'] input labels, got %r" % (labels,))
+        itype = DataType(itensor['dtype'])
+        if not itype.is_complex:
+            raise TypeError('correlate requires complex voltages, '
+                            'got %s' % itensor['dtype'])
+        ohdr = deepcopy(hdr)
+        otensor = ohdr['_tensor']
+        otensor['dtype'] = 'cf32'
+        for key in ('shape', 'labels', 'scales', 'units'):
+            if key not in itensor:
+                continue
+            tv, fv, sv, pv = (deepcopy(v) for v in itensor[key])
+            otensor[key] = [tv, fv, sv, pv,
+                            deepcopy(sv) if key != 'labels'
+                            else sv + '_j',
+                            deepcopy(pv) if key != 'labels'
+                            else pv + '_j']
+        if 'labels' in otensor:
+            otensor['labels'][2] += '_i'
+            otensor['labels'][3] += '_i'
+        if 'scales' in otensor:
+            otensor['scales'][0][1] *= self.nframe_per_vis
+        ohdr['matrix_fill_mode'] = 'full'
+        return ohdr
+
+    def build(self, in_meta):
+        import jax
+        import jax.numpy as jnp
+        reim = in_meta.get('reim', False)
+        r = self.nframe_per_vis
+        t = in_meta['shape'][0]
+        if t % r:
+            raise ValueError(
+                'CorrelateStage: gulp nframe %d not divisible by '
+                'nframe_per_vis %d' % (t, r))
+        engine = self.engine
+
+        def fn(x):
+            if reim and not jnp.issubdtype(x.dtype,
+                                           jnp.complexfloating):
+                re, im = x[..., 0], x[..., 1]
+            else:
+                re, im = jnp.real(x), jnp.imag(x)
+            nt, f, s, p = re.shape
+            re = re.reshape(nt // r, r, f, s * p)
+            im = im.reshape(nt // r, r, f, s * p)
+            # one engine call per integration group; vmap traces the
+            # engine at the (r, f, n) per-group shape, so the winner
+            # probed by an eager prewarm at that shape applies — and
+            # the SAME program runs at every macro factor K, keeping
+            # K>1 byte-identical to K=1
+            vis = jax.vmap(engine)(re, im)          # (g, f, n, n)
+            return vis.reshape(nt // r, f, s, p, s, p) \
+                .astype(jnp.complex64)
+        return fn
+
+
+class AccumulateStage(ReduceStage):
+    """Frame-axis integration as a fusable stage — the in-chain twin
+    of :class:`bifrost_tpu.blocks.accumulate.AccumulateBlock` (which
+    carries state across gulps): sums whole groups of ``nframe``
+    frames within a gulp, so it composes into fused segments and
+    macro-gulp batches.  The FX chain uses it to integrate visibility
+    matrices after the X step."""
+
+    def __init__(self, nframe, op='sum'):
+        super(AccumulateStage, self).__init__('time', factor=int(nframe),
+                                              op=op)
 
 
 class MapStage(Stage):

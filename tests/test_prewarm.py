@@ -148,8 +148,8 @@ def test_fdmt_probe_outside_on_data(monkeypatch):
 
 
 def test_xcorr_probe_outside_on_data(monkeypatch, tmp_path):
-    """With measured layout probing forced on, CorrelateBlock's xcorr
-    probe must run at on_sequence (xcorr_prewarm); no mprobe.select
+    """With measured probing forced on, CorrelateBlock's X-engine
+    probe must run at on_sequence (XEngine.prewarm); no mprobe.select
     may execute inside on_data — the traced call finds the winner in
     the cache."""
     from bifrost_tpu.blocks.correlate import CorrelateBlock
@@ -191,18 +191,18 @@ def test_xcorr_probe_outside_on_data(monkeypatch, tmp_path):
     with bf.Pipeline() as p:
         src = NumpySourceBlock([raw[:8], raw[8:]], hdr, gulp_nframe=8)
         b = bf.blocks.copy(src, space='tpu')
-        b = bf.blocks.correlate(b, nframe_per_integration=16)
-        b = bf.blocks.copy(b, space='system')
+        corr = bf.blocks.correlate(b, nframe_per_integration=16)
+        b = bf.blocks.copy(corr, space='system')
         sink = GatherSink(b)
         p.run()
     assert sink.result() is not None
-    xsel = [(ind, n) for ind, n in probes if n == 'linalg_xcorr']
-    assert xsel, 'xcorr layout probe never ran (prewarm missing)'
+    xsel = [(ind, n) for ind, n in probes if n == 'xengine']
+    assert xsel, 'X-engine probe never ran (prewarm missing)'
     assert not any(ind for ind, _ in xsel), \
-        'xcorr probe executed inside on_data (not pre-warmed)'
+        'X-engine probe executed inside on_data (not pre-warmed)'
     # the prewarmed winner must be keyed at the shape the traced
     # on_data call actually looks up — a t_eff/shape mismatch would
     # pass the asserts above while the gulps silently run the default
     n = S * P
-    key = 'auto=True i=%s j=%s' % ((8, F, n), (8, F, n))
-    assert key in L._xcorr_chosen, (key, L._xcorr_chosen)
+    key = corr.engine._key((8, F, n), 'int8', True)
+    assert key in corr.engine.chosen, (key, corr.engine.chosen)

@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""FX-correlator flagship gate: the quantized X-engine must WIN and the
+whole chain must be EXACT — this publishes the BENCH_FXCORR_*.json
+artifact series and a mesh-scaling row into the MULTICHIP_*.json glob.
+
+Runs bench_suite config 19 (ci8 stations -> F -> requantize -> X ->
+accumulate; bench_suite.bench_fxcorr) in a fresh subprocess pinned to
+the CPU backend with ``--xla_force_host_platform_device_count=8``, and
+asserts:
+
+- ``quant_beats_f32``         — the X-engine race winner at the int8
+  accuracy class beats the complex64 XLA baseline in the engine
+  microbench (on the CPU gate host that is typically the bf16 plane
+  GEMM; on MXU hosts the exact int8 kernels — measured, never
+  asserted);
+- ``oracle_identical``        — every arm (f32 / quant / segment) is
+  BYTE-identical to the sequential oracle: eager F + quantize, then an
+  int64 numpy X step.  The integer visibilities are exactly
+  representable in complex64, so no arm gets a tolerance;
+- ``zero_member_dispatches``  — under BF_SEGMENTS=force the
+  capture->F->quantize->X->accumulate chain compiled into ONE segment
+  and the member blocks dispatched exactly ZERO times;
+- ``deterministic``           — the three arms' output streams are
+  byte-identical to each other.
+
+The mesh arm (stateful CorrelateBlock striped over the 8-device mesh,
+psum vs the corner-turn collective) must byte-match the single-device
+run when it ran; its wall ratio is recorded but NOT gated (virtual
+host-platform devices share cores — the real-chip round overwrites the
+row).  Its result also lands as ``MULTICHIP_${BF_BENCH_ROUND}_fxcorr
+.json`` so the mesh artifact series gains the baselines x channels/s
+per chip row next to config 11's.
+
+Exit codes: 0 pass, 3 a gate condition failed, 2 the bench failed to
+produce a result.  ``tools/watch_and_bench.sh`` runs this after the
+mesh gate (``BF_SKIP_FXCORR_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_DEVICES = 8
+
+
+def run_config19(timeout=1800):
+    """One bench_suite --config 19 subprocess on an 8-device
+    host-platform mesh; returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    flags = env.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=%d'
+            % N_DEVICES).strip()
+    # a configured global batch/donate would skew the arm comparison
+    env.pop('BF_GULP_BATCH', None)
+    env.pop('BF_DONATE', None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '19'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'xengine' in d:
+            return d
+        if isinstance(d, dict) and d.get('error'):
+            raise RuntimeError('config 19 failed: %s' % d['error'])
+    raise RuntimeError(
+        'config 19 produced no result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1000:], out.stderr[-1000:]))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    round_ = os.environ.get('BF_BENCH_ROUND', 'cpu')
+    ap.add_argument('--out', default='BENCH_FXCORR_%s.json' % round_,
+                    help='artifact path (full config-19 result + '
+                         'verdict)')
+    ap.add_argument('--mesh-out',
+                    default='MULTICHIP_%s_fxcorr.json' % round_,
+                    help='mesh-scaling row artifact (written only '
+                         'when the mesh arm ran)')
+    ap.add_argument('--timeout', type=float, default=1800.0,
+                    help='bench subprocess timeout in seconds')
+    args = ap.parse_args()
+
+    try:
+        res = run_config19(timeout=args.timeout)
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        print('fxcorr_gate: bench failed: %s' % exc, file=sys.stderr)
+        return 2
+
+    quant_ok = bool(res.get('quant_beats_f32'))
+    oracle_ok = bool(res.get('oracle_identical'))
+    seg_ok = bool(res.get('zero_member_dispatches'))
+    det_ok = bool(res.get('deterministic'))
+    mesh = res.get('mesh')
+    # gated only when the arm ran: a 1-device host legitimately skips
+    mesh_ok = bool(mesh.get('outputs_match')) if mesh else True
+    ok = quant_ok and oracle_ok and seg_ok and det_ok and mesh_ok
+    artifact = dict(res,
+                    gate={'quant_beats_f32': quant_ok,
+                          'oracle_identical': oracle_ok,
+                          'zero_member_dispatches': seg_ok,
+                          'deterministic': det_ok,
+                          'mesh_outputs_match': mesh_ok,
+                          'mesh_arm_ran': bool(mesh),
+                          'pass': ok,
+                          'round': os.environ.get('BF_BENCH_ROUND',
+                                                  '')})
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write('\n')
+    if mesh:
+        row = dict(mesh,
+                   config='FX correlator mesh arm (bench_suite '
+                          'config 19): stateful CorrelateBlock '
+                          'striped over the device mesh, psum vs '
+                          'corner-turn collective',
+                   config_id=19,
+                   gate={'outputs_match': mesh_ok,
+                         'ratio_gated': False,
+                         'pass': mesh_ok,
+                         'round': os.environ.get('BF_BENCH_ROUND',
+                                                 '')})
+        with open(args.mesh_out, 'w') as f:
+            json.dump(row, f, indent=1, sort_keys=True)
+            f.write('\n')
+    xe = res.get('xengine', {})
+    print('fxcorr_gate: winner %s %.1f GOP/s vs xla %.1f GOP/s, '
+          'quant_beats_f32=%s oracle_identical=%s '
+          'zero_member_dispatches=%s deterministic=%s mesh=%s %s'
+          % (xe.get('winner'), xe.get('gops_per_s', -1),
+             xe.get('xla_gops_per_s', -1), quant_ok, oracle_ok,
+             seg_ok, det_ok,
+             ('match' if mesh_ok else 'MISMATCH') if mesh
+             else 'skipped',
+             'PASS' if ok else 'FAIL'))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -67,6 +67,10 @@ WATCHLIST = [
     # when wall-clock noise hides it
     ('*segment.elided_rings*', 'lower', 'any', 0.0),
     ('*segment.dispatches*', 'lower', 'any', 0.0),
+    # FX correlator flagship (BENCH_FXCORR, config 19): the raced
+    # X-engine's winner rate — a drop means the quantized candidate
+    # stopped winning or the race landed somewhere slower
+    ('*xengine.gops_per_s*', 'lower', 'pct', 10.0),
     ('*crc_errors*', 'higher', 'any', 0.0),
     ('*reconnects*', 'higher', 'any', 0.0),
     ('*fallback*', 'higher', 'any', 0.0),

@@ -320,6 +320,23 @@ for i in $(seq 1 400); do
           exit "$mrc"
         fi
       fi
+      # FX-correlator flagship gate: config 19 — quantized X-engine
+      # winner must beat the complex64 baseline, every arm must be
+      # byte-identical to the sequential oracle, and the fused
+      # segment arm must dispatch its member blocks ZERO times.
+      # Writes BENCH_FXCORR_${ROUND}.json plus the mesh-scaling row
+      # MULTICHIP_${ROUND}_fxcorr.json.
+      if [ "${BF_SKIP_FXCORR_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) fx correlator gate (config 19, 8-dev host mesh)" >> "$LOG"
+        python tools/fxcorr_gate.py --out "BENCH_FXCORR_${ROUND}.json" \
+          --mesh-out "MULTICHIP_${ROUND}_fxcorr.json" >> "$LOG" 2>&1
+        xrc=$?
+        echo "$(date -u +%FT%TZ) fxcorr gate rc=$xrc" >> "$LOG"
+        if [ "$xrc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) fx correlator gate FAILED" >> "$LOG"
+          exit "$xrc"
+        fi
+      fi
       exit 0
     fi
     # never leave a truncated artifact where round automation could
