@@ -3667,6 +3667,435 @@ def _fxcorr_mesh_arm(raw, hdr, NT, NW, NS, NP, nbl, reps):
     }
 
 
+# ---------------------------------------------------------------------------
+# config 20: elastic control plane chaos drill — cross-host tenant
+# scheduling, SIGKILL-triggered re-placement with warm zero-recompile
+# migration and ledger-exact resume, priority displacement, and the
+# cross-tenant autotune arbiter (bifrost_tpu.scheduler;
+# docs/scheduler.md; gated by tools/sched_gate.py into
+# SCHED_CHAOS_cpu.json)
+# ---------------------------------------------------------------------------
+
+_SCHED_VIC_SCRIPT = r'''
+import json, os, sys
+(root, spec_path, state_dir, nf, gulp, nchan, tick_s) = (
+    sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]), float(sys.argv[7]))
+sys.path.insert(0, root)
+sys.path.insert(0, os.path.join(root, 'tests'))
+os.environ['BF_FABRIC_STATE'] = state_dir
+from bifrost_tpu import fabric, service
+from util import CallbackSinkBlock
+
+spec = fabric.FabricSpec.load(spec_path)
+member = fabric.Membership(spec, 'hostA').start()
+# the durable sender ledger the scheduler resumes from: every gulp
+# the sink commits is acked (force=True: the SIGKILL must not lose a
+# noted frontier to the rate-limited save)
+led = fabric.AckLedger('sched20', 'hostA', 'stream')
+rowb = nchan * 4
+done = {'n': 0}
+
+def note(arr):
+    n = int(arr.shape[0])
+    led.note_acked('vic', done['n'], n, n * rowb)
+    led.save(force=True)
+    done['n'] += n
+
+service.reset_registry()
+mgr = service.JobManager(max_tenants=1, warm=False)
+mgr.submit(service.TenantSpec('vic', priority=2, ncores=2,
+                              gulp_nframe=gulp,
+                              source={'kind': 'synthetic',
+                                      'nframe_total': nf,
+                                      'gulp_nframe': gulp,
+                                      'nchan': nchan, 'seed': 11,
+                                      'tick_s': tick_s}),
+           build=lambda gate: CallbackSinkBlock(gate,
+                                                data_callback=note))
+print('START', flush=True)
+mgr.start()
+mgr.wait(600)
+member.stop()
+print('RESULT ' + json.dumps({'frames': done['n']}), flush=True)
+'''
+
+
+def bench_sched_chaos(kill_after=1.2, timeout=240):
+    """Elastic control plane chaos drill (docs/scheduler.md): three
+    tenants placed across a 3-host fabric — ``vic`` (priority 2, 2
+    cores, pinned to hostA, running in a REAL subprocess that acks a
+    durable AckLedger frontier per delivered gulp), ``slo`` (priority
+    2, quota-paced with a declared real-time cadence and an SLO
+    budget, on hostB) and ``bulk`` (priority 0, shed-policy quota, on
+    hostB) — pre-gated by ``verify_placement`` (BF-E22x), then driven
+    through a SIGKILL of hostA mid-stream:
+
+    1. the head's Membership declares hostA dead; the scheduler's
+       death-watch re-places ``vic`` onto hostB automatically;
+    2. the migration composes a PR-15 warm start (the topology was
+       pre-warmed: plan-depot replay, ZERO recompiles) with a PR-13
+       resume from the ledger frontier (only unacked frames replay;
+       skipped frames are counted, bounded loss);
+    3. hostB lands oversubscribed (4 cores demanded, 3 declared), so
+       the lowest-priority tenant ``bulk`` is DISPLACED: its quota is
+       scaled and it shed by policy — counted, never a deadlock;
+    4. once ``slo`` blows its latency budget (quota-starved against
+       its declared cadence), :meth:`Scheduler.arbitrate` moves rate
+       from ``bulk`` to ``slo`` and the rollup returns under budget
+       within the run.
+
+    Invariants: death detected; re-placement automatic; zero plan
+    builds during the migration (plan-depot hit, job flagged warm);
+    resume skipped exactly the ledger frontier (0 < F < total);
+    produced == acked-before-death + delivered-after-resume
+    BYTE-EXACT with the resumed payload identical to the source
+    tail; the displaced tenant finishes DONE shedding counted gulps;
+    the arbiter restores the violator's SLO."""
+    import shutil
+    import signal as signal_mod
+    import subprocess
+    import tempfile
+    _tests = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'tests')
+    if _tests not in sys.path:
+        sys.path.insert(0, _tests)
+    import bifrost_tpu as bf
+    from bifrost_tpu import fabric, scheduler, service, telemetry
+    from bifrost_tpu.stages import FftStage, DetectStage, ReduceStage
+    from bifrost_tpu.telemetry import counters
+    from bifrost_tpu.telemetry import slo as slo_mod
+    from util import GatherSink
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    NF, GULP, NCHAN = 1920, 32, 64       # the vic stream
+    rowb = NCHAN * 4
+    sub_tick = 0.15                      # subprocess pace: 9 s runway
+    tmpdir = tempfile.mkdtemp(prefix='bf_sched_')
+    state_dir = os.path.join(tmpdir, 'state')
+
+    link_base = _fabric_port_block(2)    # 2-origin fan-in: port, +1
+    ctrl = _fabric_free_ports(3, exclude=(link_base, link_base + 1))
+    # the link exists so peers_of() makes all three hosts mutual
+    # membership peers (and verify_fabric has a topology to pre-gate)
+    # — nothing listens on it in this drill
+    spec = fabric.FabricSpec.from_dict({
+        'name': 'sched20',
+        'hosts': {
+            'head': {'address': '127.0.0.1', 'control_port': ctrl[0],
+                     'role': 'control', 'cores': [3]},
+            'hostA': {'address': '127.0.0.1', 'control_port': ctrl[1],
+                      'role': 'worker', 'cores': [0, 1]},
+            'hostB': {'address': '127.0.0.1', 'control_port': ctrl[2],
+                      'role': 'worker', 'cores': [0, 1, 2]},
+        },
+        'links': {
+            'stream': {'kind': 'fanin', 'src': ['hostA', 'hostB'],
+                       'dst': 'head', 'port': link_base, 'window': 2,
+                       'gulp_nbyte': GULP * rowb},
+        },
+    })
+    spec_path = os.path.join(tmpdir, 'spec.json')
+    spec.save(spec_path)
+
+    chaos_env = {'BF_FABRIC_STATE': state_dir,
+                 'BF_FABRIC_HEARTBEAT_SECS': '0.1',
+                 'BF_FABRIC_DEADLINE_SECS': '0.6'}
+    saved_env = {k: os.environ.get(k) for k in chaos_env}
+    os.environ.update(chaos_env)
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    for var in ('BF_FAULTS', 'BF_METRICS_FILE', 'BF_FABRIC_IDENTITY',
+                'BF_SLO_MS'):
+        env.pop(var, None)
+
+    service.reset_registry()
+    service.reset_warm_registry()
+    store = {'raw': [], 'out': []}
+
+    def build_vic(gate):
+        # raw tap (byte-exactness assertion) + the fused device chain
+        # whose compiled plans the warm migration must replay
+        store['raw'].append(GatherSink(gate))
+        b = bf.blocks.copy(gate, space='tpu')
+        fbk = bf.blocks.fused(
+            b, [FftStage('chan', axis_labels='freq'),
+                DetectStage('scalar'),
+                ReduceStage('freq', 3)])
+        store['out'].append(GatherSink(bf.blocks.copy(fbk,
+                                                      space='system')))
+
+    def vic_source(tick_s=0.0):
+        return {'kind': 'synthetic', 'nframe_total': NF,
+                'gulp_nframe': GULP, 'nchan': NCHAN, 'seed': 11,
+                'tick_s': tick_s}
+
+    schedule = []
+    proc = None
+    sched = None
+    membs = []
+    try:
+        # ---- phase 0: pre-warm the vic topology ----------------------
+        # (the chaos migration must be a PR-15 warm start: plan depot
+        # + knob profile harvested here, adopted on hostB later)
+        mgr0 = service.JobManager(max_tenants=2)
+        pre = mgr0.submit(
+            service.TenantSpec('prewarm', priority=2, ncores=2,
+                               gulp_nframe=GULP,
+                               source=vic_source()),
+            build=build_vic)
+        pre.start()
+        pre.wait(120)
+        mgr0.shutdown()
+        if pre.state != 'DONE':
+            raise RuntimeError('prewarm job ended %s' % pre.state)
+
+        # ---- phase 1: launch hostA's agent, wire the control plane --
+        proc = subprocess.Popen(
+            [sys.executable, '-c', _SCHED_VIC_SCRIPT, root, spec_path,
+             state_dir, str(NF), str(GULP), str(NCHAN),
+             str(sub_tick)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+        _fabric_read_start(proc, timeout)
+        m_head = fabric.Membership(spec, 'head').start()
+        m_hostB = fabric.Membership(spec, 'hostB').start()
+        membs = [m_head, m_hostB]
+        alive_deadline = time.monotonic() + 15
+        while time.monotonic() < alive_deadline:
+            if m_head.counts()['alive'] >= 2:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError('head membership never saw both '
+                               'workers alive')
+
+        mgrB = service.JobManager(max_tenants=4)
+        sched = scheduler.Scheduler(
+            spec, managers={'hostB': mgrB}, membership=m_head,
+            resume_of=lambda tid, dead: scheduler.ledger_frontier(
+                'sched20', dead, 'stream'),
+            exclude=('head',))
+        tenants = [
+            service.TenantSpec('vic', priority=2, ncores=2,
+                               gulp_nframe=GULP,
+                               source=vic_source(tick_s=0.01)),
+            service.TenantSpec('slo', priority=2, ncores=1,
+                               gulp_nframe=GULP, slo_ms=2000,
+                               quota_bytes_per_s=4096.0,
+                               quota_policy='pace',
+                               source={'kind': 'synthetic',
+                                       'nframe_total': 1600,
+                                       'gulp_nframe': GULP,
+                                       'nchan': 16, 'seed': 5,
+                                       'tsamp': 0.01}),
+            service.TenantSpec('bulk', priority=1, ncores=1,
+                               gulp_nframe=GULP,
+                               quota_bytes_per_s=64000.0,
+                               quota_policy='shed',
+                               source={'kind': 'synthetic',
+                                       'nframe_total': 16000,
+                                       'gulp_nframe': GULP,
+                                       'nchan': 16, 'seed': 6,
+                                       'tick_s': 0.02}),
+        ]
+        placement0 = sched.place(
+            tenants, pinned={'vic': 'hostA', 'slo': 'hostB',
+                             'bulk': 'hostB'})
+        pre_gate_clean = not any(d.is_error
+                                 for d in placement0.diagnostics)
+        sched.set_build('vic', build_vic)
+        jobs = sched.apply(build={'slo': None, 'bulk': None})
+        t0 = time.monotonic()
+        schedule.append(('placed+applied', 0.0))
+
+        builds0 = counters.get('fused.plan_builds')
+        hits0 = counters.get('fused.plan_depot_hits')
+        repl0 = counters.get('scheduler.replacements')
+        mig0 = counters.get('scheduler.migrations')
+        skip0 = counters.get('scheduler.resume.skipped_frames')
+        disp0 = counters.get('scheduler.displaced')
+        arb0 = counters.get('scheduler.arbiter.retunes')
+
+        sched.watch(poll_s=0.1)
+
+        # ---- phase 2: SIGKILL hostA mid-stream -----------------------
+        time.sleep(max(kill_after - (time.monotonic() - t0), 0))
+        schedule.append(('SIGKILL hostA',
+                         round(time.monotonic() - t0, 2)))
+        proc.send_signal(signal_mod.SIGKILL)
+        proc.wait(timeout=10)
+        kill_t = time.monotonic()
+
+        death_detected = False
+        dd = time.monotonic() + 20
+        while time.monotonic() < dd:
+            c = m_head.counts()
+            if 'hostA' in (c.get('dead') or []) and \
+                    c.get('death_events', 0) >= 1:
+                death_detected = True
+                break
+            time.sleep(0.05)
+
+        vic_job = None
+        rd = time.monotonic() + 20
+        while time.monotonic() < rd:
+            vic_job = mgrB.job('vic')
+            if vic_job is not None and vic_job.state in ('RUNNING',
+                                                         'DONE'):
+                break
+            time.sleep(0.05)
+        downtime = time.monotonic() - kill_t
+        schedule.append(('vic resumed on hostB',
+                         round(time.monotonic() - t0, 2)))
+        if vic_job is None:
+            raise RuntimeError('vic was never re-placed onto hostB')
+        vic_job.wait(90)
+        frontier = scheduler.ledger_frontier('sched20', 'hostA',
+                                             'stream')
+        builds_d = counters.get('fused.plan_builds') - builds0
+        hits_d = counters.get('fused.plan_depot_hits') - hits0
+
+        # ---- phase 3: cross-tenant arbitration -----------------------
+        slo_job = jobs['slo']
+        pre_ok = None
+        vd = time.monotonic() + 30
+        while time.monotonic() < vd:
+            r = slo_job.slo_rollup()
+            if r.get('ok') is False:
+                pre_ok = False
+                break
+            if slo_job.state != 'RUNNING':
+                break
+            time.sleep(0.1)
+        viol_age = slo_job.slo_rollup().get('exit_age_p99_s')
+        transfers = sched.arbitrate()
+        schedule.append(('arbitrate',
+                         round(time.monotonic() - t0, 2)))
+        # the boost drains the violator's backlog: fresh observation
+        # windows (stale ages reset, docs/scheduler.md) must come
+        # back under budget before the stream ends
+        post_ok = False
+        ad = time.monotonic() + 30
+        while time.monotonic() < ad:
+            for b in (slo_job.pipeline.blocks
+                      if slo_job.pipeline else []):
+                slo_mod.reset_block_ages(b.name)
+            time.sleep(0.5)
+            r = slo_job.slo_rollup()
+            if r.get('ok') is True:
+                post_ok = True
+                break
+            if slo_job.state != 'RUNNING':
+                break
+
+        # ---- drain + invariants --------------------------------------
+        mgrB.wait(timeout)
+        repl_d = counters.get('scheduler.replacements') - repl0
+        mig_d = counters.get('scheduler.migrations') - mig0
+        skip_d = counters.get('scheduler.resume.skipped_frames') \
+            - skip0
+        disp_d = counters.get('scheduler.displaced') - disp0
+        arb_d = counters.get('scheduler.arbiter.retunes') - arb0
+        stats = {j.spec.id: j.stats() for j in mgrB.jobs()}
+
+        vic_raw = store['raw'][1].result() if len(store['raw']) > 1 \
+            else None
+        expected = service.SyntheticSource.payload(NF, NCHAN, 11)
+        resumed_exact = (vic_raw is not None
+                         and 0 < frontier < NF
+                         and np.array_equal(vic_raw,
+                                            expected[frontier:]))
+        led = fabric.AckLedger('sched20', 'hostA', 'stream')
+        acked_bytes = int(led.acked_bytes)
+        resumed_bytes = 0 if vic_raw is None else vic_raw.nbytes
+        bulk_stats = stats.get('bulk', {})
+        bulk_gulps = (bulk_stats.get('gulps', 0)
+                      + bulk_stats.get('quota_shed_gulps', 0))
+        bulk_bytes = (bulk_stats.get('bytes', 0)
+                      + bulk_stats.get('quota_shed_bytes', 0))
+        invariants = {
+            'no_deadlock': True,       # every phase exited in time
+            'placement_pre_gated': bool(pre_gate_clean),
+            'death_detected': bool(death_detected),
+            'replacement_automatic': bool(
+                repl_d >= 1 and mig_d >= 1
+                and sched.placement.assignments.get('vic')
+                == 'hostB' and vic_job.state == 'DONE'),
+            'warm_zero_recompiles': bool(
+                vic_job.warm and builds_d == 0 and hits_d >= 1),
+            'resume_bounded_loss': bool(
+                0 < frontier < NF and skip_d == frontier),
+            'byte_exact': bool(
+                resumed_exact
+                and NF * rowb == acked_bytes + resumed_bytes),
+            'displaced_sheds_not_deadlocks': bool(
+                'bulk' in sched.placement.displaced and disp_d >= 1
+                and bulk_stats.get('state') == 'DONE'
+                and bulk_stats.get('quota_shed_gulps', 0) > 0
+                and bulk_gulps == 16000 // GULP
+                and bulk_bytes == 16000 * 16 * 4),
+            'arbiter_restored_slo': bool(
+                pre_ok is False and arb_d >= 1 and transfers
+                and transfers[0][0] == 'slo'
+                and transfers[0][1] == 'bulk' and post_ok
+                and stats.get('slo', {}).get('state') == 'DONE'),
+            'scheduler_telemetry': bool(
+                telemetry.snapshot().get('scheduler', {})
+                .get('replacements', 0) >= 1),
+        }
+        return {
+            'config': 'elastic control plane: 3 tenants across 3 '
+                      'hosts, SIGKILL hostA@%.1fs -> automatic warm '
+                      're-placement + ledger resume, priority '
+                      'displacement, cross-tenant arbiter'
+                      % kill_after,
+            'value': round(downtime, 3),
+            'unit': 's SIGKILL-to-resumed downtime (warm, 0 '
+                    'recompiles)',
+            'invariants': invariants,
+            'schedule': schedule,
+            'placement': sched.placement.as_dict(),
+            'ledger': {
+                'produced_bytes': NF * rowb,
+                'acked_before_death_bytes': acked_bytes,
+                'delivered_after_resume_bytes': resumed_bytes,
+                'resume_frontier_frames': frontier,
+                'skipped_frames_counted': skip_d,
+            },
+            'migration': {
+                'downtime_s': round(downtime, 3),
+                'plan_builds': builds_d,
+                'plan_depot_hits': hits_d,
+                'warm_flagged': int(vic_job.warm),
+            },
+            'arbiter': {
+                'violation_p99_s': None if viol_age is None
+                else round(viol_age, 3),
+                'transfers': [[v, d, round(x, 1)]
+                              for v, d, x in transfers],
+                'restored': bool(post_ok),
+            },
+            'tenants': stats,
+            'pass': all(invariants.values()),
+        }
+    finally:
+        if sched is not None:
+            sched.shutdown()
+        for m in membs:
+            try:
+                m.stop()
+            except Exception:
+                pass
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 ALL = {
     1: bench_sigproc_cpu,
     2: bench_spectroscopy,
@@ -3687,13 +4116,14 @@ ALL = {
     17: bench_fabric_chaos,
     18: bench_service,
     19: bench_fxcorr,
+    20: bench_sched_chaos,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-19; 0 = all')
+                    help='config number 1-20; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -3703,7 +4133,8 @@ def main(argv=None):
                     help='flagship pipeline Msamples/s for config 7')
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
-    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16, 18, 19)
+    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16, 18,
+                         19, 20)
                    for c in todo)
     if need_dev:
         from bench import _backend_alive
@@ -4078,6 +4509,71 @@ def _verify_config19():
     return p
 
 
+def _verify_config20():
+    """The elastic-control-plane topology (bench_sched_chaos): the
+    drill's 3-host fabric spec + 3-tenant set must pass the joint
+    ``verify_placement`` pre-gate (no BF-E22x) under the drill's
+    pinning, and every tenant pipeline (source -> quota gate -> sink)
+    must lint clean.  The spec is declarative — no socket binds."""
+    from bifrost_tpu import scheduler, service
+    from bifrost_tpu.analysis import verify
+
+    spec = {
+        'name': 'sched20',
+        'hosts': {
+            'head': {'address': '127.0.0.1', 'control_port': 47200,
+                     'role': 'control', 'cores': [3]},
+            'hostA': {'address': '127.0.0.1', 'control_port': 47201,
+                      'role': 'worker', 'cores': [0, 1]},
+            'hostB': {'address': '127.0.0.1', 'control_port': 47202,
+                      'role': 'worker', 'cores': [0, 1, 2]},
+        },
+        'links': {
+            'stream': {'kind': 'fanin', 'src': ['hostA', 'hostB'],
+                       'dst': 'head', 'port': 47210, 'window': 2,
+                       'gulp_nbyte': 32 * 64 * 4},
+        },
+    }
+    tenants = [
+        service.TenantSpec('vic', priority=2, ncores=2,
+                           gulp_nframe=32,
+                           source={'kind': 'synthetic',
+                                   'nframe_total': 1920,
+                                   'gulp_nframe': 32, 'nchan': 64,
+                                   'seed': 11}),
+        service.TenantSpec('slo', priority=2, ncores=1,
+                           gulp_nframe=32, slo_ms=2000,
+                           quota_bytes_per_s=4096.0,
+                           quota_policy='pace',
+                           source={'kind': 'synthetic',
+                                   'nframe_total': 1600,
+                                   'gulp_nframe': 32, 'nchan': 16,
+                                   'seed': 5}),
+        service.TenantSpec('bulk', priority=1, ncores=1,
+                           gulp_nframe=32,
+                           quota_bytes_per_s=64000.0,
+                           quota_policy='shed',
+                           source={'kind': 'synthetic',
+                                   'nframe_total': 16000,
+                                   'gulp_nframe': 32, 'nchan': 16,
+                                   'seed': 6}),
+    ]
+    placement = scheduler.plan_placement(
+        spec, tenants, exclude=('head',),
+        pinned={'vic': 'hostA', 'slo': 'hostB', 'bulk': 'hostB'})
+    diags = verify.verify_placement(spec, tenants,
+                                    placement.assignments)
+    errs = [d for d in diags if d.is_error]
+    if errs:
+        raise RuntimeError(
+            'placement failed the BF-E22x pre-gate: %s'
+            % '; '.join('%s: %s' % (d.code, d.message)
+                        for d in errs))
+    service.reset_registry()
+    mgr = service.JobManager(max_tenants=4, warm=False)
+    return [mgr.submit(t).pipeline for t in tenants]
+
+
 def build_verify_topologies():
     """{name: builder} over every pipeline-shaped bench config.  Each
     builder returns a Pipeline, a list of Pipelines, or None when the
@@ -4096,6 +4592,7 @@ def build_verify_topologies():
         'config17_fabric': _verify_config17,
         'config18_service': _verify_config18,
         'config19_fxcorr': _verify_config19,
+        'config20_sched': _verify_config20,
     }
 
 

@@ -38,6 +38,7 @@ from copy import deepcopy
 
 __all__ = ['Diagnostic', 'PipelineValidationError', 'CODES',
            'verify_pipeline', 'verify_fabric', 'verify_service',
+           'verify_placement',
            'errors', 'warnings_',
            'format_report', 'gate_run', 'lint_intercept',
            'validate_mode', 'ring_capacity_floors', 'new_errors_vs',
@@ -80,6 +81,14 @@ CODES = {
     'BF-E210': 'duplicate tenant id in a service spec',
     'BF-E211': 'tenant quota smaller than one gulp span',
     'BF-W212': 'tenant core requests oversubscribe the host',
+    'BF-E220': 'tenant core demand exceeds every schedulable host',
+    'BF-E221': 'placement pins a tenant to an unknown fabric host',
+    'BF-E222': 'placement fabric pre-gate failed (verify_fabric '
+               'errors)',
+    'BF-E223': 'placement service pre-gate failed (verify_service '
+               'errors)',
+    'BF-W224': 'placement oversubscribes a host; lower-priority '
+               'tenants are displaced onto shared cores',
     'BF-I199': 'verifier check failed internally (diagnostic only)',
 }
 
@@ -1301,6 +1310,129 @@ def verify_service(specs, ncores=None):
             'shrink the tenant set for isolation'
             % (want, ncores),
             block='tenant:%s' % specs[0].id if specs else None))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# cross-host placement verification (bifrost_tpu.scheduler;
+# docs/scheduler.md)
+# ---------------------------------------------------------------------------
+
+def verify_placement(spec, tenants, assignments):
+    """Jointly pre-gate a cross-host tenant placement BEFORE the
+    scheduler applies it — the composition of :func:`verify_fabric`
+    (over the fabric spec) and :func:`verify_service` (over each
+    host's assigned tenant group at THAT host's core capacity), plus
+    the placement-level findings neither can see alone:
+
+    - **BF-E220** unsatisfiable demand: a tenant's ``ncores`` exceeds
+      the core capacity of EVERY schedulable host — no bin-packing
+      order can place it;
+    - **BF-E221** unknown pin: ``assignments`` maps a tenant onto a
+      host name the fabric spec does not define;
+    - **BF-E222** fabric pre-gate failed: :func:`verify_fabric`
+      returned errors — the placement would launch tenants onto a
+      topology that cannot come up (the underlying BF-E2xx
+      diagnostics are passed through alongside);
+    - **BF-E223** service pre-gate failed: :func:`verify_service`
+      over some host's tenant group returned errors (duplicate ids,
+      shed-quota below one span, ...) — passed through alongside;
+    - **BF-W224** oversubscription: a host's assigned tenants demand
+      more cores than it declares — :func:`affinity.partition_cores`
+      will share cores and the scheduler displaces the
+      lowest-priority tenants' quotas (bounded, counted — never a
+      deadlock).
+
+    ``spec`` is a :class:`bifrost_tpu.fabric.FabricSpec` (or dict),
+    ``tenants`` a list of :class:`bifrost_tpu.service.TenantSpec` (or
+    dicts), ``assignments`` a ``{tenant_id: host_name}`` mapping
+    (tenants absent from it are unplaced and only capacity-checked).
+    Returns :class:`Diagnostic` s anchored on ``tenant:<id>`` /
+    ``host:<name>``."""
+    from ..fabric import FabricSpec
+    from ..service import TenantSpec
+    if isinstance(spec, dict):
+        spec = FabricSpec.from_dict(spec)
+    tenants = [TenantSpec.coerce(t) for t in tenants]
+    assignments = dict(assignments or {})
+    diags = []
+
+    # -- fabric pre-gate (BF-E222) ----------------------------------------
+    fab = verify_fabric(spec)
+    diags.extend(fab)
+    fab_errors = [d for d in fab if d.severity == 'error']
+    if fab_errors:
+        diags.append(Diagnostic(
+            'BF-E222',
+            'placement fabric pre-gate failed: verify_fabric found '
+            '%d error(s) (%s) — no tenant may be placed onto a '
+            'topology that cannot come up'
+            % (len(fab_errors),
+               ', '.join(sorted({d.code for d in fab_errors})))))
+
+    # -- capacity model ----------------------------------------------------
+    # a host that declares cores is schedulable at len(cores); one
+    # that does not still runs tenants on shared cores at capacity 1
+    caps = {name: (len(h.cores) if h.cores else 1)
+            for name, h in spec.hosts.items()}
+    max_cap = max(caps.values()) if caps else 0
+
+    # -- per-tenant findings (BF-E220 / BF-E221) --------------------------
+    by_host = {}
+    for t in tenants:
+        want = max(t.ncores, 1)
+        if want > max_cap:
+            diags.append(Diagnostic(
+                'BF-E220',
+                'tenant %r requests %d core(s) but the largest '
+                'schedulable host offers %d: no placement order can '
+                'satisfy it — shrink ncores or add capacity'
+                % (t.id, want, max_cap),
+                block='tenant:%s' % t.id))
+        host = assignments.get(t.id)
+        if host is None:
+            continue
+        if host not in spec.hosts:
+            diags.append(Diagnostic(
+                'BF-E221',
+                'tenant %r is pinned to host %r, which the fabric '
+                'spec does not define (hosts: %s)'
+                % (t.id, host, ', '.join(sorted(spec.hosts))
+                   or 'none'),
+                block='tenant:%s' % t.id))
+            continue
+        by_host.setdefault(host, []).append(t)
+
+    # -- per-host service pre-gate (BF-E223) and oversubscription
+    #    (BF-W224) ---------------------------------------------------------
+    for host in sorted(by_host):
+        group = by_host[host]
+        svc = verify_service(group, ncores=caps[host])
+        diags.extend(svc)
+        svc_errors = [d for d in svc if d.severity == 'error']
+        if svc_errors:
+            diags.append(Diagnostic(
+                'BF-E223',
+                'placement service pre-gate failed on host %r: '
+                'verify_service found %d error(s) (%s) for its '
+                'tenant group [%s]'
+                % (host, len(svc_errors),
+                   ', '.join(sorted({d.code for d in svc_errors})),
+                   ', '.join(t.id for t in group)),
+                block='host:%s' % host))
+        want = sum(max(t.ncores, 1) for t in group)
+        if want > caps[host]:
+            displaced = sorted(group,
+                               key=lambda t: (t.priority, t.id))
+            diags.append(Diagnostic(
+                'BF-W224',
+                'host %r is oversubscribed: its tenant group '
+                'demands %d core(s) against %d — '
+                'affinity.partition_cores shares cores and the '
+                'scheduler displaces the lowest-priority tenant '
+                '(%r) first (quota scaled, shed counted)'
+                % (host, want, caps[host], displaced[0].id),
+                block='host:%s' % host))
     return diags
 
 

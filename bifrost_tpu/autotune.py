@@ -256,6 +256,49 @@ def adopt_profile(pipeline, knobs):
     return applied
 
 
+def gated_retune(pipeline, knobs):
+    """Verifier-gated LIVE retune of a (possibly running) pipeline —
+    the cross-tenant arbiter's write path (bifrost_tpu.scheduler,
+    docs/scheduler.md): the candidate knob set rides
+    ``verify.scope_overrides``, is diffed against the pipeline's
+    CURRENT diagnostics (``new_errors_vs``), and only applies (via
+    :func:`adopt_profile`) when it introduces no new BF-E — exactly
+    the retune protocol the in-pipeline controller uses, exposed for
+    a controller that sits OUTSIDE the pipeline.  Returns True when
+    applied; refusals count on ``autotune.rejected``."""
+    from .analysis import verify
+    knobs = dict(knobs or {})
+    overrides = {}
+    if 'gulp_batch' in knobs:
+        try:
+            overrides['gulp_batch'] = int(knobs['gulp_batch'])
+        except (TypeError, ValueError):
+            knobs.pop('gulp_batch')
+    windows = knobs.get('bridge_window') or {}
+    if isinstance(windows, dict) and windows:
+        try:
+            _sig, bmap, _rmap = topology_signature(pipeline)
+            live = {v: k for k, v in bmap.items()}
+        except Exception:
+            live = {}
+        overrides['bridge_window'] = {
+            live.get(key, key): w for key, w in windows.items()}
+    if overrides:
+        try:
+            baseline = verify.verify_pipeline(pipeline)
+            with verify.scope_overrides(overrides):
+                cand = verify.verify_pipeline(pipeline)
+        except Exception:
+            baseline, cand = [], []   # never let the gate crash a
+            #                           control loop
+        if verify.new_errors_vs(baseline, cand):
+            from .telemetry import counters
+            counters.inc('autotune.rejected')
+            return False
+    adopt_profile(pipeline, knobs)
+    return True
+
+
 def _pipeline_rings(pipeline):
     """{name: base ring} over every ring the pipeline's blocks touch."""
     rings = {}

@@ -370,6 +370,10 @@ class BridgeSource(_BridgeBlock):
         self.adopt_sessions = bool(adopt_sessions)
         self.reconnect_max = _reconnect_budget() if reconnect_max is None \
             else int(reconnect_max)
+        #: forwarded onto the receiver: fired when a new sender
+        #: session is adopted or a resume probe answered (the fabric
+        #: wires this to Membership.confirm_resume)
+        self.on_session_adopted = None
         self.out_proclog = ProcLog(self.name + '/out')
         rnames = {'nring': len(self.orings)}
         for i, r in enumerate(self.orings):
@@ -402,6 +406,7 @@ class BridgeSource(_BridgeBlock):
                 adopt_sessions=self.adopt_sessions)
         else:
             self._receiver.sock = self.listener
+        self._receiver.on_session_adopted = self.on_session_adopted
         receiver = self._receiver
         attempts = 0
         try:

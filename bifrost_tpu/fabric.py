@@ -322,7 +322,17 @@ class Membership(object):
     REJOIN (``fabric.peers.rejoined``).  The fan-out/fan-in blocks
     consult :meth:`is_dead` for their re-striping / gap-marking
     choreography; ``fabric/membership`` ProcLog publishes the live
-    table."""
+    table.
+
+    Beats carry a per-process ``session`` token: a peer heard under a
+    NEW session (it restarted — new pid) is held as a fresh unknown
+    peer for one heartbeat interval before being adopted, so a
+    half-initialised restart cannot flap the death choreography.
+    :meth:`confirm_resume` short-circuits the hold-down the moment a
+    resume probe from the new session matches (the bridge receivers
+    wire this through ``on_session_adopted``).  Session-change
+    adoptions count on ``fabric.peers.readopted``, separately from
+    the dead-to-alive ``fabric.peers.rejoined``."""
 
     def __init__(self, spec, host, state_cb=None):
         self.spec = spec
@@ -330,8 +340,16 @@ class Membership(object):
         self.role = spec.hosts[host].role
         self.state_cb = state_cb      # () -> fabric state string
         self.peers = spec.peers_of(host)
+        self.session = '%d.%x' % (os.getpid(),
+                                  int(time.time() * 1e3) & 0xffffff)
         self._last_seen = {}
         self._peer_state = {}
+        self._peer_session = {}
+        #: peer -> (new_session, state, first_heard) while held down
+        self._pending = {}
+        #: peers vouched for by a resume probe before their first
+        #: new-session beat arrived (probe/beat race on rejoin)
+        self._preconfirmed = set()
         self._dead = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -341,6 +359,7 @@ class Membership(object):
         self._proclog = None
         self._death_events = 0
         self._rejoin_events = 0
+        self._readopt_events = 0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -406,7 +425,42 @@ class Membership(object):
         return {'total': len(self.peers),
                 'alive': len(self.peers) - len(dead), 'dead': dead,
                 'death_events': self._death_events,
-                'rejoin_events': self._rejoin_events}
+                'rejoin_events': self._rejoin_events,
+                'readopt_events': self._readopt_events}
+
+    def confirm_resume(self, peer):
+        """A resume probe from ``peer``'s NEW session matched — adopt
+        it immediately instead of waiting out the one-heartbeat
+        hold-down.  Called by the bridge receivers' session-adoption
+        hook; safe to call for peers not currently held (the
+        confirmation is remembered for the probe-before-beat race)."""
+        rejoined = readopted = False
+        with self._lock:
+            if peer in self._pending:
+                readopted, rejoined = self._adopt_locked(
+                    peer, time.monotonic())
+            elif peer in self.peers:
+                self._preconfirmed.add(peer)
+        if rejoined:
+            counters.inc('fabric.peers.rejoined')
+        if readopted:
+            counters.inc('fabric.peers.readopted')
+
+    def _adopt_locked(self, peer, now):
+        """Promote a held-down new-session peer to alive.  Returns
+        (readopted, rejoined) for the caller to count OUTSIDE the
+        lock."""
+        session, state, _first = self._pending.pop(peer)
+        self._preconfirmed.discard(peer)
+        self._peer_session[peer] = session
+        self._last_seen[peer] = now
+        self._peer_state[peer] = state
+        was_dead = peer in self._dead
+        if was_dead:
+            self._dead.discard(peer)
+            self._rejoin_events += 1
+        self._readopt_events += 1
+        return True, was_dead
 
     # -- loop --------------------------------------------------------------
     def _run(self):
@@ -427,7 +481,8 @@ class Membership(object):
                         pass
                 payload = json.dumps(
                     {'host': self.host, 'role': self.role,
-                     'state': state}).encode()
+                     'state': state,
+                     'session': self.session}).encode()
                 for addr, port, _p in targets:
                     try:
                         self._sock.sendto(payload, (addr, port))
@@ -451,15 +506,40 @@ class Membership(object):
                 continue
             if peer in self.peers:
                 counters.inc('fabric.heartbeats.rx')
+                session = beat.get('session')
+                state = beat.get('state', '?')
+                hb_now = time.monotonic()
+                rejoined = readopted = False
                 with self._lock:
-                    was_dead = peer in self._dead
-                    self._last_seen[peer] = time.monotonic()
-                    self._peer_state[peer] = beat.get('state', '?')
-                    if was_dead:
-                        self._dead.discard(peer)
-                        self._rejoin_events += 1
-                if was_dead:
+                    known = self._peer_session.get(peer)
+                    if session is not None and known is not None \
+                            and session != known:
+                        # restarted peer (new pid/session): hold it
+                        # as a fresh unknown for one heartbeat
+                        # interval — unless a resume probe already
+                        # vouched for the new session
+                        pend = self._pending.get(peer)
+                        first = pend[2] if pend and pend[0] == session \
+                            else hb_now
+                        self._pending[peer] = (session, state, first)
+                        if peer in self._preconfirmed or \
+                                hb_now - first >= _hb_secs():
+                            readopted, rejoined = \
+                                self._adopt_locked(peer, hb_now)
+                    else:
+                        if session is not None:
+                            self._peer_session[peer] = session
+                        was_dead = peer in self._dead
+                        self._last_seen[peer] = hb_now
+                        self._peer_state[peer] = state
+                        if was_dead:
+                            self._dead.discard(peer)
+                            self._rejoin_events += 1
+                            rejoined = True
+                if rejoined:
                     counters.inc('fabric.peers.rejoined')
+                if readopted:
+                    counters.inc('fabric.peers.readopted')
 
     def _check_deaths(self, now):
         newly = []
@@ -1181,6 +1261,15 @@ class FabricHost(object):
                     me.bind_address, link.port + off,
                     adopt_sessions=True, crc=link.crc,
                     name='rx_%s_%d' % (link.name, off))
+                # a resume probe / session adoption on this endpoint
+                # vouches for the (possibly restarted) origin host:
+                # end its membership hold-down immediately instead of
+                # waiting out a heartbeat interval
+                origin = link.src[off] if link.kind == 'fanin' \
+                    else link.src[0]
+                src.on_session_adopted = (
+                    lambda peer=origin:
+                    self.membership.confirm_resume(peer))
                 if link.kind == 'fanin':
                     fanin_parts.setdefault(link.name, []).append(
                         (off, src))

@@ -1649,6 +1649,12 @@ class RingReceiver(object):
         self._frames_by_seq = {}
         self._cur_seq_key = None
         self._sessions_adopted = 0
+        #: optional hook fired (no args) when a NEW session is
+        #: adopted or a resume probe is answered — the fabric wires
+        #: this to ``Membership.confirm_resume`` so a restarted
+        #: peer's hold-down ends the moment its resume choreography
+        #: touches this receiver (docs/scheduler.md)
+        self.on_session_adopted = None
 
     # -- public ------------------------------------------------------------
     def run(self):
@@ -2008,6 +2014,11 @@ class RingReceiver(object):
                 sock.close()
             except OSError:
                 pass
+        if self.on_session_adopted is not None:
+            try:
+                self.on_session_adopted()
+            except Exception:
+                pass
 
     def _handshake(self, socks, hello):
         self._protocol = 2
@@ -2029,6 +2040,11 @@ class RingReceiver(object):
             self._expected = 0
             self._sessions_adopted += 1
             _counters().inc('bridge.rx.sessions_adopted')
+            if self.on_session_adopted is not None:
+                try:
+                    self.on_session_adopted()
+                except Exception:
+                    pass
         self._session = session
         if session:
             # register the session in this process's trace metadata so

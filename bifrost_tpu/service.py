@@ -215,13 +215,24 @@ class SyntheticSource(SourceBlock):
     the payload reproducible so sinks can assert byte-correctness."""
 
     def __init__(self, nframe_total, gulp_nframe, nchan=16, seed=0,
-                 tick_s=0.0, name_prefix='synthetic', *args, **kwargs):
+                 tick_s=0.0, start_frame=0, tsamp=None,
+                 name_prefix='synthetic', *args, **kwargs):
         super(SyntheticSource, self).__init__(
             [name_prefix], gulp_nframe, *args, **kwargs)
         self.nframe_total = int(nframe_total)
         self.nchan = int(nchan)
         self.seed = int(seed)
         self.tick_s = float(tick_s)
+        #: declared real-time frame cadence (seconds/frame).  The SLO
+        #: age math extrapolates a frame's capture instant from the
+        #: header tsamp, so a stream that MEANS "100 frames/s" must
+        #: say so or a quota-paced consumer looks progressively stale
+        #: against the sequence origin (docs/scheduler.md, arbiter).
+        self.tsamp = None if tsamp is None else float(tsamp)
+        #: resume support (docs/scheduler.md): a migrated tenant
+        #: replays only the frames its downstream never committed —
+        #: the scheduler sets this from the durable AckLedger frontier
+        self.start_frame = max(int(start_frame), 0)
 
     @staticmethod
     def payload(nframe_total, nchan, seed):
@@ -240,11 +251,12 @@ class SyntheticSource(SourceBlock):
         return _R()
 
     def _header(self, sourcename):
+        ts = self.tsamp if self.tsamp else 1e-6
         return {'name': sourcename,
-                'tsamp': 1e-6,
+                'tsamp': ts,
                 '_tensor': {'shape': [-1, self.nchan], 'dtype': 'f32',
                             'labels': ['time', 'chan'],
-                            'scales': [[0, 1e-6], [0, 1]],
+                            'scales': [[0, ts], [0, 1]],
                             'units': ['s', None]}}
 
     def static_oheaders(self):
@@ -253,7 +265,7 @@ class SyntheticSource(SourceBlock):
     def on_sequence(self, reader, sourcename):
         self._data = self.payload(self.nframe_total, self.nchan,
                                   self.seed)
-        self._pos = 0
+        self._pos = min(self.start_frame, self.nframe_total)
         return [self._header(sourcename)]
 
     def on_data(self, reader, ospans):
@@ -306,6 +318,27 @@ class QuotaGate(TransformBlock):
 
     def on_sequence(self, iseq):
         return dict(iseq.header)
+
+    def retune(self, quota_bytes_per_s):
+        """Live quota change (the scheduler's cross-tenant arbiter):
+        the refill rate moves immediately; the burst capacity keeps
+        its one-gulp floor so a 'shed' stream never deadlocks on its
+        own span size.  Counted on ``service.<id>.quota_retunes``."""
+        new = max(float(quota_bytes_per_s or 0), 0.0)
+        self.quota_bytes_per_s = new
+        bucket = self._bucket
+        if bucket is not None:
+            if new <= 0:
+                self._bucket = None    # unlimited: plain counted copy
+            else:
+                burst = max(_env_float('BF_SERVE_QUOTA_BURST', 0.1),
+                            1e-3)
+                bucket.rate = new
+                # _take restores the one-gulp capacity floor on the
+                # next span, so a shrink cannot strand the stream
+                bucket.capacity = max(new * burst, 1.0)
+                bucket.tokens = min(bucket.tokens, bucket.capacity)
+        counters.inc('service.%s.quota_retunes' % self.tenant_id)
 
     def _take(self, nbyte):
         """True when the gulp is admitted (sleeping the debt under
@@ -472,7 +505,9 @@ def _build_source(spec, job):
             int(src.get('gulp_nframe') or spec.gulp_nframe or 64),
             nchan=int(src.get('nchan', 16)),
             seed=int(src.get('seed', 0)),
-            tick_s=float(src.get('tick_s', 0.0))), None
+            tick_s=float(src.get('tick_s', 0.0)),
+            start_frame=int(src.get('start_frame', 0)),
+            tsamp=src.get('tsamp')), None
     if kind == 'udp':
         pump = _UdpCapturePump(src, spec.id)
         return pump.ring, pump
@@ -526,6 +561,46 @@ def _harvest_knobs(pipeline):
     from .macro import resolve_gulp_batch
     return {'sync_depth': resolve_sync_depth(pipeline),
             'gulp_batch': resolve_gulp_batch(pipeline)}
+
+
+def _warm_floors_violate(pipeline, knobs):
+    """Would adopting a harvested profile's geometry knobs push a
+    ring-capacity floor past THIS build's verifier bound?  Matching
+    plan signatures prove the topology is identical, but the TARGET
+    host may declare smaller rings than the harvest host did (a
+    migration lands on whatever the survivor provisioned) — a warm
+    start must not import a gulp_batch/window the local verifier
+    rejects (BF-E101 and friends).  Same ``scope_overrides`` +
+    ``new_errors_vs`` gate as ``autotune._profile_safe``."""
+    from .analysis import verify
+    overrides = {}
+    try:
+        gb = (knobs or {}).get('gulp_batch')
+        if gb is not None and int(gb) > 1:
+            overrides['gulp_batch'] = int(gb)
+    except (TypeError, ValueError):
+        pass
+    windows = (knobs or {}).get('bridge_window') or {}
+    if isinstance(windows, dict) and windows:
+        # v2 profiles key by structural key — translate to the LIVE
+        # block names the verifier's checks match against
+        try:
+            from .autotune import topology_signature
+            _sig, bmap, _rmap = topology_signature(pipeline)
+            live = {v: k for k, v in bmap.items()}
+        except Exception:
+            live = {}
+        overrides['bridge_window'] = {
+            live.get(key, key): w for key, w in windows.items()}
+    if not overrides:
+        return False
+    try:
+        baseline = verify.verify_pipeline(pipeline)
+        with verify.scope_overrides(overrides):
+            cand = verify.verify_pipeline(pipeline)
+    except Exception:
+        return False              # never let the gate kill admission
+    return bool(verify.new_errors_vs(baseline, cand))
 
 
 # ---------------------------------------------------------------------------
@@ -958,6 +1033,13 @@ class JobManager(object):
         if ws is not None:
             stale = (ws['plan_sigs'] != job._plan_sigs or
                      any(v is None for v in job._plan_sigs.values()))
+            # signatures alone are not sufficient: the profile's
+            # geometry knobs must also clear THIS host's ring-capacity
+            # floors (a migration target may provision smaller rings
+            # than the harvest host)
+            if not stale and _warm_floors_violate(job.pipeline,
+                                                  ws.get('knobs')):
+                stale = True
             if stale:
                 job.warm_rejected = True
                 counters.inc('service.warm.rejected_stale')

@@ -45,6 +45,7 @@ All of it is testable on CPU through the deterministic fault harness in
 from __future__ import annotations
 
 import os
+import random
 import sys
 import threading
 import time
@@ -80,6 +81,19 @@ def _env_int(name, default):
         return int(os.environ.get(name, '') or default)
     except ValueError:
         return default
+
+
+def jittered_backoff(attempt, base=0.1, cap=_BACKOFF_CAP,
+                     jitter=0.0):
+    """Exponential backoff delay for retry ``attempt`` (0-based):
+    ``min(base * 2**attempt, cap)``, plus an optional uniform random
+    slice of ``jitter * delay`` so a fleet retrying in lockstep
+    de-synchronizes — the one backoff curve shared by the block
+    supervisor and the scheduler's re-placement loop."""
+    delay = min(base * (2 ** attempt), cap)
+    if jitter > 0:
+        delay += random.uniform(0, jitter * delay)
+    return delay
 
 
 class BlockFailure(object):
@@ -225,7 +239,7 @@ class Supervisor(object):
     def _backoff(self, block, restarts):
         base = getattr(block, 'restart_backoff', None)
         base = self.default_backoff if base is None else float(base)
-        return min(base * (2 ** restarts), _BACKOFF_CAP)
+        return jittered_backoff(restarts, base=base)
 
     # -- failure reporting (called from block threads) ---------------------
     def record(self, failure):

@@ -167,6 +167,21 @@ def _tenant_section():
         return {}
 
 
+def _scheduler_section():
+    """The elastic control plane's placement/migration counters
+    (bifrost_tpu.scheduler.telemetry_section — docs/scheduler.md),
+    or {} when no scheduler is live in this process.  Same
+    lazy-import gate as the tenant section."""
+    import sys
+    if 'bifrost_tpu.scheduler' not in sys.modules:
+        return {}
+    try:
+        from .. import scheduler
+        return scheduler.telemetry_section()
+    except Exception:
+        return {}
+
+
 #: mesh counter prefixes folded into the snapshot's 'mesh' summary
 _MESH_KEYS = ('mesh.reshards', 'mesh.reshard_bytes',
               'mesh.sharded_commits', 'mesh.layout_mismatch',
@@ -197,6 +212,7 @@ def snapshot(pipeline=None, rates=False):
          'mesh':       {reshards,sharded_commits,collectives,...},
          'tenants':    {tenant_id: {state,health,gulps,bytes,
                         quota_shed_*,ring_shed_*,slo,...}},
+         'scheduler':  {placements,migrations,replacements,...},
          'rates':      {dt, counters: {name: per_s},
                         histograms: {name: {count_per_s, sum_per_s}}}}
 
@@ -238,6 +254,7 @@ def snapshot(pipeline=None, rates=False):
         'devices': _device_stats(),
         'mesh': _mesh_summary(counts),
         'tenants': _tenant_section(),
+        'scheduler': _scheduler_section(),
         'identity': identity,
     }
     if rates:

@@ -234,7 +234,8 @@ def test_snapshot_merges_counters_histograms_rings():
     p, sink = _run_simple_pipeline(ngulp=5)
     snap = bf.telemetry.snapshot()
     assert set(snap) == {'counters', 'histograms', 'rings',
-                         'devices', 'mesh', 'tenants', 'identity'}
+                         'devices', 'mesh', 'tenants', 'scheduler',
+                         'identity'}
     assert snap['identity']['pid'] == os.getpid()
     assert snap['counters'].get('pipeline.gulps', 0) > 0
     assert any(k.startswith('block.') and k.endswith('.gulp_s')

@@ -27,7 +27,12 @@ Subcommands::
     bf_fabric.py status
         One-shot fabric status from the local proclog tree: every
         launcher's ``fabric/health`` row (state, peers, end-to-end
-        age p99).
+        age p99), followed by the joined per-host × per-tenant
+        rollup (``fabric/health`` + ``service/tenants`` +
+        ``sched/placements`` merged into one table —
+        ``bifrost_tpu.scheduler.joined_rollup``, the same table
+        ``bf_sched.py status`` prints and like_top renders as
+        ``[sched]``).
 
 The builder spec ``pkg.mod:fn`` imports ``pkg.mod`` and calls ``fn``
 with the context; relative module paths resolve from the CWD.
@@ -145,6 +150,13 @@ def cmd_status(args):
     if not rows:
         print('bf_fabric: no fabric launchers found in the proclog '
               'tree (%s)' % proclog.proclog_dir())
+    # joined host × tenant rollup: fabric/health + service/tenants +
+    # sched/placements merged (docs/scheduler.md)
+    from bifrost_tpu.scheduler import joined_rollup, format_rollup
+    joined = joined_rollup()
+    if any(r['tenants'] for r in joined):
+        print('bf_fabric: host × tenant rollup:')
+        print(format_rollup(joined))
     return 0
 
 

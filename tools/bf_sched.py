@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Scheduler CLI: plan, lint, and inspect cross-host tenant
+placements (bifrost_tpu.scheduler; docs/scheduler.md).
+
+Subcommands::
+
+    bf_sched.py plan fabric.json service.json
+        Bin-pack the service spec's tenants across the fabric spec's
+        hosts (priority-weighted worst-fit on declared cores), run
+        the joint verify_placement pre-gate (verify_fabric +
+        verify_service + the BF-E22x placement codes), and print the
+        placement table.  Exit 0 when the plan is admissible, 3 on
+        any BF-E, 2 when a spec cannot be read.
+
+    bf_sched.py lint fabric.json service.json
+        Same gate, diagnostics-only output (no table) — the
+        scheduler-level sibling of ``bf_fabric.py lint`` /
+        ``bf_serve.py --validate``.
+
+    bf_sched.py status
+        One-shot joined per-host × per-tenant health rollup from the
+        local proclog tree: every process's ``fabric/health`` row
+        merged with its ``service/tenants`` and ``sched/placements``
+        rows (the same table ``bf_fabric.py status`` appends and
+        like_top renders as ``[sched]``).
+
+Knobs (docs/envvars.md): ``BF_SCHED_REBALANCE_SECS`` death-watch
+poll, ``BF_SCHED_DISPLACE_QUOTA_FRAC`` displaced-tenant quota scale,
+``BF_SCHED_MAX_REPLACEMENTS`` re-placement event cap,
+``BF_SCHED_ARBITER_FRAC`` arbiter quota-transfer fraction.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _load(fabric_path, service_path):
+    from bifrost_tpu.fabric import FabricSpec
+    from bifrost_tpu.service import TenantSpec
+    spec = FabricSpec.load(fabric_path)
+    with open(service_path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or not doc.get('tenants'):
+        raise ValueError('service spec must be a JSON object with a '
+                         'non-empty "tenants" list')
+    tenants = [TenantSpec.coerce(t) for t in doc['tenants']]
+    return spec, tenants
+
+
+def _plan_and_gate(args):
+    from bifrost_tpu import scheduler
+    from bifrost_tpu.analysis import verify
+    try:
+        spec, tenants = _load(args.fabric, args.service)
+    except (OSError, ValueError) as exc:
+        print('bf_sched: cannot read specs: %s' % exc)
+        return None, None, None, 2
+    try:
+        placement = scheduler.plan_placement(
+            spec, tenants,
+            exclude=[h for h in (args.exclude or '').split(',') if h])
+    except scheduler.PlacementError as exc:
+        for d in exc.diagnostics:
+            print('bf_sched: %r' % d)
+        print('bf_sched: placement infeasible (%d error(s))'
+              % len(exc.diagnostics))
+        return None, None, None, 3
+    diags = verify.verify_placement(spec, tenants,
+                                    placement.assignments)
+    return (spec, tenants, placement, diags)
+
+
+def cmd_plan(args):
+    res = _plan_and_gate(args)
+    if isinstance(res[3], int):          # load/plan failure exit code
+        return res[3]
+    spec, tenants, placement, diags = res
+    for d in diags:
+        print('bf_sched: %r' % d)
+    print('bf_sched: fabric %r: %d host(s), %d tenant(s), '
+          '%d diagnostic(s)' % (spec.name, len(spec.hosts),
+                                len(tenants), len(diags)))
+    for host in sorted(placement.capacity):
+        tids = placement.tenants_on(host)
+        print('  host %-12s cores=%d demand=%d  %s%s'
+              % (host, placement.capacity[host],
+                 placement.demand.get(host, 0),
+                 ' '.join(tids) or '(idle)',
+                 '  OVERSUBSCRIBED' if placement.demand.get(host, 0)
+                 > placement.capacity[host] else ''))
+    if placement.displaced:
+        print('  displaced (quota-scaled, shed by policy): %s'
+              % ', '.join(placement.displaced))
+    nerr = sum(1 for d in diags if d.is_error)
+    print('bf_sched: plan %s' % ('PASS' if nerr == 0
+                                 else 'FAIL (%d error(s))' % nerr))
+    return 3 if nerr else 0
+
+
+def cmd_lint(args):
+    res = _plan_and_gate(args)
+    if isinstance(res[3], int):          # load/plan failure exit code
+        return res[3]
+    spec, tenants, _placement, diags = res
+    from bifrost_tpu.analysis.verify import format_report, errors
+    print('bf_sched: fabric %r × %d tenant(s): %d diagnostic(s)'
+          % (spec.name, len(tenants), len(diags)))
+    print(format_report(diags) if diags else '  (clean)')
+    return 3 if errors(diags) else 0
+
+
+def cmd_status(args):
+    from bifrost_tpu import scheduler
+    rows = scheduler.joined_rollup()
+    print(scheduler.format_rollup(rows))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest='cmd', required=True)
+    for name, fn, helptext in (
+            ('plan', cmd_plan,
+             'bin-pack tenants across hosts and print the table'),
+            ('lint', cmd_lint,
+             'joint placement pre-gate, diagnostics only')):
+        p = sub.add_parser(name, help=helptext)
+        p.add_argument('fabric', help='fabric spec JSON')
+        p.add_argument('service', help='service spec JSON')
+        p.add_argument('--exclude', default='',
+                       help='comma-separated hosts to treat as dead')
+        p.set_defaults(fn=fn)
+    p = sub.add_parser('status',
+                       help='joined host × tenant rollup from '
+                            'proclogs')
+    p.set_defaults(fn=cmd_status)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

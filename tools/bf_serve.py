@@ -155,6 +155,18 @@ def main():
         mgr.shutdown()
     out = service.telemetry_section()
     print(json.dumps(out, indent=1, sort_keys=True, default=str))
+    # joined host × tenant rollup (docs/scheduler.md): when this
+    # service runs beside a fabric launcher or scheduler, show the
+    # merged per-host table too — the same one bf_fabric.py status /
+    # bf_sched.py status print
+    try:
+        from bifrost_tpu.scheduler import joined_rollup, format_rollup
+        joined = joined_rollup()
+        if any(r['tenants'] for r in joined):
+            print('bf_serve: host × tenant rollup:')
+            print(format_rollup(joined))
+    except Exception:
+        pass
     failed = [tid for tid, d in out.items()
               if d.get('state') == 'FAILED']
     if failed:

@@ -174,17 +174,19 @@ def get_device_memory_usage(timeout=10.0):
 
 
 def collect_blocks(pids=None, autotune=None, health=None, fabric=None,
-                   tenants=None):
+                   tenants=None, sched=None):
     """Per-block rows across pipelines: pid/name/cmd/core and the perf
     times (reference: like_top.py:305-330).  Pass a dict as
     ``autotune`` to collect each process's ``analysis/autotune`` knob
     panel — as ``health`` its ``pipeline/health`` state row
     (docs/robustness.md) — as ``fabric`` its ``fabric/health``
-    membership/end-to-end row (docs/fabric.md) — and as ``tenants``
+    membership/end-to-end row (docs/fabric.md) — as ``tenants``
     its ``service/tenants`` multi-tenant pane (docs/service.md) —
-    from the SAME proclog walk (a separate collect pass would
-    re-parse every proclog file per refresh).  ``pids`` entries may be
-    bare PIDs or fabric instance strings (``<pid>@<host>.<role>``)."""
+    and as ``sched`` its ``sched/placements`` control-plane row
+    (docs/scheduler.md) — from the SAME proclog walk (a separate
+    collect pass would re-parse every proclog file per refresh).
+    ``pids`` entries may be bare PIDs or fabric instance strings
+    (``<pid>@<host>.<role>``)."""
     rows = {}
     for pid in (pids if pids is not None else list_pipelines()):
         contents = proclog.load_by_pid(pid)
@@ -204,6 +206,10 @@ def collect_blocks(pids=None, autotune=None, health=None, fabric=None,
             trow = contents.get('service', {}).get('tenants')
             if trow:
                 tenants[pid] = trow
+        if sched is not None:
+            srow = contents.get('sched', {}).get('placements')
+            if srow:
+                sched[pid] = srow
         cmd = get_command_line(pid)
         for block, logs in contents.items():
             if block == 'rings':
@@ -269,7 +275,7 @@ def collect_autotune(pids=None):
 
 def render_text(load, cpu, mem, dev, rows, tuners=None,
                 sort_key='process', sort_rev=True, width=140,
-                health=None, fabric=None, tenants=None):
+                health=None, fabric=None, tenants=None, sched=None):
     """Render the full display as text lines (shared by --once and the
     curses loop)."""
     host = socket.gethostname()
@@ -382,6 +388,34 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
                           'yes' if _num(f('warm', 0)) else 'no',
                           ('%.1f' % _num(age)) if age not in
                           (None, '') else '-'))
+    # elastic control-plane placements pane (sched/placements
+    # ProcLog, published by the cross-host Scheduler —
+    # docs/scheduler.md): which host each tenant landed on, whether
+    # it was displaced by bin-packing, and how many dead-host
+    # re-placement events have fired
+    for pid in sorted(sched or {}, key=str):
+        s = sched[pid]
+        tids = sorted({k.split('.', 2)[1] for k in s
+                       if k.startswith('p.') and k.count('.') >= 2})
+        out.append('')
+        out.append('[sched] pid %s  fabric %s  %s tenant(s)  '
+                   'replacements %s%s'
+                   % (pid, s.get('fabric', '?'),
+                      s.get('ntenants', len(tids)),
+                      s.get('replacement_events', 0),
+                      ('  dead: %s' % s['dead_hosts'])
+                      if s.get('dead_hosts') not in
+                      (None, '', 'none') else ''))
+        if tids:
+            placed = []
+            for tid in tids:
+                hostname = s.get('p.%s.host' % tid, '?')
+                disp = _num(s.get('p.%s.displaced' % tid, 0))
+                placed.append('%s->%s%s' % (tid, hostname,
+                                            '(displaced)' if disp
+                                            else ''))
+            out.append('   ' + '  '.join(placed)
+                       [:max(width - 3, 0)])
     # live auto-tuner knob panel (analysis/autotune ProcLog, fed by
     # the autotune.* counters — docs/autotune.md)
     for pid in sorted(tuners or {}, key=str):
@@ -428,21 +462,21 @@ def run_curses(args):
                 sort_key = new_key
             now = time.time()
             if now - t_last > args.interval or state is None:
-                tuners, health, fab, tens = {}, {}, {}, {}
+                tuners, health, fab, tens, schd = {}, {}, {}, {}, {}
                 state = (get_load_average(), get_processor_usage(),
                          get_memory_swap_usage(),
                          get_device_memory_usage() if args.devices
                          else None,
                          collect_blocks(autotune=tuners,
                                         health=health, fabric=fab,
-                                        tenants=tens),
-                         tuners, health, fab, tens)
+                                        tenants=tens, sched=schd),
+                         tuners, health, fab, tens, schd)
                 t_last = now
             maxy, maxx = scr.getmaxyx()
             lines = render_text(*state[:6], sort_key=sort_key,
                                 sort_rev=sort_rev, width=maxx,
                                 health=state[6], fabric=state[7],
-                                tenants=state[8])
+                                tenants=state[8], sched=state[9])
             for y, line in enumerate(lines[:maxy - 1]):
                 attr = curses.A_REVERSE if line.startswith('   PID') \
                     else curses.A_NORMAL
@@ -474,15 +508,15 @@ def main():
     if args.once:
         get_processor_usage()        # prime the delta state
         time.sleep(0.05)
-        tuners, health, fab, tens = {}, {}, {}, {}
+        tuners, health, fab, tens, schd = {}, {}, {}, {}, {}
         lines = render_text(
             get_load_average(), get_processor_usage(),
             get_memory_swap_usage(),
             get_device_memory_usage() if args.devices else None,
             collect_blocks(autotune=tuners, health=health, fabric=fab,
-                           tenants=tens),
+                           tenants=tens, sched=schd),
             tuners, sort_key=args.sort, health=health, fabric=fab,
-            tenants=tens)
+            tenants=tens, sched=schd)
         print('\n'.join(lines))
         return 0
     run_curses(args)

@@ -71,6 +71,14 @@ WATCHLIST = [
     # X-engine's winner rate — a drop means the quantized candidate
     # stopped winning or the race landed somewhere slower
     ('*xengine.gops_per_s*', 'lower', 'pct', 10.0),
+    # elastic control plane (SCHED_CHAOS, config 20): the chaos drill
+    # SIGKILLs a host mid-stream — fewer migrations or re-placement
+    # events between same-config rounds means the death watch or the
+    # re-placement path silently disengaged and the drill stopped
+    # exercising what it gates
+    # (no trailing glob: 'replacements_refused' DROPPING is fine)
+    ('*scheduler.migrations', 'lower', 'any', 0.0),
+    ('*scheduler.replacements', 'lower', 'any', 0.0),
     ('*crc_errors*', 'higher', 'any', 0.0),
     ('*reconnects*', 'higher', 'any', 0.0),
     ('*fallback*', 'higher', 'any', 0.0),

@@ -62,6 +62,14 @@
 #                               only unacked frames, dead origin gapped
 #                               not stalled, produced == delivered +
 #                               shed byte-exact; tools/fabric_gate.py)
+#   SCHED_CHAOS_${ROUND}.json - elastic control-plane gate (config 20
+#                               on CPU: a SIGKILL'd host's tenants
+#                               re-place automatically onto survivors
+#                               as warm zero-recompile starts resuming
+#                               from the durable ledger frontier;
+#                               displacement sheds by policy and the
+#                               cross-tenant arbiter restores the SLO
+#                               violator; tools/sched_gate.py)
 #   bench_watch.log           - probe/attempt history (gitignored)
 cd "$(dirname "$0")/.." || exit 1
 ROUND="${BF_BENCH_ROUND:-r$(date -u +%Y%m%d)}"
@@ -301,6 +309,25 @@ for i in $(seq 1 400); do
         if [ "$src_gate" -ne 0 ]; then
           echo "$(date -u +%FT%TZ) multi-tenant service gate FAILED" >> "$LOG"
           exit "$src_gate"
+        fi
+      fi
+      # Elastic control-plane gate: config 20 on CPU — the scheduler
+      # must pre-gate the cross-host placement (BF-E22x), detect a
+      # SIGKILLed host, automatically re-place its tenant as a WARM
+      # zero-recompile start resuming from the durable AckLedger
+      # frontier (byte-exact, bounded counted loss), displace the
+      # lowest-priority tenant on the oversubscribed survivor (shed
+      # by policy, no deadlock), and restore an SLO violator through
+      # the cross-tenant arbiter (tools/sched_gate.py;
+      # docs/scheduler.md).  Writes SCHED_CHAOS_${ROUND}.json.
+      if [ "${BF_SKIP_SCHED_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) elastic control-plane gate (config 20, CPU)" >> "$LOG"
+        python tools/sched_gate.py --out "SCHED_CHAOS_${ROUND}.json" >> "$LOG" 2>&1
+        sch_gate=$?
+        echo "$(date -u +%FT%TZ) sched gate rc=$sch_gate" >> "$LOG"
+        if [ "$sch_gate" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) elastic control-plane gate FAILED" >> "$LOG"
+          exit "$sch_gate"
         fi
       fi
       # Mesh-resident pipeline gate: config 11 on an 8-device
