@@ -4096,6 +4096,410 @@ def bench_sched_chaos(kill_after=1.2, timeout=240):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+#: config 21's hostA agent: the SAME victim agent as config 20, plus
+#: a fleet publisher streaming its telemetry to the head's collector
+#: (acquired from BF_FLEET_COLLECTOR in the subprocess env; the
+#: SIGKILL means no final snapshot is ever sent — exactly the silent
+#: death the staleness/death choreography must catch)
+_FLEET_VIC_SCRIPT = _SCHED_VIC_SCRIPT.replace(
+    "from bifrost_tpu import fabric, service",
+    "from bifrost_tpu import fabric, service\n"
+    "from bifrost_tpu.telemetry import fleet as _fleet\n"
+    "_pub = _fleet.acquire_publisher()")
+assert '_fleet.acquire_publisher' in _FLEET_VIC_SCRIPT
+
+
+def bench_fleet_obs(kill_after=1.5, timeout=240):
+    """Fleet observability chaos drill (docs/observability.md "Fleet
+    plane"): a 3-host fabric with the head running a FleetCollector
+    (alert rules + incident black-box), hostA a REAL subprocess
+    streaming telemetry.snapshot() deltas while serving tenant ``vic``,
+    hostB this process (its own publisher + the scheduler's standby
+    JobManager).  SIGKILL hostA mid-stream and assert the whole
+    alert -> bundle -> trace_merge chain against the scripted fault
+    timeline:
+
+    1. both publishers are adopted; the rollup shows vic on hostA;
+    2. the SIGKILL silences hostA's stream: the collector marks it
+       STALE past BF_FLEET_DEADLINE, then DEAD on the head
+       Membership's verdict (a literal never-seen host ``ghost`` in
+       the rules stays UNKNOWN throughout — unknown is not dead);
+    3. the vic tenant-absence rule FIRES (incident: true), archiving
+       a black-box bundle carrying hostA's last flight record and
+       snapshots; the scheduler's death watch re-places vic onto
+       hostB, whose publisher re-surfaces the tenant and RESOLVES the
+       alert;
+    4. the bundle's settle-window ``post/rollup.json`` captures the
+       replacement record; ``tools/trace_merge.py`` consumes the
+       bundle directly; the merged Prometheus export carries per-host
+       and per-tenant labels; the hostB publisher's metered busy time
+       stays under the 2%% streaming bound."""
+    import shutil
+    import signal as signal_mod
+    import subprocess
+    import tempfile
+    _tests = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'tests')
+    if _tests not in sys.path:
+        sys.path.insert(0, _tests)
+    from bifrost_tpu import fabric, scheduler, service
+    from bifrost_tpu.telemetry import counters
+    from bifrost_tpu.telemetry import fleet as fleet_mod
+    from util import GatherSink
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    NF, GULP, NCHAN = 1920, 32, 64
+    rowb = NCHAN * 4
+    sub_tick = 0.15                      # hostA pace: 9 s runway
+    tmpdir = tempfile.mkdtemp(prefix='bf_fleet_')
+    state_dir = os.path.join(tmpdir, 'state')
+    incident_dir = os.path.join(tmpdir, 'incidents')
+
+    link_base = _fabric_port_block(2)
+    ctrl = _fabric_free_ports(3, exclude=(link_base, link_base + 1))
+    spec = fabric.FabricSpec.from_dict({
+        'name': 'fleet21',
+        'hosts': {
+            'head': {'address': '127.0.0.1', 'control_port': ctrl[0],
+                     'role': 'control', 'cores': [3]},
+            'hostA': {'address': '127.0.0.1', 'control_port': ctrl[1],
+                      'role': 'worker', 'cores': [0, 1]},
+            'hostB': {'address': '127.0.0.1', 'control_port': ctrl[2],
+                      'role': 'worker', 'cores': [0, 1, 2]},
+        },
+        'links': {
+            'stream': {'kind': 'fanin', 'src': ['hostA', 'hostB'],
+                       'dst': 'head', 'port': link_base, 'window': 2,
+                       'gulp_nbyte': GULP * rowb},
+        },
+    })
+    spec_path = os.path.join(tmpdir, 'spec.json')
+    spec.save(spec_path)
+
+    # the fabric verdict is deliberately SLOWER than the fleet
+    # staleness deadline (2.5s vs 1.0s): the collector must mark the
+    # host stale and fire the absence alert BEFORE the scheduler's
+    # death watch re-places the tenant — the drill asserts the full
+    # fire -> re-place -> resolve ordering, not just the end state
+    chaos_env = {'BF_FABRIC_STATE': state_dir,
+                 'BF_FABRIC_HEARTBEAT_SECS': '0.1',
+                 'BF_FABRIC_DEADLINE_SECS': '2.5'}
+    saved_env = {k: os.environ.get(k) for k in chaos_env}
+    os.environ.update(chaos_env)
+
+    service.reset_registry()
+    store = []
+
+    def build_vic(gate):
+        store.append(GatherSink(gate))
+
+    rules = fleet_mod.load_rules([
+        {'name': 'vic-absent', 'kind': 'absence', 'tenant': 'vic',
+         'for_ticks': 2, 'clear_ticks': 2, 'incident': True,
+         'severity': 'page'},
+        {'name': 'host-absent', 'kind': 'absence', 'host': 'host*',
+         'for_ticks': 2, 'clear_ticks': 2},
+        # a literal host the collector will NEVER see: must sit in
+        # 'unknown' the whole run, mirroring Membership's
+        # never-seen-is-not-dead semantics
+        {'name': 'ghost-absent', 'kind': 'absence', 'host': 'ghost',
+         'for_ticks': 1, 'clear_ticks': 1},
+    ])
+
+    schedule = []
+    proc = None
+    sched = None
+    membs = []
+    coll = None
+    pub_b = None
+    try:
+        m_head = fabric.Membership(spec, 'head')
+        coll = fleet_mod.FleetCollector(
+            bind=('127.0.0.1', 0), membership=m_head, rules=rules,
+            interval=0.25, deadline=1.0, incident_dir=incident_dir,
+            history=8)
+        coll.recorder.settle = 3.0
+
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   BF_FLEET_COLLECTOR='127.0.0.1:%d' % coll.port,
+                   BF_FLEET_HOST='hostA',
+                   BF_FLEET_INTERVAL='0.25',
+                   BF_FLEET_FULL_EVERY='4')
+        for var in ('BF_FAULTS', 'BF_METRICS_FILE',
+                    'BF_FABRIC_IDENTITY', 'BF_SLO_MS',
+                    'BF_ALERT_RULES', 'BF_ALERT_LOG',
+                    'BF_ALERT_WEBHOOK', 'BF_FLEET_ROLLUP_FILE',
+                    'BF_FLEET_PROM_FILE', 'BF_FLEET_INCIDENT_DIR'):
+            env.pop(var, None)
+
+        fired0 = counters.get('alerts.fired')
+        resolved0 = counters.get('alerts.resolved')
+        bundles0 = counters.get('incident.bundles')
+        dead0 = counters.get('fleet.hosts_dead')
+        pub_busy0 = counters.get('fleet.pub.busy_us')
+
+        # ---- phase 1: hostA agent + control plane + fleet plane ------
+        proc = subprocess.Popen(
+            [sys.executable, '-c', _FLEET_VIC_SCRIPT, root, spec_path,
+             state_dir, str(NF), str(GULP), str(NCHAN),
+             str(sub_tick)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+        _fabric_read_start(proc, timeout)
+        m_head.start()
+        m_hostB = fabric.Membership(spec, 'hostB').start()
+        membs = [m_head, m_hostB]
+        coll.start()
+        pub_start = time.monotonic()
+        pub_b = fleet_mod.FleetPublisher(
+            collector=('127.0.0.1', coll.port), interval=0.25,
+            host='hostB', full_every=4).start()
+        t0 = time.monotonic()
+        schedule.append(('fabric + fleet plane up', 0.0))
+
+        hosts_adopted = False
+        ad = time.monotonic() + 20
+        while time.monotonic() < ad:
+            r = coll.rollup()
+            h = r['hosts']
+            if (h.get('hostA', {}).get('fresh')
+                    and h.get('hostB', {}).get('fresh')
+                    and 'vic' in r.get('tenants_seen', {})):
+                hosts_adopted = True
+                break
+            time.sleep(0.05)
+        schedule.append(('both hosts adopted, vic visible',
+                         round(time.monotonic() - t0, 2)))
+
+        mgrB = service.JobManager(max_tenants=2)
+        sched = scheduler.Scheduler(
+            spec, managers={'hostB': mgrB}, membership=m_head,
+            resume_of=lambda tid, dead: scheduler.ledger_frontier(
+                'sched20', dead, 'stream'),
+            exclude=('head',))
+        sched.place([service.TenantSpec(
+            'vic', priority=2, ncores=2, gulp_nframe=GULP,
+            source={'kind': 'synthetic', 'nframe_total': NF,
+                    'gulp_nframe': GULP, 'nchan': NCHAN, 'seed': 11,
+                    'tick_s': 0.01})], pinned={'vic': 'hostA'})
+        sched.set_build('vic', build_vic)
+        sched.apply()
+        sched.watch(poll_s=0.1)
+
+        # ---- phase 2: SIGKILL hostA mid-stream -----------------------
+        time.sleep(max(kill_after - (time.monotonic() - t0), 0))
+        schedule.append(('SIGKILL hostA',
+                         round(time.monotonic() - t0, 2)))
+        proc.send_signal(signal_mod.SIGKILL)
+        proc.wait(timeout=10)
+        kill_wall = time.time()
+
+        host_stale = False
+        sd = time.monotonic() + 15
+        while time.monotonic() < sd:
+            e = coll.rollup()['hosts'].get('hostA', {})
+            if e.get('stale') or e.get('dead'):
+                host_stale = True
+                break
+            time.sleep(0.05)
+        schedule.append(('hostA marked stale',
+                         round(time.monotonic() - t0, 2)))
+
+        host_dead = False
+        dd = time.monotonic() + 20
+        while time.monotonic() < dd:
+            if 'hostA' in coll.rollup()['fleet']['hosts_dead']:
+                host_dead = True
+                break
+            time.sleep(0.05)
+        schedule.append(('membership verdict -> DEAD',
+                         round(time.monotonic() - t0, 2)))
+
+        fire_wall = None
+        fd = time.monotonic() + 20
+        while time.monotonic() < fd:
+            fires = [e for e in coll.engine.history
+                     if e['name'] == 'vic-absent'
+                     and e['event'] == 'FIRING']
+            if fires:
+                fire_wall = fires[0]['wall']
+                break
+            time.sleep(0.05)
+        schedule.append(('vic-absent FIRING',
+                         round(time.monotonic() - t0, 2)))
+
+        # ---- phase 3: re-placement resolves the alert ----------------
+        vic_job = None
+        rd = time.monotonic() + 30
+        while time.monotonic() < rd:
+            vic_job = mgrB.job('vic')
+            if vic_job is not None and vic_job.state in ('RUNNING',
+                                                         'DONE'):
+                break
+            time.sleep(0.05)
+        if vic_job is None:
+            raise RuntimeError('vic was never re-placed onto hostB')
+        vic_job.wait(90)
+        schedule.append(('vic resumed+done on hostB',
+                         round(time.monotonic() - t0, 2)))
+
+        alert_resolved = False
+        od = time.monotonic() + 20
+        while time.monotonic() < od:
+            if any(e['name'] == 'vic-absent'
+                   and e['event'] == 'RESOLVED'
+                   for e in coll.engine.history):
+                alert_resolved = True
+                break
+            time.sleep(0.05)
+        schedule.append(('vic-absent RESOLVED',
+                         round(time.monotonic() - t0, 2)))
+
+        # ---- phase 4: bundle settles; post-mortem chain --------------
+        bundle = coll.recorder.bundles[0] \
+            if coll.recorder.bundles else None
+        post_path = os.path.join(bundle, 'post',
+                                 'rollup.json') if bundle else ''
+        pd = time.monotonic() + 15
+        while bundle and time.monotonic() < pd:
+            if os.path.exists(post_path):
+                break
+            time.sleep(0.1)
+        schedule.append(('bundle settled',
+                         round(time.monotonic() - t0, 2)))
+        pub_wall = time.monotonic() - pub_start
+        pub_busy = counters.get('fleet.pub.busy_us') - pub_busy0
+        overhead_pct = pub_busy / 1e6 / pub_wall * 100.0
+
+        flight_events = snaps = 0
+        origin_ok = replacement_recorded = False
+        if bundle:
+            with open(os.path.join(bundle, 'meta.json')) as f:
+                meta = json.load(f)
+            ha = (meta.get('hosts') or {}).get('hostA') or {}
+            origin_ok = ha.get('span_origin_wall_ns', 0) > 0
+            with open(os.path.join(bundle, 'hosts', 'hostA',
+                                   'flight.json')) as f:
+                flight_events = len([
+                    e for e in json.load(f)['traceEvents']
+                    if e.get('ph') != 'M'])
+            with open(os.path.join(bundle, 'hosts', 'hostA',
+                                   'snapshots.json')) as f:
+                snaps = len(json.load(f))
+            if os.path.exists(post_path):
+                with open(post_path) as f:
+                    post = json.load(f)
+                sched_sect = (post['hosts'].get('hostB', {})
+                              .get('scheduler') or {})
+                last = sched_sect.get('last_replacement') or {}
+                replacement_recorded = (
+                    last.get('tenant') == 'vic'
+                    and last.get('from') == 'hostA'
+                    and last.get('to') == 'hostB')
+
+        merged_ok = False
+        merged_path = os.path.join(tmpdir, 'merged.json')
+        if bundle:
+            tm = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, 'tools', 'trace_merge.py'),
+                 '-o', merged_path, bundle],
+                capture_output=True, text=True, cwd=root)
+            if tm.returncode == 0 and os.path.exists(merged_path):
+                with open(merged_path) as f:
+                    m = json.load(f)
+                merged_ok = (
+                    any(e.get('ph') not in (None, 'M')
+                        for e in m['traceEvents'])
+                    and any(i.get('host') == 'hostA'
+                            for i in m['otherData']
+                            ['bf_merged_from'].values()))
+
+        prom = coll.prometheus_text()
+        status = coll.engine.status()
+        detect_s = (fire_wall - kill_wall) if fire_wall else None
+
+        fired_d = counters.get('alerts.fired') - fired0
+        resolved_d = counters.get('alerts.resolved') - resolved0
+        bundles_d = counters.get('incident.bundles') - bundles0
+        dead_d = counters.get('fleet.hosts_dead') - dead0
+        invariants = {
+            'no_deadlock': True,     # every phase exited in time
+            'hosts_adopted': bool(hosts_adopted),
+            'host_marked_stale': bool(host_stale),
+            'host_dead_verdict': bool(host_dead),
+            'unknown_not_dead': bool(
+                status.get('ghost-absent@host:ghost') == 'unknown'
+                and not any(e['name'] == 'ghost-absent'
+                            for e in coll.engine.history)),
+            'absence_alert_fired_then_resolved': bool(
+                fire_wall is not None and alert_resolved),
+            'replacement_automatic': bool(
+                vic_job.state == 'DONE'
+                and sched.placement.assignments.get('vic')
+                == 'hostB'),
+            'incident_bundle_complete': bool(
+                bundle and origin_ok and flight_events > 0
+                and snaps > 0 and replacement_recorded),
+            'trace_merge_consumes_bundle': bool(merged_ok),
+            'merged_prom_labels': bool(
+                'host="hostA"' in prom and 'host="hostB"' in prom
+                and 'tenant="vic"' in prom),
+            'publish_overhead_lt_2pct': bool(overhead_pct < 2.0),
+            'counters_match_timeline': bool(
+                counters.get('fleet.hosts_live') == 1
+                and fired_d >= 2 and resolved_d >= 1
+                and bundles_d >= 1 and dead_d == 1
+                and counters.get('fleet.decode_errors') == 0),
+        }
+        return {
+            'config': 'fleet observability plane: 3-host fabric, '
+                      'streaming collector + alert rules + black-box,'
+                      ' SIGKILL hostA@%.1fs -> stale/dead marking, '
+                      'absence alert fire/resolve, incident bundle, '
+                      'trace_merge' % kill_after,
+            'value': round(detect_s, 3) if detect_s is not None
+            else None,
+            'unit': 's SIGKILL-to-alert detection latency',
+            'invariants': invariants,
+            'schedule': schedule,
+            'fleet': {
+                'hosts_live_final':
+                    counters.get('fleet.hosts_live'),
+                'fulls_rx': counters.get('fleet.fulls_rx'),
+                'deltas_rx': counters.get('fleet.deltas_rx'),
+                'alerts_fired': fired_d,
+                'alerts_resolved': resolved_d,
+                'incident_bundles': bundles_d,
+                'publish_overhead_pct': round(overhead_pct, 3),
+                'bundle': os.path.basename(bundle) if bundle else None,
+                'bundle_flight_events': flight_events,
+                'bundle_snapshots': snaps,
+            },
+            'pass': all(invariants.values()),
+        }
+    finally:
+        if sched is not None:
+            sched.shutdown()
+        if pub_b is not None:
+            pub_b.stop()
+        if coll is not None:
+            coll.stop()
+        for m in membs:
+            try:
+                m.stop()
+            except Exception:
+                pass
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 ALL = {
     1: bench_sigproc_cpu,
     2: bench_spectroscopy,
@@ -4117,13 +4521,14 @@ ALL = {
     18: bench_service,
     19: bench_fxcorr,
     20: bench_sched_chaos,
+    21: bench_fleet_obs,
 }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument('--config', type=int, default=0,
-                    help='config number 1-20; 0 = all')
+                    help='config number 1-21; 0 = all')
     ap.add_argument('--ceil-json', default=None,
                     help='pre-measured chip ceilings as a JSON object '
                          '(skips the in-process ceiling probes; used '
@@ -4133,7 +4538,7 @@ def main(argv=None):
                     help='flagship pipeline Msamples/s for config 7')
     args = ap.parse_args(argv)
     todo = sorted(ALL) if not args.config else [args.config]
-    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16, 18,
+    need_dev = any(c in (2, 3, 4, 5, 8, 9, 11, 12, 13, 14, 16, 18, 21,
                          19, 20)
                    for c in todo)
     if need_dev:
@@ -4171,7 +4576,8 @@ def main(argv=None):
         except Exception as e:
             res = {'config': 'config %d' % c, 'error':
                    '%s: %s' % (type(e).__name__, e)}
-        res['value'] = round(res['value'], 2) if 'value' in res else None
+        res['value'] = round(res['value'], 2) \
+            if res.get('value') is not None else None
         if 'roofline' in res:
             roof = {k: (round(v, 3) if isinstance(v, float) else v)
                     for k, v in res['roofline'].items()}

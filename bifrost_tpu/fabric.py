@@ -360,6 +360,23 @@ class Membership(object):
         self._death_events = 0
         self._rejoin_events = 0
         self._readopt_events = 0
+        #: callbacks invoked (outside the lock) per newly-dead peer —
+        #: the scheduler's death watch polls; the fleet collector's
+        #: incident recorder subscribes here for a push verdict
+        self._death_watchers = []
+
+    def add_death_watch(self, cb):
+        """Register ``cb(peer)`` to run when a peer newly misses its
+        deadline (once per death event; a rejoin re-arms it).  Errors
+        are swallowed and counted on ``fabric.watch_errors``."""
+        with self._lock:
+            if cb not in self._death_watchers:
+                self._death_watchers.append(cb)
+
+    def remove_death_watch(self, cb):
+        with self._lock:
+            if cb in self._death_watchers:
+                self._death_watchers.remove(cb)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -551,8 +568,14 @@ class Membership(object):
                     self._dead.add(p)
                     self._death_events += 1
                     newly.append(p)
-        for _p in newly:
+            watchers = list(self._death_watchers)
+        for p in newly:
             counters.inc('fabric.peers.dead')
+            for cb in watchers:
+                try:
+                    cb(p)
+                except Exception:
+                    counters.inc('fabric.watch_errors')
 
     def _publish(self):
         try:

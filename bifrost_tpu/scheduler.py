@@ -229,6 +229,13 @@ def ledger_frontier(fabric_name, host, link, seq_name=None):
     return int(max(acked.values())) if acked else 0
 
 
+#: weakrefs to recently-built Scheduler instances (newest last) and
+#: the most recent replacement record — telemetry_section() surfaces
+#: both so the fleet rollup / incident bundles carry live placements
+_live_schedulers = []
+_last_replacement = {}
+
+
 class Scheduler(object):
     """The control plane: owns the current :class:`Placement`, the
     per-host :class:`~bifrost_tpu.service.JobManager` handles it
@@ -266,6 +273,12 @@ class Scheduler(object):
         self._proclog = None
         self._stop = threading.Event()
         self._thread = None
+        # live-instance registry: telemetry_section() (and through it
+        # the fleet plane's per-host scheduler rollup) reports this
+        # process's current assignments + last replacement record
+        import weakref
+        _live_schedulers.append(weakref.ref(self))
+        del _live_schedulers[:-4]
 
     # -- placement ---------------------------------------------------------
     def place(self, tenants, pinned=None, exclude=()):
@@ -462,6 +475,11 @@ class Scheduler(object):
                 # a remote agent launches it
                 continue
             counters.inc('scheduler.replacements')
+            # the replacement record the incident bundle archives:
+            # who moved, from which dead host, to where, when
+            _last_replacement.update({
+                'tenant': tid, 'from': dead_host, 'to': target,
+                'wall': round(time.time(), 3)})
             job = moved[tid]
             if tid in placement.displaced:
                 self._displace(job, self.tenants[tid])
@@ -635,8 +653,12 @@ class Scheduler(object):
 def telemetry_section():
     """The ``scheduler`` section of ``telemetry.snapshot()``: the
     control-plane event counters (placements, migrations,
-    replacements, displacements, arbiter activity, resume skips)."""
-    return {
+    replacements, displacements, arbiter activity, resume skips),
+    plus — when a Scheduler lives in this process — its current
+    ``assignments``/``displaced`` and the most recent replacement
+    record (what the fleet incident bundle archives as the
+    post-mortem's 'where did the tenant land' answer)."""
+    out = {
         'placements': counters.get('scheduler.placements'),
         'migrations': counters.get('scheduler.migrations'),
         'replacements': counters.get('scheduler.replacements'),
@@ -648,6 +670,20 @@ def telemetry_section():
         'resume_skipped_frames':
             counters.get('scheduler.resume.skipped_frames'),
     }
+    for ref in reversed(_live_schedulers):
+        sched = ref()
+        if sched is None or sched.placement is None:
+            continue
+        try:
+            out['assignments'] = dict(sched.placement.assignments)
+            out['displaced_tenants'] = sorted(
+                sched.placement.displaced)
+        except Exception:
+            pass
+        break
+    if _last_replacement:
+        out['last_replacement'] = dict(_last_replacement)
+    return out
 
 
 def joined_rollup(pids=None):

@@ -679,6 +679,7 @@ class Job(object):
 
     def _run(self):
         self.run_started_at = time.monotonic()
+        self._note_fleet('RUNNING')
         if self._pump is not None:
             self._pump.start()
         try:
@@ -693,10 +694,23 @@ class Job(object):
             self.state = 'DONE'
         finally:
             self.finished_at = time.monotonic()
+            self._note_fleet(self.state)
             try:
                 self.manager._job_finished(self)
             except Exception:
                 pass
+
+    def _note_fleet(self, state):
+        """Tenant state transitions ride the fleet event side-channel
+        (telemetry.fleet) so the collector's rollup — and absence
+        alerts on this tenant — react within a tick instead of a
+        snapshot interval.  No-op outside a fleet-armed process."""
+        try:
+            from .telemetry import fleet
+            fleet.note_event('tenant', {'tenant': self.spec.id,
+                                        'state': state})
+        except Exception:
+            pass
 
     def note_first_data(self):
         if self.first_data_at is None:

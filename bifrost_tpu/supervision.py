@@ -55,7 +55,8 @@ from .telemetry import counters
 
 __all__ = ['PipelineRuntimeError', 'PipelineStallError', 'BlockFailure',
            'Supervisor', 'POLICIES', 'HEALTH_STATES', 'HealthMonitor',
-           'dump_thread_stacks', 'ring_occupancies']
+           'dump_thread_stacks', 'ring_occupancies', 'live_health',
+           'add_escalation_watch', 'remove_escalation_watch']
 
 #: recognized on_failure policies
 POLICIES = ('abort', 'restart', 'skip_sequence')
@@ -65,6 +66,63 @@ POLICIES = ('abort', 'restart', 'skip_sequence')
 #: bridge reconnects) -> SHEDDING (drop-policy loss in progress) ->
 #: STALLED (no block progressing) -> FAILED (fatal failure / abort)
 HEALTH_STATES = ('OK', 'DEGRADED', 'SHEDDING', 'STALLED', 'FAILED')
+
+#: pipeline states severe enough to notify escalation watchers (the
+#: fleet plane's incident black-box trigger — docs/observability.md)
+ESCALATION_STATES = ('SHEDDING', 'STALLED', 'FAILED')
+
+#: live HealthMonitor weakrefs + escalation callbacks (fleet plane)
+_live_monitors = []
+_escalation_cbs = []
+_registry_lock = threading.Lock()
+
+
+def live_health():
+    """{pipeline_name: health snapshot} over every HealthMonitor
+    currently alive in this process — what the fleet publisher
+    attaches to each streamed snapshot (telemetry.fleet)."""
+    out = {}
+    with _registry_lock:
+        refs = list(_live_monitors)
+    for ref in refs:
+        mon = ref()
+        if mon is None:
+            with _registry_lock:
+                if ref in _live_monitors:
+                    _live_monitors.remove(ref)
+            continue
+        try:
+            name = getattr(mon.supervisor.pipeline, 'name', 'pipeline')
+            out[name] = mon.snapshot()
+        except Exception:
+            pass
+    return out
+
+
+def add_escalation_watch(cb):
+    """Register ``cb(pipeline_name, from_state, to_state, reason)``,
+    invoked on every health transition INTO an ESCALATION_STATES
+    member (errors swallowed + counted on ``health.hook_errors``)."""
+    with _registry_lock:
+        if cb not in _escalation_cbs:
+            _escalation_cbs.append(cb)
+
+
+def remove_escalation_watch(cb):
+    with _registry_lock:
+        if cb in _escalation_cbs:
+            _escalation_cbs.remove(cb)
+
+
+def _notify_escalation(pipeline_name, from_state, to_state, reason):
+    with _registry_lock:
+        cbs = list(_escalation_cbs)
+    for cb in cbs:
+        try:
+            cb(pipeline_name, from_state, to_state, reason)
+        except Exception:
+            counters.inc('health.hook_errors')
+
 
 _BACKOFF_CAP = 5.0
 
@@ -431,6 +489,9 @@ class HealthMonitor(threading.Thread):
         self._transitions = []       # (unix_ts, from, to, reason)
         self._proclog = None
         self._nfail_seen = 0
+        import weakref
+        with _registry_lock:
+            _live_monitors.append(weakref.ref(self))
 
     def stop(self):
         self._stop_event.set()
@@ -583,6 +644,14 @@ class HealthMonitor(threading.Thread):
             self._since = time.time()
             self._clean_ticks = 0
             counters.inc('health.transitions')
+            if nxt in ESCALATION_STATES and \
+                    self._SEV[nxt] > self._SEV[cur]:
+                # escalation hook (fleet incident black-box): fires
+                # only on the way UP — recovery transitions through
+                # SHEDDING etc. are not new incidents
+                _notify_escalation(
+                    getattr(self.supervisor.pipeline, 'name',
+                            'pipeline'), cur, nxt, reason)
         # per-block: immediate escalation, shared hysteresis counter
         # is overkill per block — blocks recover with the pipeline
         for block in self.supervisor.pipeline.blocks:

@@ -34,12 +34,13 @@ from . import histograms  # noqa: F401  (log2 latency/size histograms)
 from . import spans  # noqa: F401  (gulp-span tracing / flight recorder)
 from . import slo  # noqa: F401  (capture-to-commit latency SLOs)
 from . import profiling  # noqa: F401  (one-shot BF_JAX_PROFILE hook)
+from . import fleet  # noqa: F401  (fleet streaming/alerts/black-box)
 
 __all__ = ['is_active', 'enable', 'disable', 'flush', 'snapshot',
            'track_script', 'track_module', 'track_function',
            'track_function_timed', 'track_method',
            'track_method_timed', 'usage_path', 'counters',
-           'histograms', 'spans', 'slo', 'profiling']
+           'histograms', 'spans', 'slo', 'profiling', 'fleet']
 
 MAX_ENTRIES = 100     # flush the in-memory cache after this many names
 

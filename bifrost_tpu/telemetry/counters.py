@@ -244,6 +244,56 @@ Multi-tenant service counters (bifrost_tpu.service — docs/service.md):
                                            new pipeline by
                                            autotune.adopt_profile
                                            (service warm starts)
+
+Fleet observability counters (telemetry.fleet — docs/observability.md
+"Fleet plane"):
+
+- ``fleet.pub.msgs`` / ``fleet.pub.bytes``  snapshot messages / wire
+                                           bytes a FleetPublisher sent
+- ``fleet.pub.busy_us``                    publisher THREAD-CPU time
+                                           spent building+sending (what
+                                           the <2% obs_overhead fleet
+                                           gate binds on)
+- ``fleet.pub.errors``                     publish/send/request
+                                           failures (never raised)
+- ``fleet.pub.events``                     out-of-band events pushed
+                                           (health escalations, tenant
+                                           transitions via note_event)
+- ``fleet.pub.full_requests`` /
+  ``fleet.pub.flight_replies``             collector ``need_full`` /
+                                           ``flight_request`` messages
+                                           answered
+- ``fleet.msgs_rx`` / ``fleet.fulls_rx`` /
+  ``fleet.deltas_rx`` / ``fleet.events_rx`` messages the collector
+                                           ingested, by type
+- ``fleet.decode_errors``                  corrupt/unparseable frames
+                                           dropped at ingest
+- ``fleet.need_full_tx``                   resync requests sent
+                                           (unknown session, delta seq
+                                           gap, collector restart)
+- ``fleet.hosts_adopted``                  publisher sessions adopted
+                                           into the rollup
+- ``fleet.hosts_live``                     LEVEL: hosts currently
+                                           fresh (inc'd by the signed
+                                           per-tick change)
+- ``fleet.hosts_stale_ticks``              ticks a host sat stale but
+                                           not yet dead
+- ``fleet.hosts_dead``                     hosts promoted to DEAD
+                                           (membership verdict or
+                                           final+stale), once each
+- ``fleet.tick_errors``                    collector tick exceptions
+- ``alerts.fired`` / ``alerts.resolved``   FIRING / RESOLVED
+                                           transitions out of the
+                                           AlertEngine state machines
+- ``alerts.suppressed``                    repeat-bad ticks deduped
+                                           while already firing
+- ``alerts.sink_errors``                   alert-log/webhook delivery
+                                           failures (never raised)
+- ``incident.bundles``                     black-box bundles archived
+                                           by the IncidentRecorder
+- ``incident.suppressed``                  triggers absorbed by the
+                                           per-reason cooldown
+- ``incident.errors``                      bundle write failures
 """
 
 from __future__ import annotations

@@ -44,7 +44,8 @@ __all__ = ['enabled', 'trace_file', 'span', 'record',
            'record_elapsed', 'now_us', 'configure', 'reconfigure',
            'enable_flight_recorder', 'disable_flight_recorder',
            'export', 'export_if_configured', 'flight_record',
-           'prune_dead_buffers', 'reset', 'events', 'dropped_spans',
+           'flight_events', 'prune_dead_buffers', 'reset', 'events',
+           'dropped_spans',
            'note_peer_clock', 'clock_info']
 
 DEFAULT_BUFFER = 65536
@@ -468,6 +469,24 @@ def flight_record(per_thread=32):
                         tname[-24:], name, extra))
     lines.append('=== end flight recorder ===')
     return '\n'.join(lines)
+
+
+def flight_events(per_thread=64):
+    """Structured twin of :func:`flight_record`: the most recent
+    ``per_thread`` spans of every thread as ``[[thread_name, name,
+    cat, ts_us, dur_us, args], ...]`` sorted by start time — what the
+    fleet publisher attaches to full snapshots and flight-request
+    replies (telemetry.fleet), and what incident bundles re-render as
+    Chrome traces for ``tools/trace_merge.py``."""
+    with _buffers_lock:
+        bufs = [(t.name, b) for t, b, _d in _buffers]
+    out = []
+    for tname, buf in bufs:
+        for name, cat, ts, dur, args in _drain(buf)[-per_thread:]:
+            out.append([tname, name, cat or 'bf',
+                        round(ts, 3), round(dur, 3), args])
+    out.sort(key=lambda e: e[3])
+    return out
 
 
 def reset():

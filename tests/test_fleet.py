@@ -1,0 +1,659 @@
+"""Fleet observability plane (bifrost_tpu.telemetry.fleet —
+docs/observability.md "Fleet plane"): wire round-trips, delta
+compactness, collector restart resync, staleness/death marking,
+alert-rule edge cases (unknown vs dead, hysteresis), the incident
+black box, and the tool surfaces (trace_merge, like_top, Prometheus
+export)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import zlib
+
+import pytest
+
+from bifrost_tpu.telemetry import counters, fleet, histograms
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, 'tools')
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet_state(monkeypatch):
+    for var in ('BF_FLEET_COLLECTOR', 'BF_FLEET_HOST',
+                'BF_FLEET_INTERVAL', 'BF_FLEET_FULL_EVERY',
+                'BF_FLEET_DEADLINE', 'BF_FLEET_HISTORY',
+                'BF_FLEET_ROLLUP_FILE', 'BF_FLEET_PROM_FILE',
+                'BF_FLEET_INCIDENT_DIR', 'BF_FLEET_INCIDENT_COOLDOWN',
+                'BF_FLEET_SETTLE', 'BF_ALERT_RULES', 'BF_ALERT_LOG',
+                'BF_ALERT_WEBHOOK'):
+        monkeypatch.delenv(var, raising=False)
+    counters.reset()
+    histograms.reset()
+    yield
+    counters.reset()
+    histograms.reset()
+
+
+def make_collector(**kw):
+    """An un-started collector: tests feed messages synchronously via
+    _handle/tick, no threads or timing races."""
+    kw.setdefault('bind', ('127.0.0.1', 0))
+    kw.setdefault('interval', 0.1)
+    kw.setdefault('deadline', 5.0)
+    kw.setdefault('rules', [])
+    return fleet.FleetCollector(**kw)
+
+
+def make_publisher(coll, **kw):
+    """An un-started publisher aimed at ``coll``; its messages are
+    captured AND pushed straight into the collector, skipping UDP."""
+    kw.setdefault('interval', 0.1)
+    kw.setdefault('host', 'h1')
+    pub = fleet.FleetPublisher(
+        collector=('127.0.0.1', coll.port), **kw)
+    sent = []
+    orig_send = pub._send
+
+    def send_and_feed(msg):
+        sent.append(json.loads(json.dumps(msg)))
+        coll._handle(json.loads(json.dumps(msg)),
+                     pub._sock.getsockname())
+        orig_send(msg)
+    pub._send = send_and_feed
+    pub._sent = sent
+    return pub
+
+
+def full_msg(host='h1', session='s1', seq=1, cnts=None, **extra):
+    msg = {'t': 'full', 'host': host, 'session': session, 'seq': seq,
+           'wall_ns': 1000000000000, 'mono_us': 1000.0,
+           'counters': dict(cnts or {}), 'histograms': {},
+           'rings': {}, 'health': {}, 'tenants': {}, 'scheduler': {},
+           'identity': {'pid': 42}}
+    msg.update(extra)
+    return msg
+
+
+def delta_msg(host='h1', session='s1', seq=2, cnts=None, **extra):
+    msg = {'t': 'delta', 'host': host, 'session': session, 'seq': seq,
+           'wall_ns': 1000000000000, 'mono_us': 2000.0,
+           'counters': dict(cnts or {}), 'histograms': {},
+           'rings': {}, 'health': {}, 'tenants': {}, 'scheduler': {}}
+    msg.update(extra)
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def test_parse_collector_addr():
+    assert fleet.parse_collector_addr('10.0.0.7:9123') == \
+        ('10.0.0.7', 9123)
+    assert fleet.parse_collector_addr(':9123') == ('127.0.0.1', 9123)
+    assert fleet.parse_collector_addr('') is None
+    assert fleet.parse_collector_addr('nope') is None
+    assert fleet.parse_collector_addr('h:x') is None
+    assert fleet.parse_collector_addr() is None   # env unset
+
+
+def test_wire_roundtrip_single_frame():
+    msg = {'t': 'full', 'host': 'h1', 'n': 3}
+    frames = fleet._encode(msg, 7)
+    assert len(frames) == 1
+    r = fleet._Reassembler()
+    assert r.feed(frames[0], ('127.0.0.1', 1)) == msg
+
+
+def test_wire_roundtrip_chunked_out_of_order():
+    # incompressible payload forces chunking past the 60000B cap
+    blob = os.urandom(90000).hex()
+    msg = {'t': 'full', 'host': 'h1', 'pad': blob}
+    frames = fleet._encode(msg, 9)
+    assert len(frames) >= 2
+    r = fleet._Reassembler()
+    out = None
+    for frame in reversed(frames):
+        got = r.feed(frame, ('127.0.0.1', 1))
+        if got is not None:
+            out = got
+    assert out == msg
+    assert not r._parts
+
+
+def test_reassembler_rejects_corrupt_frames():
+    r = fleet._Reassembler()
+    with pytest.raises(ValueError):
+        r.feed(b'xx', ('127.0.0.1', 1))
+    frame = fleet._encode({'a': 1}, 1)[0]
+    with pytest.raises(ValueError):
+        r.feed(b'NOPE' + frame[4:], ('127.0.0.1', 1))
+    with pytest.raises(zlib.error):
+        r.feed(frame[:fleet._HEADER.size] + b'garbage',
+               ('127.0.0.1', 1))
+
+
+# ---------------------------------------------------------------------------
+# publisher -> collector round-trip
+# ---------------------------------------------------------------------------
+
+def test_full_then_delta_roundtrip_and_compactness():
+    coll = make_collector()
+    pub = make_publisher(coll, full_every=10)
+    try:
+        counters.inc('app.work', 5)
+        pub.publish()                       # seq 1: forced full
+        assert pub._sent[0]['t'] == 'full'
+        assert pub._sent[0]['counters']['app.work'] == 5
+        assert 'identity' in pub._sent[0]
+        assert 'flight' in pub._sent[0]
+
+        counters.inc('app.work', 2)
+        pub.publish()                       # seq 2: delta
+        d = pub._sent[1]
+        assert d['t'] == 'delta'
+        # delta carries ONLY changed counters — with CUMULATIVE values
+        assert d['counters']['app.work'] == 7
+        assert all(k.startswith(('app.', 'fleet.'))
+                   for k in d['counters'])
+        assert 'identity' not in d
+
+        r = coll.rollup()
+        assert r['hosts']['h1']['fresh']
+        assert r['hosts']['h1']['counters']['app.work'] == 7
+        assert r['counters']['app.work'] == 7   # summed, not doubled
+        assert counters.get('fleet.fulls_rx') == 1
+        assert counters.get('fleet.deltas_rx') == 1
+        assert counters.get('fleet.hosts_adopted') == 1
+    finally:
+        pub._sock.close()
+        coll._sock.close()
+
+
+def test_unchanged_counters_stay_off_the_delta_wire():
+    coll = make_collector()
+    pub = make_publisher(coll, full_every=10)
+    try:
+        counters.inc('app.static', 3)
+        counters.inc('app.moving', 1)
+        pub.publish()
+        counters.inc('app.moving', 1)
+        pub.publish()
+        d = pub._sent[1]
+        assert d['t'] == 'delta'
+        assert 'app.static' not in d['counters']
+        assert d['counters']['app.moving'] == 2
+    finally:
+        pub._sock.close()
+        coll._sock.close()
+
+
+def test_full_every_forces_periodic_fulls():
+    coll = make_collector()
+    pub = make_publisher(coll, full_every=2)
+    try:
+        for _ in range(4):
+            pub.publish()
+        kinds = [m['t'] for m in pub._sent]
+        assert kinds == ['full', 'delta', 'full', 'delta']
+    finally:
+        pub._sock.close()
+        coll._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# collector restart: re-adoption without double-counting
+# ---------------------------------------------------------------------------
+
+def test_collector_restart_readopts_without_double_count():
+    coll1 = make_collector()
+    pub = make_publisher(coll1, full_every=100)
+    try:
+        counters.inc('app.work', 10)
+        pub.publish()                        # full into collector 1
+        counters.inc('app.work', 1)
+        pub.publish()                        # delta into collector 1
+        assert coll1.rollup()['counters']['app.work'] == 11
+    finally:
+        coll1._sock.close()
+
+    # the collector restarts; the publisher keeps streaming deltas
+    coll2 = make_collector()
+    pub2_addr = pub._sock.getsockname()
+    try:
+        counters.inc('app.work', 1)
+        nf0 = counters.get('fleet.need_full_tx')
+        # feed the NEXT delta to the fresh collector: unknown session
+        # -> it must refuse the delta and ask for a full
+        pub._send = lambda m: coll2._handle(
+            json.loads(json.dumps(m)), pub2_addr)
+        pub.publish()
+        assert 'h1' not in coll2.rollup()['hosts']
+        assert counters.get('fleet.need_full_tx') == nf0 + 1
+        # the publisher answers with a cumulative full: adopted clean
+        pub._handle_request({'t': 'need_full'})
+        pub.publish()
+        r = coll2.rollup()
+        assert r['hosts']['h1']['counters']['app.work'] == 12
+        assert r['counters']['app.work'] == 12   # NOT 23
+        assert counters.get('fleet.pub.full_requests') == 1
+    finally:
+        pub._sock.close()
+        coll2._sock.close()
+
+
+def test_seq_gap_triggers_resync_request():
+    coll = make_collector()
+    addr = ('127.0.0.1', 50000)
+    coll._handle(full_msg(seq=1, cnts={'a': 1}), addr)
+    nf0 = counters.get('fleet.need_full_tx')
+    coll._handle(delta_msg(seq=3, cnts={'a': 3}), addr)   # 2 was lost
+    assert counters.get('fleet.need_full_tx') == nf0 + 1
+    # the gapped delta still applied (cumulative values are safe)
+    assert coll.rollup()['hosts']['h1']['counters']['a'] == 3
+    coll._sock.close()
+
+
+def test_session_change_is_a_publisher_restart():
+    coll = make_collector()
+    addr = ('127.0.0.1', 50001)
+    coll._handle(full_msg(session='s1', seq=5, cnts={'a': 5}), addr)
+    coll._handle(full_msg(session='s2', seq=1, cnts={'a': 1}), addr)
+    r = coll.rollup()['hosts']['h1']
+    assert r['session'] == 's2'
+    assert r['counters']['a'] == 1
+    assert counters.get('fleet.hosts_adopted') == 2
+    coll._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# staleness, death, and the hosts_live level
+# ---------------------------------------------------------------------------
+
+def test_staleness_marking_and_live_level():
+    coll = make_collector(deadline=1.0)
+    addr = ('127.0.0.1', 50002)
+    coll._handle(full_msg(), addr)
+    now = coll._hosts['h1'].last_seen
+    coll.tick(now=now + 0.5)
+    assert counters.get('fleet.hosts_live') == 1
+    assert not coll.rollup()['hosts']['h1']['stale']
+    coll.tick(now=now + 2.0)
+    r = coll.rollup()
+    assert r['hosts']['h1']['stale']
+    assert not r['hosts']['h1']['dead']      # stale alone != dead
+    assert r['fleet']['hosts_stale'] == ['h1']
+    assert counters.get('fleet.hosts_live') == 0
+    assert counters.get('fleet.hosts_dead') == 0
+    coll._sock.close()
+
+
+def test_stale_plus_final_is_dead():
+    coll = make_collector(deadline=1.0)
+    addr = ('127.0.0.1', 50003)
+    coll._handle(full_msg(final=True), addr)
+    now = coll._hosts['h1'].last_seen
+    coll.tick(now=now + 2.0)
+    r = coll.rollup()
+    assert r['hosts']['h1']['dead']
+    assert r['fleet']['hosts_dead'] == ['h1']
+    assert counters.get('fleet.hosts_dead') == 1
+    coll.tick(now=now + 3.0)                 # counted once, not per tick
+    assert counters.get('fleet.hosts_dead') == 1
+    coll._sock.close()
+
+
+class _FakeMembership(object):
+    def __init__(self):
+        self.dead = set()
+
+    def is_dead(self, host):
+        return host in self.dead
+
+    def counts(self):
+        return {'dead': sorted(self.dead)}
+
+
+def test_membership_verdict_overrides_freshness():
+    m = _FakeMembership()
+    coll = make_collector(deadline=60.0, membership=m)
+    addr = ('127.0.0.1', 50004)
+    coll._handle(full_msg(), addr)
+    coll.tick()
+    assert not coll.rollup()['hosts']['h1']['dead']
+    m.dead.add('h1')
+    coll.tick()
+    # dead on the fabric's verdict even though the stream is fresh
+    assert coll.rollup()['hosts']['h1']['dead']
+    assert counters.get('fleet.hosts_dead') == 1
+    coll._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# alert rules: validation, unknown vs dead, hysteresis
+# ---------------------------------------------------------------------------
+
+def test_load_rules_validation_errors():
+    with pytest.raises(fleet.AlertRuleError):
+        fleet.load_rules([{'kind': 'threshold', 'metric': 'a'}])
+    with pytest.raises(fleet.AlertRuleError):
+        fleet.load_rules([{'name': 'r', 'kind': 'nope'}])
+    with pytest.raises(fleet.AlertRuleError):
+        fleet.load_rules([{'name': 'r', 'kind': 'threshold'}])
+    with pytest.raises(fleet.AlertRuleError):
+        fleet.load_rules([{'name': 'r', 'kind': 'absence'}])
+    with pytest.raises(fleet.AlertRuleError):
+        fleet.load_rules([{'name': 'r', 'metric': 'a', 'op': '~'}])
+    with pytest.raises(fleet.AlertRuleError):
+        fleet.load_rules([{'name': 'r', 'metric': 'a',
+                           'surprise': 1}])
+    assert fleet.load_rules(None) == []
+    rules = fleet.load_rules({'rules': [
+        {'name': 'ok', 'metric': 'counters.x', 'op': '>',
+         'value': 2}]})
+    assert rules[0].name == 'ok' and rules[0].kind == 'threshold'
+
+
+def test_load_rules_from_file_and_env(tmp_path, monkeypatch):
+    path = tmp_path / 'rules.json'
+    path.write_text(json.dumps({'rules': [
+        {'name': 'f', 'kind': 'absence', 'host': 'h*'}]}))
+    assert fleet.load_rules(str(path))[0].name == 'f'
+    monkeypatch.setenv('BF_ALERT_RULES', str(path))
+    assert fleet.load_rules()[0].name == 'f'
+
+
+def test_absence_unknown_is_not_dead():
+    """A literal host/tenant the collector has NEVER seen sits in
+    'unknown' and never fires; a host that was seen and then died
+    fires.  Mirrors Membership's never-seen-is-not-dead."""
+    rules = fleet.load_rules([
+        {'name': 'ghost', 'kind': 'absence', 'host': 'ghost',
+         'for_ticks': 1},
+        {'name': 'gone-t', 'kind': 'absence', 'tenant': 'never',
+         'for_ticks': 1},
+        {'name': 'gone-h', 'kind': 'absence', 'host': 'h1',
+         'for_ticks': 1},
+    ])
+    coll = make_collector(deadline=1.0, rules=rules)
+    addr = ('127.0.0.1', 50005)
+    coll._handle(full_msg(), addr)
+    now = coll._hosts['h1'].last_seen
+    for i in range(3):
+        coll.tick(now=now + 0.1 * i)
+    st = coll.engine.status()
+    assert st['ghost@host:ghost'] == 'unknown'
+    assert st['gone-t@tenant:never'] == 'unknown'
+    assert st['gone-h@host:h1'] == 'ok'
+    assert counters.get('alerts.fired') == 0
+    # h1 goes silent past the deadline: gone-h fires, ghost does not
+    coll.tick(now=now + 5.0)
+    st = coll.engine.status()
+    assert st['gone-h@host:h1'] == 'firing'
+    assert st['ghost@host:ghost'] == 'unknown'
+    assert [e['name'] for e in coll.engine.history] == ['gone-h']
+    assert counters.get('alerts.fired') == 1
+    coll._sock.close()
+
+
+def _rollup_with_value(v):
+    return {'hosts': {'h1': {'fresh': True, 'stale': False,
+                             'dead': False,
+                             'counters': {'app.depth': v},
+                             'histograms': {}, 'rings': {},
+                             'tenants': {}}},
+            'tenants': {}, 'tenants_seen': {'h1': 'h1'},
+            'counters': {'app.depth': v}}
+
+
+def test_threshold_hysteresis_across_flaps():
+    """for_ticks/clear_ticks hysteresis: a metric flapping around the
+    threshold fires ONCE and resolves ONCE — no flap storm."""
+    eng = fleet.AlertEngine(fleet.load_rules([
+        {'name': 'deep', 'metric': 'counters.app.depth', 'op': '>',
+         'value': 10, 'for_ticks': 2, 'clear_ticks': 2}]))
+    seq = [5, 15, 5, 15, 5,          # flapping: never 2 bad in a row
+           15, 15,                   # sustained: fires on the 2nd
+           15, 5, 15, 5,             # firing + flap: stays firing
+           5, 5]                     # sustained good: resolves
+    for i, v in enumerate(seq):
+        eng.evaluate(_rollup_with_value(v), now=100.0 + i)
+    events = [e['event'] for e in eng.history]
+    assert events == ['FIRING', 'RESOLVED']
+    assert counters.get('alerts.fired') == 1
+    assert counters.get('alerts.resolved') == 1
+    # repeat-bad ticks while firing were deduped, not re-fired
+    assert counters.get('alerts.suppressed') >= 1
+
+
+def test_delta_and_rate_rules_window():
+    eng = fleet.AlertEngine(fleet.load_rules([
+        {'name': 'burst', 'kind': 'delta',
+         'metric': 'counters.app.depth', 'op': '>=', 'value': 20,
+         'window_s': 10.0, 'for_ticks': 1},
+        {'name': 'fast', 'kind': 'rate',
+         'metric': 'counters.app.depth', 'op': '>', 'value': 100.0,
+         'window_s': 10.0, 'for_ticks': 1}]))
+    eng.evaluate(_rollup_with_value(0), now=100.0)
+    eng.evaluate(_rollup_with_value(5), now=101.0)
+    assert not eng.active()
+    eng.evaluate(_rollup_with_value(30), now=102.0)
+    assert [a['name'] for a in eng.active()] == ['burst']
+
+
+def test_alert_log_sink(tmp_path):
+    log = tmp_path / 'alerts.jsonl'
+    eng = fleet.AlertEngine(fleet.load_rules([
+        {'name': 'deep', 'metric': 'counters.app.depth', 'op': '>',
+         'value': 10}]), log_path=str(log))
+    eng.evaluate(_rollup_with_value(99), now=100.0)
+    eng.evaluate(_rollup_with_value(0), now=101.0)
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [l['event'] for l in lines] == ['FIRING', 'RESOLVED']
+    assert lines[0]['name'] == 'deep'
+    assert lines[0]['instance'] == 'h1:counters.app.depth'
+
+
+# ---------------------------------------------------------------------------
+# incident black box
+# ---------------------------------------------------------------------------
+
+def _flight(n=3):
+    return [['worker', 'copy', 'blocks', 100.0 + 10 * i, 5.0, None]
+            for i in range(n)]
+
+
+def test_health_escalation_triggers_incident(tmp_path):
+    coll = make_collector(incident_dir=str(tmp_path))
+    addr = ('127.0.0.1', 50006)
+    coll._handle(full_msg(flight=_flight()), addr)
+    ev = {'t': 'event', 'host': 'h1', 'session': 's1',
+          'kind': 'health', 'pipeline': 'p0', 'from': 'DEGRADED',
+          'to': 'FAILED', 'reason': 'wedged'}
+    coll._handle(dict(ev), addr)
+    assert len(coll.recorder.bundles) == 1
+    assert 'health-h1-FAILED' in coll.recorder.bundles[0]
+    assert counters.get('incident.bundles') == 1
+    coll._handle(dict(ev), addr)      # same escalation: no new bundle
+    assert len(coll.recorder.bundles) == 1
+    coll._sock.close()
+
+
+def test_incident_bundle_layout_and_cooldown(tmp_path):
+    coll = make_collector(incident_dir=str(tmp_path))
+    coll.recorder.cooldown = 60.0
+    coll.recorder.settle = 0.0
+    addr = ('127.0.0.1', 50007)
+    coll._handle(full_msg(cnts={'a': 1}, flight=_flight()), addr)
+    path = coll.recorder.trigger('drill', {'why': 'test'})
+    assert path is not None
+    meta = json.load(open(os.path.join(path, 'meta.json')))
+    assert meta['reason'] == 'drill'
+    # span_origin = wall_ns - mono_us*1e3: the trace_merge shift base
+    assert meta['hosts']['h1']['span_origin_wall_ns'] == \
+        1000000000000 - int(1000.0 * 1e3)
+    trace = json.load(open(os.path.join(path, 'hosts', 'h1',
+                                        'flight.json')))
+    assert trace['otherData']['bf_host'] == 'h1'
+    assert [e for e in trace['traceEvents'] if e['ph'] == 'X']
+    snaps = json.load(open(os.path.join(path, 'hosts', 'h1',
+                                        'snapshots.json')))
+    assert snaps and snaps[-1]['counters'] == {'a': 1}
+    assert os.path.isfile(os.path.join(path, 'rollup.json'))
+    assert os.path.isfile(os.path.join(path, 'alerts.json'))
+    coll.recorder.poll(now=float('inf'))
+    assert os.path.isfile(os.path.join(path, 'post', 'rollup.json'))
+    # cooldown: an immediate same-reason re-trigger is suppressed
+    assert coll.recorder.trigger('drill') is None
+    assert counters.get('incident.suppressed') == 1
+    assert counters.get('incident.bundles') == 1
+    coll._sock.close()
+
+
+def test_trace_merge_consumes_bundle(tmp_path):
+    coll = make_collector(incident_dir=str(tmp_path))
+    addrs = [('127.0.0.1', 50008), ('127.0.0.1', 50009)]
+    coll._handle(full_msg(host='h1', flight=_flight()), addrs[0])
+    # h2's span clock started 2ms later in wall time
+    coll._handle(full_msg(host='h2', session='s2',
+                          wall_ns=1000002000000, flight=_flight()),
+                 addrs[1])
+    path = coll.recorder.trigger('merge-drill')
+    out = tmp_path / 'merged.json'
+    res = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, 'trace_merge.py'),
+         '-o', str(out), path],
+        capture_output=True, text=True, cwd=ROOT)
+    assert res.returncode == 0, res.stderr
+    merged = json.load(open(str(out)))
+    info = merged['otherData']['bf_merged_from']
+    hosts = sorted(i['host'] for i in info.values())
+    assert hosts == ['h1', 'h2']
+    assert any(i.get('aligned_by') == 'wall_origin'
+               for i in info.values())
+    # h2's identical span timestamps land +2000us after the shift
+    by_pid = {}
+    for e in merged['traceEvents']:
+        if e.get('ph') == 'X':
+            by_pid.setdefault(e['pid'], []).append(e['ts'])
+    ts = sorted(min(v) for v in by_pid.values())
+    assert abs((ts[1] - ts[0]) - 2000.0) < 1.0
+    coll._sock.close()
+
+
+def test_incident_alert_rule_trips_recorder(tmp_path):
+    rules = fleet.load_rules([
+        {'name': 'gone', 'kind': 'absence', 'host': 'h1',
+         'for_ticks': 1, 'incident': True}])
+    coll = make_collector(deadline=0.5, rules=rules,
+                          incident_dir=str(tmp_path))
+    addr = ('127.0.0.1', 50010)
+    coll._handle(full_msg(flight=_flight()), addr)
+    now = coll._hosts['h1'].last_seen
+    coll.tick(now=now + 2.0)
+    assert coll.recorder.bundles
+    assert 'alert-gone' in coll.recorder.bundles[0]
+    coll._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# exports: prometheus, rollup file, like_top, telemetry_diff
+# ---------------------------------------------------------------------------
+
+def test_prometheus_labels_per_host_and_tenant():
+    coll = make_collector()
+    coll._handle(full_msg(host='h1', cnts={'a.b': 3},
+                          tenants={'vic': {'state': 'RUNNING',
+                                           'gulps': 7}}),
+                 ('127.0.0.1', 50011))
+    coll._handle(full_msg(host='h2', session='s2', cnts={'a.b': 4}),
+                 ('127.0.0.1', 50012))
+    coll.tick()
+    text = coll.prometheus_text()
+    assert 'bifrost_tpu_fleet_up{host="h1"} 1' in text
+    assert ('bifrost_tpu_fleet_counter_total{host="h2",name="a.b"} 4'
+            in text)
+    assert ('bifrost_tpu_fleet_tenant{host="h1",tenant="vic",'
+            'kind="gulps"} 7' in text)
+    assert 'bifrost_tpu_fleet_hosts{state="live"} 2' in text
+    coll._sock.close()
+
+
+def test_rollup_file_feeds_like_top_fleet(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        import like_top
+    finally:
+        sys.path.remove(TOOLS)
+    rollup_path = tmp_path / 'rollup.json'
+    coll = make_collector(rollup_file=str(rollup_path), deadline=1.0)
+    coll._handle(full_msg(
+        tenants={'vic': {'state': 'RUNNING', 'gulps': 3,
+                         'health': 'NOMINAL', 'warm': True,
+                         'slo': {'exit_age_p99_s': 0.004}}}),
+        ('127.0.0.1', 50013))
+    coll.tick()
+    rollup = like_top.load_fleet_rollup(str(rollup_path))
+    assert rollup is not None
+    text = '\n'.join(like_top.render_fleet(rollup))
+    assert '1 live' in text
+    assert 'h1' in text and 'vic' in text
+    # staleness renders too
+    now = coll._hosts['h1'].last_seen
+    coll.tick(now=now + 5.0)
+    text = '\n'.join(like_top.render_fleet(
+        like_top.load_fleet_rollup(str(rollup_path))))
+    assert 'STALE' in text
+    assert like_top.load_fleet_rollup(str(tmp_path / 'nope')) is None
+    coll._sock.close()
+
+
+def test_telemetry_diff_watches_fleet_counters(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        import telemetry_diff
+    finally:
+        sys.path.remove(TOOLS)
+    base = {'counters': {'fleet.decode_errors': 0,
+                         'fleet.hosts_live': 2}}
+    cur = {'counters': {'fleet.decode_errors': 3,
+                        'fleet.hosts_live': 1}}
+    findings = telemetry_diff.compare(base, cur)
+    tripped = {f['path'] for f in findings
+               if f.get('severity') == 'regression'}
+    assert 'counters.fleet.decode_errors' in tripped
+    assert 'counters.fleet.hosts_live' in tripped
+    # the same counters improving is NOT a regression
+    assert not [f for f in telemetry_diff.compare(cur, base)
+                if f.get('severity') == 'regression']
+
+
+# ---------------------------------------------------------------------------
+# singleton wiring
+# ---------------------------------------------------------------------------
+
+def test_acquire_publisher_unarmed_without_env():
+    assert fleet.acquire_publisher() is None
+    fleet.release_publisher(None)            # no-op, no raise
+
+
+def test_acquire_publisher_refcounted(monkeypatch):
+    coll = make_collector()
+    monkeypatch.setenv('BF_FLEET_COLLECTOR',
+                       '127.0.0.1:%d' % coll.port)
+    monkeypatch.setenv('BF_FLEET_INTERVAL', '0.1')
+    monkeypatch.setenv('BF_FLEET_HOST', 'solo')
+    p1 = fleet.acquire_publisher()
+    p2 = fleet.acquire_publisher()
+    try:
+        assert p1 is not None and p1 is p2
+        assert p1.host == 'solo'
+        fleet.release_publisher(p1)
+        assert p2.is_alive()                 # one hold left
+    finally:
+        fleet.release_publisher(p2)
+        coll._sock.close()
+    assert p2._stop_event.is_set()

@@ -1,0 +1,125 @@
+#!/usr/bin/env python3
+"""Fleet observability gate: the streaming telemetry plane must see a
+host die, alert on it, archive the black box, and watch the fleet
+heal — all from one collector.
+
+Runs bench_suite config 21 (bifrost_tpu.telemetry.fleet —
+docs/observability.md "Fleet plane": a 3-host fabric whose hostA is
+a REAL subprocess streaming snapshot deltas to the head's
+FleetCollector, SIGKILLed mid-stream) in a fresh subprocess pinned
+to the CPU backend, and asserts:
+
+- ``hosts_adopted``             — both publishers were adopted and
+  the victim tenant was visible in the rollup before the fault;
+- ``host_marked_stale``         — the silenced host crossed the
+  collector's staleness deadline;
+- ``host_dead_verdict``         — the attached Membership's verdict
+  promoted stale to DEAD;
+- ``unknown_not_dead``          — a rule watching a never-seen host
+  stayed 'unknown' and never fired (unknown is not dead);
+- ``absence_alert_fired_then_resolved`` — the tenant-absence rule
+  FIRED after the kill and RESOLVED once the re-placed tenant
+  re-surfaced on the survivor's stream;
+- ``replacement_automatic``     — the scheduler's death watch moved
+  the tenant to the survivor and it ran to DONE;
+- ``incident_bundle_complete``  — the black-box bundle carries the
+  dead host's flight record, last snapshots, wall-clock span origin,
+  and (post settle) the scheduler's replacement record;
+- ``trace_merge_consumes_bundle`` — ``tools/trace_merge.py`` merged
+  the bundle directly, wall-aligning per-host timelines;
+- ``merged_prom_labels``        — the merged Prometheus export
+  carries per-host and per-tenant labels;
+- ``publish_overhead_lt_2pct``  — the survivor publisher's metered
+  busy time stayed under 2% of the streamed interval;
+- ``counters_match_timeline``   — ``fleet.hosts_live``,
+  ``alerts.fired/resolved``, ``incident.bundles`` and
+  ``fleet.hosts_dead`` match the scripted fault timeline.
+
+The full config result is written to the ``--out`` JSON artifact
+(``FLEET_OBS_${ROUND}.json``) so bench rounds record the
+observability plane's health next to the throughput numbers.
+
+Exit codes: 0 pass, 3 an invariant failed, 2 the drill failed to
+run.  ``tools/watch_and_bench.sh`` runs this after the scheduler
+gate (``BF_SKIP_FLEET_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config21(timeout=900):
+    """One bench_suite --config 21 subprocess on the CPU backend;
+    returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # configured fault/quota/alert knobs would skew the scripted
+    # drill; ambient fleet/fabric endpoints would leak a foreign
+    # collector or spec into the drill's own plane
+    for var in ('BF_FAULTS', 'BF_OVERLOAD_POLICY', 'BF_SLO_MS',
+                'BF_AUTOTUNE', 'BF_SERVE_MAX_TENANTS',
+                'BF_SERVE_WARM', 'BF_GULP_BATCH', 'BF_SYNC_DEPTH',
+                'BF_SEGMENTS', 'BF_FABRIC_STATE',
+                'BF_FABRIC_IDENTITY', 'BF_FABRIC_HEARTBEAT_SECS',
+                'BF_FABRIC_DEADLINE_SECS',
+                'BF_FLEET_COLLECTOR', 'BF_FLEET_HOST',
+                'BF_FLEET_INTERVAL', 'BF_FLEET_FULL_EVERY',
+                'BF_FLEET_DEADLINE', 'BF_FLEET_ROLLUP_FILE',
+                'BF_FLEET_PROM_FILE', 'BF_FLEET_INCIDENT_DIR',
+                'BF_FLEET_INCIDENT_COOLDOWN', 'BF_FLEET_SETTLE',
+                'BF_ALERT_RULES', 'BF_ALERT_LOG',
+                'BF_ALERT_WEBHOOK'):
+        env.pop(var, None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '21'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'invariants' in d:
+            return d
+    raise RuntimeError(
+        'config 21 produced no invariants result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1200:], out.stderr[-1200:]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default='FLEET_OBS_cpu.json',
+                    help='artifact path for the full config result')
+    ap.add_argument('--timeout', type=int, default=900)
+    args = ap.parse_args(argv)
+    if os.environ.get('BF_SKIP_FLEET_GATE', '0') == '1':
+        print('fleet_gate: skipped (BF_SKIP_FLEET_GATE=1)')
+        return 0
+    try:
+        res = run_config21(timeout=args.timeout)
+    except Exception as exc:
+        print('fleet_gate: drill failed to run: %s: %s'
+              % (type(exc).__name__, exc))
+        return 2
+    res['round'] = os.environ.get('BF_BENCH_ROUND', '')
+    with open(args.out, 'w') as f:
+        json.dump(res, f, indent=2, sort_keys=True)
+        f.write('\n')
+    inv = res.get('invariants', {})
+    for name in sorted(inv):
+        print('%-34s %s' % (name, 'ok' if inv[name] else 'FAIL'))
+    print('fleet: %s' % json.dumps(res.get('fleet', {}),
+                                   sort_keys=True))
+    ok = bool(inv) and all(inv.values())
+    print('fleet_gate: %s -> %s' % ('PASS' if ok else 'FAIL',
+                                    args.out))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

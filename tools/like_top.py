@@ -437,6 +437,101 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
     return out
 
 
+def load_fleet_rollup(path):
+    """Parse the collector's rollup JSON (BF_FLEET_ROLLUP_FILE);
+    None when the file is missing/partial (the collector replaces it
+    atomically, so partial reads only happen on dead paths)."""
+    import json
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def render_fleet(rollup, width=140, path=None):
+    """Render the fleet collector's merged rollup as text lines:
+    per-host liveness rows, the cross-host tenant pane, and the
+    active-alert pane (docs/observability.md "Fleet plane").  Shared
+    by ``--fleet --once``, the curses loop, and tools/bf_console.py."""
+    out = []
+    if rollup is None:
+        out.append('like_top --fleet: no rollup%s — is a FleetCollector'
+                   ' running with BF_FLEET_ROLLUP_FILE set?'
+                   % ((' at %s' % path) if path else ''))
+        return out
+    fleet = rollup.get('fleet', {})
+    age_s = max(0.0, (time.time_ns() - rollup.get('wall_ns', 0)) / 1e9)
+    out.append('fleet - %s host(s): %s live, %s stale, %s dead'
+               '  (rollup age %.1fs)'
+               % (fleet.get('hosts_seen', 0),
+                  fleet.get('hosts_live', 0),
+                  len(fleet.get('hosts_stale', ())),
+                  len(fleet.get('hosts_dead', ())), age_s))
+    out.append('')
+    out.append('%-16s %-6s %7s %7s  %-14s %7s %4s %5s  %s'
+               % ('Host', 'State', 'Age(s)', 'Seq', 'Session', 'Pid',
+                  'Ten', 'Rings', 'Health'))
+    for host in sorted(rollup.get('hosts', {})):
+        e = rollup['hosts'][host]
+        state = 'DEAD' if e.get('dead') else \
+            'FINAL' if e.get('final') else \
+            'STALE' if e.get('stale') else 'live'
+        health = e.get('health') or {}
+        bad = sorted('%s:%s' % (p, (h or {}).get('state', '?'))
+                     for p, h in health.items()
+                     if (h or {}).get('state') not in (None, 'NOMINAL'))
+        ident = e.get('identity') or {}
+        out.append('%-16s %-6s %7.1f %7s  %-14s %7s %4s %5s  %s'
+                   % (host[:16], state, _num(e.get('age_s')),
+                      e.get('seq', '?'),
+                      str(e.get('session', '?'))[:14],
+                      ident.get('pid', '?'),
+                      len(e.get('tenants') or ()),
+                      len(e.get('rings') or ()),
+                      (', '.join(bad) if bad else
+                       ('ok' if health else '-'))[:max(width - 72, 0)]))
+    tenants = rollup.get('tenants', {})
+    if tenants:
+        out.append('')
+        out.append('%-16s %-12s %-9s %-9s %8s %6s  %s'
+                   % ('Tenant', 'Host', 'State', 'Health', 'Gulps',
+                      'Warm', 'Age99(ms)'))
+        for tid in sorted(tenants):
+            d = tenants[tid]
+            slo = d.get('slo') or {}
+            p99 = slo.get('exit_age_p99_s')
+            out.append('%-16s %-12s %-9s %-9s %8s %6s  %s'
+                       % (tid[:16],
+                          ('%s%s' % (d.get('host', '?'),
+                                     '' if d.get('host_fresh', True)
+                                     else '(stale)'))[:12],
+                          str(d.get('state', '?'))[:9],
+                          str(d.get('health', '?'))[:9],
+                          d.get('gulps', 0),
+                          'yes' if _num(d.get('warm', 0)) else 'no',
+                          ('%.1f' % (_num(p99) * 1e3))
+                          if p99 is not None else '-'))
+    alerts = rollup.get('alerts', {})
+    active = alerts.get('active') or []
+    ac = alerts.get('counters', {})
+    out.append('')
+    out.append('[alerts] %s firing  (fired %s  resolved %s  '
+               'suppressed %s)'
+               % (len(active), ac.get('fired', 0),
+                  ac.get('resolved', 0), ac.get('suppressed', 0)))
+    for a in active:
+        out.append('   FIRING %-8s %s@%s  value=%s'
+                   % (str(a.get('severity', 'warn'))[:8],
+                      a.get('name', '?'), a.get('instance', '?'),
+                      a.get('value')))
+    for entry in (alerts.get('history') or [])[-5:]:
+        out.append('   %-8s %s@%s  value=%s'
+                   % (entry.get('event', '?'), entry.get('name', '?'),
+                      entry.get('instance', '?'), entry.get('value')))
+    return out
+
+
 _SORT_KEYS = {'i': 'pid', 'b': 'name', 'c': 'core', 't': 'total',
               'a': 'acquire', 'p': 'process', 'r': 'reserve',
               'l': 'p99', 'w': 'wait99', 'g': 'gpd', 's': 'shards',
@@ -445,6 +540,33 @@ _SORT_KEYS = {'i': 'pid', 'b': 'name', 'c': 'core', 't': 'total',
 
 def run_curses(args):
     import curses
+
+    def fleet_loop(scr):
+        curses.use_default_colors()
+        scr.nodelay(1)
+        t_last, lines = 0.0, []
+        while True:
+            ch = scr.getch()
+            curses.flushinp()
+            if ch == ord('q'):
+                break
+            now = time.time()
+            maxy, maxx = scr.getmaxyx()
+            if now - t_last > args.interval or not lines:
+                lines = render_fleet(load_fleet_rollup(args.fleet),
+                                     width=maxx, path=args.fleet)
+                t_last = now
+            for y, line in enumerate(lines[:maxy - 1]):
+                attr = curses.A_REVERSE if line.startswith('Host') \
+                    else curses.A_NORMAL
+                try:
+                    scr.addstr(y, 0, line[:maxx - 1], attr)
+                    scr.clrtoeol()
+                except curses.error:
+                    break
+            scr.clrtobot()
+            scr.refresh()
+            time.sleep(0.2)
 
     def loop(scr):
         curses.use_default_colors()
@@ -489,7 +611,8 @@ def run_curses(args):
             scr.refresh()
             time.sleep(0.2)
 
-    curses.wrapper(loop)
+    curses.wrapper(fleet_loop if getattr(args, 'fleet', None)
+                   else loop)
 
 
 def main():
@@ -503,7 +626,25 @@ def main():
                          'when the device tunnel is down)')
     ap.add_argument('--sort', default='process',
                     choices=sorted(set(_SORT_KEYS.values())))
+    ap.add_argument('--fleet', nargs='?', metavar='ROLLUP_JSON',
+                    const=os.environ.get('BF_FLEET_ROLLUP_FILE', ''),
+                    default=None,
+                    help='render the fleet collector rollup instead '
+                         'of local pipelines; optional path to the '
+                         'rollup JSON (default: BF_FLEET_ROLLUP_FILE)')
     args = ap.parse_args()
+
+    if args.fleet is not None:
+        if not args.fleet:
+            print('like_top: --fleet needs a rollup path (argument or '
+                  'BF_FLEET_ROLLUP_FILE)', file=sys.stderr)
+            return 2
+        if args.once:
+            print('\n'.join(render_fleet(load_fleet_rollup(args.fleet),
+                                         path=args.fleet)))
+            return 0
+        run_curses(args)
+        return 0
 
     if args.once:
         get_processor_usage()        # prime the delta state

@@ -42,24 +42,31 @@ CHAIN_METRIC = 'chain_ms_per_gulp'
 _CHAIN_SNIPPET = (
     "import json, sys; sys.path.insert(0, %r); "
     "from bench_suite import _timed_config8_chain as t; "
-    "from bifrost_tpu.telemetry import counters; "
+    "from bifrost_tpu.telemetry import counters, fleet; "
+    "pub = fleet.acquire_publisher(); "
     "n = %%d; dt = t(ngulp=n); "
+    "fleet.release_publisher(pub) if pub else None; "
     "print(json.dumps({'chain_ms_per_gulp': dt / n * 1e3, "
     "'wall_s': dt, "
-    "'tuner_cpu_us': counters.get('autotune.tick_busy_us')}))"
+    "'tuner_cpu_us': counters.get('autotune.tick_busy_us'), "
+    "'fleet_pub_cpu_us': counters.get('fleet.pub.busy_us')}))"
     % ROOT)
 
 
-def run_chain(armed, timeout=1800, stack='ringcheck'):
+def run_chain(armed, timeout=1800, stack='ringcheck',
+              collector_port=None):
     """One timed config-8 chain run through a REAL pipeline
     (bench_suite._timed_config8_chain) with the stack under test
-    armed or not — the measurement arm for ``--stack ringcheck`` and
-    ``--stack autotune``.  The autotune arm runs the closed-loop
-    controller with every knob ceiling pinned at the chain's current
-    configuration (no retune can fire): the pure converged-controller
-    cost the <2% acceptance bound in docs/autotune.md refers to,
-    measured in fresh subprocesses where nothing else perturbs the
-    arms."""
+    armed or not — the measurement arm for ``--stack ringcheck``,
+    ``--stack autotune`` and ``--stack fleet``.  The autotune arm runs
+    the closed-loop controller with every knob ceiling pinned at the
+    chain's current configuration (no retune can fire): the pure
+    converged-controller cost the <2% acceptance bound in
+    docs/autotune.md refers to, measured in fresh subprocesses where
+    nothing else perturbs the arms.  The fleet arm streams the
+    subprocess's telemetry to ``collector_port`` (an in-process
+    FleetCollector in THIS process) at a 4Hz publish interval — the
+    streaming-publish bound of docs/observability.md "Fleet plane"."""
     env = dict(os.environ)
     for knob in ('BF_TRACE_FILE', 'BF_TRACE', 'BF_WATCHDOG_SECS',
                  'BF_WATCHDOG_ESCALATE', 'BF_METRICS_FILE',
@@ -68,10 +75,16 @@ def run_chain(armed, timeout=1800, stack='ringcheck'):
                  'BF_AUTOTUNE_INTERVAL', 'BF_AUTOTUNE_COOLDOWN',
                  'BF_AUTOTUNE_MIN_GAIN', 'BF_AUTOTUNE_MAX_BATCH',
                  'BF_AUTOTUNE_MAX_DEPTH', 'BF_AUTOTUNE_MAX_WINDOW',
-                 'BF_AUTOTUNE_MAX_RING_BYTES'):
+                 'BF_AUTOTUNE_MAX_RING_BYTES', 'BF_FLEET_COLLECTOR',
+                 'BF_FLEET_INTERVAL', 'BF_FLEET_HOST',
+                 'BF_FLEET_FULL_EVERY'):
         env.pop(knob, None)
     if armed and stack == 'ringcheck':
         env['BF_RINGCHECK'] = '1'
+    elif armed and stack == 'fleet':
+        env['BF_FLEET_COLLECTOR'] = '127.0.0.1:%d' % collector_port
+        env['BF_FLEET_INTERVAL'] = '0.25'
+        env['BF_FLEET_HOST'] = 'obsgate'
     elif armed:
         # ceilings pinned at the chain's own config (K=1,
         # sync_depth=4): every step() returns None, so each knob
@@ -92,7 +105,7 @@ def run_chain(armed, timeout=1800, stack='ringcheck'):
     # bound (+-1% at this length, vs +-4% at 48 gulps), so the gate
     # judges the steady state rather than the thread setup or the
     # host's mood
-    ngulp = 1920 if stack == 'autotune' else 48
+    ngulp = 1920 if stack in ('autotune', 'fleet') else 48
     out = subprocess.run([sys.executable, '-c',
                           _CHAIN_SNIPPET % ngulp],
                          capture_output=True, text=True, env=env,
@@ -166,7 +179,7 @@ def main():
     ap.add_argument('--timeout', type=float, default=1800.0,
                     help='per-run bench timeout in seconds')
     ap.add_argument('--stack', choices=('spans', 'full', 'ringcheck',
-                                        'autotune'),
+                                        'autotune', 'fleet'),
                     default='spans',
                     help="what the traced arm enables: 'spans' (the "
                          "classic PR-3 gate), 'full' (spans + "
@@ -180,21 +193,36 @@ def main():
                          "(the closed-loop controller with every "
                          "knob ceiling pinned on the same chain — "
                          "the converged-controller bound of "
-                         "docs/autotune.md, default threshold 2).  "
-                         "The chain-level full-stack bar lives in "
-                         "tools/e2e_gate.py; 'spans'/'full' bound "
-                         "the same knobs on the config-8 transfer "
-                         "loop.")
+                         "docs/autotune.md, default threshold 2), or "
+                         "'fleet' (streaming telemetry publisher "
+                         "pushing 4Hz snapshots to an in-process "
+                         "collector on the same chain — the <2% "
+                         "streaming-publish bound of "
+                         "docs/observability.md).  The chain-level "
+                         "full-stack bar lives in tools/e2e_gate.py; "
+                         "'spans'/'full' bound the same knobs on the "
+                         "config-8 transfer loop.")
     args = ap.parse_args()
     if args.threshold is None:
         args.threshold = {'ringcheck': 50.0,
-                          'autotune': 2.0}.get(args.stack, 5.0)
+                          'autotune': 2.0,
+                          'fleet': 2.0}.get(args.stack, 5.0)
 
     trace_tmp = os.path.join(tempfile.mkdtemp(prefix='bf_obs_gate_'),
                              'trace.json')
     full = args.stack == 'full'
-    chain = args.stack in ('ringcheck', 'autotune')
+    chain = args.stack in ('ringcheck', 'autotune', 'fleet')
     metric = CHAIN_METRIC if chain else METRIC
+    collector = None
+    if args.stack == 'fleet':
+        # the receiving end lives HERE: the armed subprocess streams
+        # to this collector, so the gate also proves the datagrams
+        # actually arrive (fleet.msgs_rx below) rather than timing a
+        # publisher shouting into a closed port
+        sys.path.insert(0, ROOT)
+        from bifrost_tpu.telemetry import fleet as _fleet
+        collector = _fleet.FleetCollector(rules=[], interval=0.25)
+        collector.start()
     base_runs, traced_runs = [], []
     try:
         for rep in range(max(args.reps, 1)):
@@ -203,9 +231,10 @@ def main():
                 order.reverse()
             for runs, armed in order:
                 if chain:
-                    runs.append(run_chain(armed,
-                                          timeout=args.timeout,
-                                          stack=args.stack))
+                    runs.append(run_chain(
+                        armed, timeout=args.timeout, stack=args.stack,
+                        collector_port=collector.port
+                        if collector else None))
                 else:
                     runs.append(run_config8(
                         trace_tmp if armed else None,
@@ -214,16 +243,26 @@ def main():
         print('obs_overhead: bench arm failed: %s' % exc,
               file=sys.stderr)
         return 2
+    finally:
+        msgs_rx = 0
+        if collector is not None:
+            from bifrost_tpu.telemetry import counters as _counters
+            msgs_rx = _counters.get('fleet.msgs_rx')
+            collector.stop()
+    if args.stack == 'fleet' and not msgs_rx:
+        print('obs_overhead: fleet arm streamed no telemetry to the '
+              'collector (fleet.msgs_rx == 0)', file=sys.stderr)
+        return 2
 
     b = min(float(r[metric]) for r in base_runs)
     t = min(float(r[metric]) for r in traced_runs)
     ab_pct = None
-    if args.stack == 'autotune':
-        # the BINDING number is the controller's directly-metered
-        # busy time (autotune.tick_busy_us — a conservative upper
-        # bound including the controller thread's own GIL waits) as
-        # a fraction of the pipeline wall: deterministic to well
-        # under the 2% bound.
+    if args.stack in ('autotune', 'fleet'):
+        # the BINDING number is the stack's directly-metered busy
+        # time (autotune.tick_busy_us / fleet.pub.busy_us — a
+        # conservative upper bound including the background thread's
+        # own GIL waits) as a fraction of the pipeline wall:
+        # deterministic to well under the 2% bound.
         # An A/B wall-clock comparison cannot certify 2% on a shared
         # CI host — adjacent same-length runs here spread by +-10%
         # under contention — so the drift-robust paired median of the
@@ -231,7 +270,9 @@ def main():
         ratios = sorted(float(t_[metric]) / float(b_[metric])
                         for b_, t_ in zip(base_runs, traced_runs))
         ab_pct = (ratios[len(ratios) // 2] - 1.0) * 100.0
-        cpu = max(float(r.get('tuner_cpu_us') or 0)
+        cpu_key = 'tuner_cpu_us' if args.stack == 'autotune' \
+            else 'fleet_pub_cpu_us'
+        cpu = max(float(r.get(cpu_key) or 0)
                   for r in traced_runs) / 1e6
         wall = min(float(r.get('wall_s') or 0)
                    for r in traced_runs)
@@ -257,6 +298,8 @@ def main():
         'round': os.environ.get('BF_BENCH_ROUND', ''),
         'trace_events_written': os.path.exists(trace_tmp),
     }
+    if args.stack == 'fleet':
+        artifact['fleet_msgs_rx'] = msgs_rx
     with open(args.out, 'w') as f:
         json.dump(artifact, f, indent=1, sort_keys=True)
         f.write('\n')

@@ -26,6 +26,13 @@ DIRECTION is bad:
       segment.dispatches      lower      any decrease (fusion
                                          silently disengaged)
     overhead_pct              higher     2 points (absolute)
+    fleet.*_errors /
+      fleet.pub.errors /
+      alerts.sink_errors /
+      incident.errors         higher     any increase (telemetry
+                                         silently dropping)
+    fleet.hosts_live          lower      any decrease (a publisher
+                                         stopped streaming)
 
 Unmatched numeric keys are compared informationally (reported at
 >50%% drift, never flagged).  Exit code 0 = no regressions (advisory
@@ -82,6 +89,20 @@ WATCHLIST = [
     ('*crc_errors*', 'higher', 'any', 0.0),
     ('*reconnects*', 'higher', 'any', 0.0),
     ('*fallback*', 'higher', 'any', 0.0),
+    # fleet observability plane (FLEET_OBS, config 21): decode or
+    # tick errors on the collector, publish-side send errors, or
+    # alert-sink write failures mean telemetry is silently dropping
+    # on the floor between rounds; rollup files nest these per host
+    # (hosts.<h>.counters.*) and flatten() already yields those paths
+    ('*fleet.decode_errors*', 'higher', 'any', 0.0),
+    ('*fleet.tick_errors*', 'higher', 'any', 0.0),
+    ('*fleet.pub.errors*', 'higher', 'any', 0.0),
+    ('*alerts.sink_errors*', 'higher', 'any', 0.0),
+    ('*incident.errors*', 'higher', 'any', 0.0),
+    # fewer live hosts for the same config means a publisher stopped
+    # streaming (or the collector stopped adopting) — the fleet-plane
+    # analogue of scheduler.replacements disengaging
+    ('*fleet.hosts_live', 'lower', 'any', 0.0),
 ]
 
 #: flattened paths never worth comparing (identities, timestamps,

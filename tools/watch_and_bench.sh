@@ -330,6 +330,35 @@ for i in $(seq 1 400); do
           exit "$sch_gate"
         fi
       fi
+      # Fleet observability gate: config 21 on CPU — the streaming
+      # telemetry plane must adopt both publishers, mark a SIGKILLed
+      # host stale then DEAD (a never-seen host stays UNKNOWN), fire
+      # and resolve the tenant-absence alert around the automatic
+      # re-placement, archive a black-box bundle trace_merge consumes
+      # directly, label the merged Prometheus export per host/tenant,
+      # and keep streaming-publish overhead under 2% — also proven on
+      # the config-8 chain by the obs_overhead fleet arm below
+      # (tools/fleet_gate.py; docs/observability.md "Fleet plane").
+      # Writes FLEET_OBS_${ROUND}.json + OBS_FLEET_${ROUND}.json.
+      if [ "${BF_SKIP_FLEET_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) fleet observability gate (config 21, CPU)" >> "$LOG"
+        python tools/fleet_gate.py --out "FLEET_OBS_${ROUND}.json" >> "$LOG" 2>&1
+        flt_gate=$?
+        echo "$(date -u +%FT%TZ) fleet gate rc=$flt_gate" >> "$LOG"
+        if [ "$flt_gate" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) fleet observability gate FAILED" >> "$LOG"
+          exit "$flt_gate"
+        fi
+        echo "$(date -u +%FT%TZ) fleet publish overhead arm (config-8 chain, CPU)" >> "$LOG"
+        python tools/obs_overhead.py --stack fleet --reps 3 \
+          --out "OBS_FLEET_${ROUND}.json" >> "$LOG" 2>&1
+        flt_ovh=$?
+        echo "$(date -u +%FT%TZ) fleet overhead rc=$flt_ovh" >> "$LOG"
+        if [ "$flt_ovh" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) fleet publish overhead arm FAILED" >> "$LOG"
+          exit "$flt_ovh"
+        fi
+      fi
       # Mesh-resident pipeline gate: config 11 on an 8-device
       # host-platform mesh — the sharded arm must match the
       # single-device arm, sharded spans must actually flow, and the
