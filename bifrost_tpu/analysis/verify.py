@@ -74,6 +74,9 @@ CODES = {
                'segment',
     'BF-I191': 'boundary kept by a cross-device collective schedule '
                '(correlator corner turn / psum meeting point)',
+    'BF-I192': 'overlap boundary fused WITH in-program halo carry '
+               '(ghost history rides the segment span head; the '
+               'interior ring is elided)',
     'BF-E200': 'fabric link endpoint mismatch',
     'BF-E201': 'fabric port collision',
     'BF-W202': 'fabric link window/stripe sizing hazard',
@@ -329,7 +332,15 @@ def _macro_static_k(block, overlap=None, igulp=None):
         return 1, 'block'
     reason = block._macro_static_reason()
     if reason is None and overlap:
-        reason = 'overlap'
+        # halo carry: a block that declares macro_overlap_safe() batches
+        # WITH its lookahead (the span is K*stride + overlap frames) —
+        # same test _resolve_macro_batch applies at run time
+        try:
+            safe = bool(block.macro_overlap_safe())
+        except Exception:
+            safe = False
+        if not safe:
+            reason = 'overlap'
     if reason is None and igulp:
         try:
             per = block._define_output_nframes([igulp])
@@ -485,7 +496,9 @@ def _consumer_geometry(g, b, ring, stream, diags):
     except Exception:
         overlap = 0
     k, _reason = _macro_static_k(b, overlap=overlap, igulp=gin)
-    span = k * (gin + overlap)
+    # the overlap history rides each span ONCE (at the head), whatever
+    # the macro batch: K strides plus one halo, not K halos
+    span = k * gin + overlap
     hold = span
     from ..blocks.bridge import BridgeSink
     if isinstance(b, BridgeSink):
@@ -1066,6 +1079,21 @@ def _check_segments(g, diags):
                                           None))
     _chains, boundaries = _segments.plan(g.pipeline, mode)
     for b in boundaries:
+        if b['reason'] == 'overlap_carried':
+            # NOT an unfused boundary: the planner lifted the former
+            # 'overlap' break — the ghost history is carried inside
+            # the compiled program and the interior ring is elided.
+            # Reported so an operator can see WHERE carry engaged
+            # (tools/telemetry_diff.py watches the matching
+            # segment.overlap_carried counter for silent disengage).
+            diags.append(Diagnostic(
+                'BF-I192',
+                'ring %r boundary %s -> %s fused with in-program halo '
+                'carry (%s)'
+                % (b['ring'], b['producer'], b['consumer'],
+                   _segments.REASONS.get(b['reason'], '?')),
+                block=b['producer'], ring=b['ring']))
+            continue
         # the collective reason gets its own code: it is not the
         # generic "one side is host math" story — the block IS device
         # math but owns a cross-device collective schedule (the

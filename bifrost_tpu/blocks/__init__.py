@@ -19,7 +19,9 @@ from .unpack import UnpackBlock, unpack
 from .print_header import PrintHeaderBlock, print_header
 from .fused import FusedBlock, fused
 from .beamform import BeamformBlock, beamform
-from .fdmt import FdmtBlock, fdmt
+from .fdmt import (FdmtBlock, fdmt, FdmtStageBlock, fdmt_stage,
+                   MatchedFilterBlock, matched_filter,
+                   ThresholdBlock, threshold)
 from .correlate import CorrelateBlock, CorrelateStageBlock, correlate
 from .fir import FirBlock, fir
 from .sigproc import (SigprocSourceBlock, SigprocSinkBlock, read_sigproc,

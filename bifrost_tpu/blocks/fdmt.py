@@ -14,11 +14,13 @@ from copy import deepcopy
 
 from ..pipeline import TransformBlock
 from ..units import convert_units
-from ..ops.fdmt import Fdmt
+from ..ops.fdmt import Fdmt, KDM
+from ..stages import FdmtStage, MatchedFilterStage, ThresholdStage
+from .fft import _StageBlock
 
-__all__ = ['FdmtBlock', 'fdmt']
-
-KDM = 4.148741601e3   # MHz**2 cm**3 s / pc
+__all__ = ['FdmtBlock', 'fdmt', 'FdmtStageBlock', 'fdmt_stage',
+           'MatchedFilterBlock', 'matched_filter',
+           'ThresholdBlock', 'threshold']
 
 
 class FdmtBlock(TransformBlock):
@@ -192,3 +194,60 @@ def fdmt(iring, max_dm=None, max_delay=None, max_diagonal=None,
     for pulsar/FRB searches; reference docstring: blocks/fdmt.py:129-178)."""
     return FdmtBlock(iring, max_dm, max_delay, max_diagonal, exponent,
                      negative_delays, *args, **kwargs)
+
+
+class FdmtStageBlock(_StageBlock):
+    """Stage-backed FDMT: the same transform as :class:`FdmtBlock`,
+    but driven by :class:`bifrost_tpu.stages.FdmtStage` so the whole
+    FRB-search chain (channelize -> fdmt -> matched_filter ->
+    threshold) is segment-fusable AND macro-gulp eligible with the
+    in-program halo carry (docs/perf.md): the compiled segment reads
+    K*G + max_delay frames per span, the ghost history rides the span
+    head once, and the interior overlap handoffs never touch a ring.
+    The legacy :class:`FdmtBlock` keeps the mesh halo-exchange path
+    and the max_dm/max_diagonal sizing modes."""
+
+    def __init__(self, iring, max_delay, exponent=-2.0,
+                 *args, **kwargs):
+        super(FdmtStageBlock, self).__init__(
+            iring, FdmtStage(max_delay, exponent), *args, **kwargs)
+
+
+def fdmt_stage(iring, max_delay, exponent=-2.0, *args, **kwargs):
+    """Block: stage-backed, segment-fusable FDMT (fixed ``max_delay``
+    sizing; see :class:`FdmtStageBlock`)."""
+    return FdmtStageBlock(iring, max_delay, exponent, *args, **kwargs)
+
+
+class MatchedFilterBlock(_StageBlock):
+    """Boxcar matched filter along the time axis: output frame t is
+    the fixed-order sum of input frames [t, t + ntap), the standard
+    width-matched detection filter for dispersed-pulse searches.
+    Declares ``ntap - 1`` frames of lookahead, carried in-program when
+    fused (halo carry)."""
+
+    def __init__(self, iring, ntap, *args, **kwargs):
+        super(MatchedFilterBlock, self).__init__(
+            iring, MatchedFilterStage(ntap), *args, **kwargs)
+
+
+def matched_filter(iring, ntap, *args, **kwargs):
+    """Block: boxcar matched filter over ``ntap`` time frames (see
+    :class:`MatchedFilterBlock`)."""
+    return MatchedFilterBlock(iring, ntap, *args, **kwargs)
+
+
+class ThresholdBlock(_StageBlock):
+    """Peak detect: zero every sample below ``threshold``, keep the
+    rest — the candidate sink then reads survivors off the ring
+    (frame-local, so trivially fusable and macro-gulp safe)."""
+
+    def __init__(self, iring, threshold, *args, **kwargs):
+        super(ThresholdBlock, self).__init__(
+            iring, ThresholdStage(threshold), *args, **kwargs)
+
+
+def threshold(iring, threshold, *args, **kwargs):
+    """Block: peak detect against a fixed ``threshold`` (see
+    :class:`ThresholdBlock`)."""
+    return ThresholdBlock(iring, threshold, *args, **kwargs)

@@ -42,6 +42,16 @@ class _StageBlock(TransformBlock):
         stage).  Non-equivariant stages fall back to K=1."""
         return bool(getattr(self._stage, 'batch_safe', False))
 
+    def macro_overlap_safe(self):
+        """Halo carry (docs/perf.md): an equivariant stage with a
+        declared lookahead can batch too — the K-gulp span arrives as
+        K*stride + overlap frames and the SAME plan computes it, the
+        trailing ghost frames simply going uncommitted."""
+        return self.macro_gulp_safe()
+
+    def define_input_overlap_nframe(self, iseq):
+        return int(getattr(self._stage, 'overlap_nframe', 0) or 0)
+
     def verify_header(self, ihdr):
         """Static-verification protocol (bifrost_tpu.analysis.verify):
         run the stage's pure ``transform_header`` half so contract
@@ -107,7 +117,13 @@ class _StageBlock(TransformBlock):
                                               hlo_stats_enabled,
                                               record_collectives)
                 nsh = time_axis_size(self.mesh)
-                if getattr(self._stage, 'batch_safe', False):
+                # frame-local shard_map splits the frame axis with NO
+                # halo exchange — unsafe for lookahead stages, whose
+                # shard-boundary frames would miss their history; the
+                # GSPMD path below stays correct (XLA inserts the halo
+                # collectives)
+                if getattr(self._stage, 'batch_safe', False) and \
+                        not getattr(self._stage, 'overlap_nframe', 0):
                     def build_local(local_shape):
                         lmeta = dict(meta, shape=list(local_shape))
                         return self._stage.build(lmeta)

@@ -131,6 +131,29 @@ class FusedBlock(TransformBlock):
         pays on TPU: one program K gulps wide AND N chips wide."""
         return True
 
+    def macro_overlap_safe(self):
+        """In-segment halo carry (docs/perf.md): a 'block'-mode chain
+        with a derivable lookahead batches WITH its declared overlap —
+        the K-gulp span arrives as K·G + overlap frames (ghost history
+        sliced from the span head once) and the SAME composed program
+        computes it, the trailing ghost frames going uncommitted.
+        Correct because every member stage's committed output frame is
+        a fixed-order function of a bounded input lookahead window
+        (Stage.overlap_nframe), independent of span position."""
+        from ..macro import chain_batch_mode
+        from ..stages import chain_overlap_nframe
+        return chain_batch_mode(self.stages) == 'block' and \
+            chain_overlap_nframe(self.stages) is not None
+
+    def define_input_overlap_nframe(self, iseq):
+        from ..stages import chain_overlap_nframe
+        ov = chain_overlap_nframe(self.stages)
+        if ov is None:
+            raise ValueError(
+                '%s: stage-chain lookahead does not convert to a '
+                'whole input-frame count' % self.name)
+        return ov
+
     def on_sequence(self, iseq):
         hdr = iseq.header
         self._headers = [hdr]
@@ -182,10 +205,13 @@ class FusedBlock(TransformBlock):
         gulp = self.gulp_nframe or ihdr.get('gulp_nframe')
         if not gulp or -1 not in t.get('shape', []):
             return
+        from ..stages import chain_overlap_nframe
+        ov = chain_overlap_nframe(self.stages) or 0
         try:
             import jax
             from ..devrep import device_rep_zeros
-            shape = tuple(int(s) if s != -1 else int(gulp)
+            # overlapped chains read gulp + lookahead frames per span
+            shape = tuple(int(s) if s != -1 else int(gulp) + ov
                           for s in t['shape'])
             jax.block_until_ready(
                 self._execute_plan(device_rep_zeros(shape, t['dtype'])))
@@ -203,12 +229,14 @@ class FusedBlock(TransformBlock):
             # sequence-dependent conditions (overlap / dynamic gulp)
             # can still fall back after this.  Mesh scopes prewarm the
             # macro plan too (macro × mesh composes since PR 6).
-            if k > 1 and self._macro_static_reason() is None:
+            if k > 1 and self._macro_static_reason() is None and \
+                    (not ov or self.macro_overlap_safe()):
                 import jax
                 from ..devrep import device_rep_zeros
                 taxis = t['shape'].index(-1)
                 mshape = list(shape)
-                mshape[taxis] = int(gulp) * k
+                # halo carry: K logical gulps + ONE overlap history
+                mshape[taxis] = int(gulp) * k + ov
                 jax.block_until_ready(self._execute_macro(
                     [device_rep_zeros(tuple(mshape), t['dtype'])],
                     donate=False, gulp_nframe=int(gulp)))
@@ -282,7 +310,13 @@ class FusedBlock(TransformBlock):
             # plain GSPMD).
             from ..macro import chain_batch_mode
             from ..parallel.scope import frame_local_plan
-            if chain_batch_mode(self.stages) == 'block':
+            from ..stages import chain_overlap_nframe as _chain_ov
+            # frame-local shard_map splits the frame axis with NO halo
+            # exchange — lookahead chains would lose their history at
+            # shard boundaries; GSPMD below stays correct (XLA inserts
+            # the halo collectives)
+            if chain_batch_mode(self.stages) == 'block' and \
+                    _chain_ov(self.stages) == 0:
                 def build_local(local_shape):
                     fn, info = compose_stages(self.stages,
                                               self._headers,
@@ -439,8 +473,9 @@ class FusedBlock(TransformBlock):
         import jax
         from ..macro import build_batched_fn, chain_batch_mode
         from ..ops.common import donating_jit
-        from ..stages import compose_stages
+        from ..stages import compose_stages, chain_overlap_nframe
         mode = chain_batch_mode(self.stages)
+        overlap = chain_overlap_nframe(self.stages) or 0
         part_shapes = tuple(tuple(p.shape) for p in parts)
         dtype = parts[0].dtype
         key = ('macro', part_shapes, str(dtype), bool(donate),
@@ -465,7 +500,8 @@ class FusedBlock(TransformBlock):
                                   int(gulp_nframe), part_shapes, mode)
             nframe = sum(s[taxis_in] for s in part_shapes)
             info = dict(info_box,
-                        batch=-(-nframe // int(gulp_nframe)),
+                        batch=-(-max(nframe - overlap, 1) //
+                                int(gulp_nframe)),
                         batch_mode=mode)
             dargs = tuple(range(len(parts))) if donate else ()
             if donate:
@@ -487,7 +523,10 @@ class FusedBlock(TransformBlock):
                 nsh = time_axis_size(self.mesh)
                 ndim = len(part_shapes[0])
                 if all(s[taxis_in] % nsh == 0 for s in part_shapes):
-                    if mode == 'block' and len(parts) == 1:
+                    # frame-local is halo-blind: overlap chains take
+                    # the GSPMD path (XLA inserts halo collectives)
+                    if mode == 'block' and len(parts) == 1 and \
+                            not overlap:
                         got = frame_local_plan(
                             self.mesh, per_shape, part_shapes[0],
                             dtype, taxis_in, taxis_out,

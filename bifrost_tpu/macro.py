@@ -33,8 +33,13 @@ Two batch-execution shapes, chosen per stage chain
 
 Eligibility (:meth:`bifrost_tpu.pipeline.MultiTransformBlock.
 _resolve_macro_batch`) falls back to K=1 — never an error — for host
-blocks, overlapped (FIR-history) reads, unguaranteed readers, dynamic
-gulp geometry, and nframe-nonlinear blocks.  K=1 is the default and
+blocks, unguaranteed readers, dynamic gulp geometry, and
+nframe-nonlinear blocks.  Overlapped (FIR/FDMT-history) reads fall
+back too UNLESS the block declares ``macro_overlap_safe()`` (the
+in-segment halo carry, docs/perf.md): a 'block'-mode stage chain with
+a derivable lookahead reads K·G + overlap frames per span — the ghost
+history rides at the span head ONCE, interior gulp handoffs happen
+inside the program, and the trailing ghost frames go uncommitted.  K=1 is the default and
 is byte-identical in behavior to the pre-macro runtime.  Two former
 fallbacks are RETIRED (PR 6): multi-reader input rings batch (each
 reader's guarantee independently pins its own oldest open span —
@@ -157,7 +162,11 @@ def build_batched_fn(per_gulp_for_shape, taxis_in, taxis_out,
 
     - parts are concatenated along ``taxis_in`` inside the program
       (free for a single part),
-    - 'block': the composed chain runs once on the stacked span,
+    - 'block': the composed chain runs once on the stacked span (the
+      span may carry a lookahead halo — K·G + overlap frames — since
+      a concat-equivariant chain computes any span length with the
+      same per-frame math; only 'block' chains are halo-carry
+      eligible, so 'sliced' never sees an overlapped span),
     - 'sliced': ``lax.map`` applies the per-gulp body to each G-frame
       slice and a statically-shaped tail handles the partial batch at
       sequence end, so per-gulp semantics are preserved exactly.

@@ -22,25 +22,35 @@ import numpy as np
 from .fft import _writeback
 from .common import as_jax
 
-__all__ = ['Fdmt', 'fdmt_numpy']
+__all__ = ['Fdmt', 'fdmt_numpy', 'KDM', 'fdmt_gate_rtol']
 
 #: per-step budget for the Pallas scalar-prefetch delay tables; steps
 #: beyond this run the XLA gather instead (SMEM is 1 MiB total)
 SMEM_TABLE_BUDGET = 256 * 1024
 
-#: in-process cache of core-probe winners:
-#: key -> (winner_name, {name: ms})
-_core_probe_cache = {}
+#: dispersion constant, MHz^2 s / (pc cm^-3): delay(f) =
+#: KDM * DM * f^-2 for f in MHz (reference:
+#: python/bifrost/blocks/fdmt.py:41)
+KDM = 4.148741601e3
+
+#: default oracle-gate relative tolerance for the core race: a
+#: candidate must land within this of the float64 sequential numpy
+#: reference at the probe shape or it is excluded from the race —
+#: a fast-but-wrong lowering must never become the measured winner
+#: (the BF_BEAM_GATE_RTOL / BF_LINALG_GATE_RTOL policy).  Override
+#: with BF_FDMT_GATE_RTOL (docs/envvars.md).
+FDMT_GATE_RTOL = 1e-4
 
 
-def _probe_cache_path():
-    """On-disk location of the measured core-selection cache (so later
-    sessions skip the probe compiles)."""
+def fdmt_gate_rtol():
+    """Active oracle-gate rtol: BF_FDMT_GATE_RTOL override or the
+    FDMT_GATE_RTOL default (mirrors BF_BEAM_GATE_RTOL)."""
     import os
-    base = os.environ.get('BF_CACHE_DIR')
-    if base is None:
-        base = os.path.join(os.path.expanduser('~'), '.bifrost_tpu')
-    return os.path.join(base, 'fdmt_cores.json')
+    try:
+        env = os.environ.get('BF_FDMT_GATE_RTOL', '').strip()
+        return float(env) if env else FDMT_GATE_RTOL
+    except ValueError:
+        return FDMT_GATE_RTOL
 
 
 def _cff(f1, f2, exponent):
@@ -380,26 +390,10 @@ class Fdmt(object):
         return cands[self.chosen_core]()
 
     def _probe_key(self, shape, negative_delays):
-        import jax
+        """Shape/plan signature for the mprobe 'fdmt' family (the
+        backend:device:version prefix is mprobe's job)."""
         import zlib
         plan = self._plan
-        try:
-            backend = jax.default_backend()
-        except Exception:
-            backend = 'unknown'
-        # key on the device generation and package version too: a
-        # winner measured on one TPU generation (or by an older kernel
-        # version sharing ~/.bifrost_tpu) must not be reused where the
-        # core ranking can differ (ADVICE r4)
-        try:
-            kind = jax.devices()[0].device_kind.replace(' ', '_')
-        except Exception:
-            kind = 'unknown'
-        try:
-            from bifrost_tpu import __version__ as _ver
-        except Exception:
-            _ver = '0'
-        backend = '%s:%s:v%s' % (backend, kind, _ver)
         # hash the actual delay tables: plans with the same (nchan,
         # max_delay) but different f0/df/exponent have different shift
         # distributions (different rolls program size / gather
@@ -409,92 +403,60 @@ class Fdmt(object):
             for arr in (step.d1, step.d2,
                         step.passthrough.astype(np.int32)):
                 h = zlib.crc32(np.ascontiguousarray(arr).tobytes(), h)
-        return '%s|nchan=%d|md=%d|ndi=%d|T=%d|sgn=%d|tab=%08x' % (
-            backend, plan['nchan'], plan['max_delay'], plan['nd_init'],
+        key = 'nchan=%d|md=%d|ndi=%d|T=%d|sgn=%d|tab=%08x' % (
+            plan['nchan'], plan['max_delay'], plan['nd_init'],
             shape[-1], -1 if negative_delays else 1, h & 0xffffffff)
+        rtol = fdmt_gate_rtol()
+        if rtol != FDMT_GATE_RTOL:
+            # an explicit BF_FDMT_GATE_RTOL changes which candidates
+            # may race, so it is part of the measurement's identity
+            # (the LinAlg gate-key policy)
+            key += '|gate_rtol=%g' % rtol
+        return key
 
     def _probe_cores(self, cands, shape, negative_delays):
-        """Measure every candidate core at ``shape`` (amortized: K
-        chained applications inside one jitted fori_loop, same
-        methodology as the bench suite) and cache the winner."""
-        import json
-        import os
-        import time
-        key = self._probe_key(shape, negative_delays)
-        if key in _core_probe_cache:
-            self.core_probe_ms = _core_probe_cache[key][1]
-            self.chosen_core = _core_probe_cache[key][0]
-            return self.chosen_core
-        path = _probe_cache_path()
-        disk = {}
-        try:
-            with open(path) as f:
-                disk = json.load(f)
-        except (OSError, ValueError):
-            pass
-        if key in disk and disk[key].get('winner') in cands:
-            entry = (disk[key]['winner'], disk[key].get('ms', {}))
-            _core_probe_cache[key] = entry
-            self.chosen_core, self.core_probe_ms = entry
-            return entry[0]
-
+        """Oracle-gate every candidate core at ``shape`` against the
+        float64 sequential numpy reference, race the survivors through
+        the shared mprobe harness (family ``fdmt`` —
+        tools/mprobe_report.py renders winner/margin/COIN-FLIP rows),
+        and cache the winner per (backend, plan, shape) in-process and
+        on disk so later sessions skip the probe compiles."""
         import jax
         import jax.numpy as jnp
-        from jax import lax
+        from . import mprobe
+        key = self._probe_key(shape, negative_delays)
+        cached = mprobe.peek('fdmt', key)
+        if cached is not None and cached[0] in cands:
+            self.chosen_core, self.core_probe_ms = cached[0], cached[1]
+            return cached[0]
         nchan, T = int(shape[-2]), int(shape[-1])
         rng = np.random.RandomState(0)
-        x = jnp.asarray(rng.randn(nchan, T).astype(np.float32))
-        K = 4 if jax.default_backend() == 'tpu' else 2
-        ms = {}
-        errors = {}
+        xn = rng.randn(nchan, T).astype(np.float32)
+        xj = jnp.asarray(xn)
+        ref = self._core_numpy(xn.astype(np.float64), negative_delays)
+        scale = float(np.max(np.abs(ref))) or 1.0
+        rtol = fdmt_gate_rtol()
+        fns = {}
+        had_errors = False
         for name, factory in cands.items():
             try:
-                c = factory()
-                y0 = c(x)
-
-                def body(i, carry):
-                    return c(x + (1e-30 * i) + 1e-30 * carry[0, 0])
-
-                f = jax.jit(lambda s0: lax.fori_loop(0, K, body, s0))
-                y = f(y0)
-                float(jnp.sum(y))           # compile + drain
-                # best-of-N: a single aggregate timing froze
-                # first-session jitter (compile residue, tunnel
-                # latency) into the permanent cache (ADVICE r4)
-                best = float('inf')
-                for _ in range(3):
-                    t0 = time.perf_counter()
-                    y = f(y)
-                    float(jnp.sum(y))
-                    best = min(best, time.perf_counter() - t0)
-                ms[name] = round(best / K * 1e3, 3)
-            except Exception as e:
-                errors[name] = '%s: %s' % (type(e).__name__,
-                                           str(e)[:120])
-                continue
-        if not ms:
+                fn = jax.jit(factory())
+                y = np.asarray(fn(xj))
+                if float(np.max(np.abs(y - ref))) / scale <= rtol:
+                    fns[name] = fn
+            except Exception:
+                # a transient compile blip must not freeze a ranking
+                # that excludes the possibly-faster core (ADVICE r4):
+                # race without it this session, don't persist
+                had_errors = True
+        if not fns:
             return 'none'
-        winner = min(ms, key=ms.get)
-        _core_probe_cache[key] = (winner, ms)
+        winner, ms, _err = mprobe.select('fdmt', key, fns,
+                                         lambda: (xj,),
+                                         persist=not had_errors)
+        if winner is None:
+            return 'none'
         self.chosen_core, self.core_probe_ms = winner, ms
-        # persist only clean, decisive measurements: if a candidate
-        # errored (e.g. a transient Pallas compile blip) the possibly
-        # faster core would never be reconsidered; if the margin over
-        # the runner-up is inside noise, a re-probe next session is
-        # cheap and avoids freezing jitter (ADVICE r4)
-        ranked = sorted(ms.values())
-        decisive = (len(ranked) < 2
-                    or ranked[1] >= ranked[0] * 1.10)
-        if not errors and decisive:
-            disk[key] = {'winner': winner, 'ms': ms}
-            try:
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = path + '.tmp%d' % os.getpid()
-                with open(tmp, 'w') as f:
-                    json.dump(disk, f, indent=1)
-                os.replace(tmp, path)
-            except OSError:
-                pass
         return winner
 
     def _rolls_segments(self):

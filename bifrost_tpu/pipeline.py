@@ -1411,6 +1411,19 @@ class MultiTransformBlock(Block):
         wrappers, CopyBlock's space movers) override this."""
         return False
 
+    def macro_overlap_safe(self):
+        """Whether this block can process a K-gulp macro span that
+        CARRIES its declared input overlap in-program: the span is
+        read as K*stride + overlap frames (the ghost history sliced
+        from the span head ONCE) and on_data must produce output whose
+        committed K*stride frames are byte-identical to K sequential
+        overlapped gulps.  Default False: a declared overlap forces
+        K=1 (``macro.fallback.overlap``).  Stage-chain blocks whose
+        chain is 'block'-mode equivariant with a derivable lookahead
+        override this (FusedBlock, the jitted _StageBlock wrappers) —
+        the in-segment halo carry, docs/perf.md."""
+        return False
+
     def _macro_input_consumers(self):
         """Direct consumers of this block's input ring (by base-ring
         identity, so block_view taps count).  A multi-reader input
@@ -1458,7 +1471,8 @@ class MultiTransformBlock(Block):
         if k <= 1:
             return 1
         reason = self._macro_static_reason()
-        if reason is None and any(igulp_overlaps):
+        if reason is None and any(igulp_overlaps) and \
+                not self.macro_overlap_safe():
             reason = 'overlap'
         if reason is None and any(not g or g <= 0
                                   for g in istride_nframes):
@@ -1535,11 +1549,20 @@ class MultiTransformBlock(Block):
         self._gulp_batch_active = batch
         self._macro_gulp_in = istride_nframes[0] if istride_nframes \
             else None
+        self._macro_overlap_in = igulp_overlaps[0] if igulp_overlaps \
+            else 0
         if batch > 1:
-            igulp_nframes = [g * batch for g in igulp_nframes]
+            # halo carry: the span is K logical strides plus ONE copy
+            # of the overlap history at the head — NOT K copies (the
+            # interior handoffs happen inside the program), which is
+            # what makes a carried K-gulp span cheaper than K
+            # overlapped gulps
+            igulp_nframes = [s * batch + o for s, o
+                             in zip(istride_nframes, igulp_overlaps)]
             istride_nframes = [s * batch for s in istride_nframes]
 
-        for iseq, igulp_nframe in zip(iseqs, igulp_nframes):
+        for iseq, igulp_nframe, istride_nframe, ioverlap in zip(
+                iseqs, igulp_nframes, istride_nframes, igulp_overlaps):
             if self.buffer_factor is None:
                 src_block = iseq.ring.owner
                 # Fused scopes share one gulp of buffering so that
@@ -1552,8 +1575,23 @@ class MultiTransformBlock(Block):
                     buffer_factor = None
             else:
                 buffer_factor = self.buffer_factor
+            buf_nframe = self.buffer_nframe
+            if ioverlap > 0 and buf_nframe is None and \
+                    buffer_factor is None:
+                # Overlap consumers hold span N while acquiring span
+                # N+1 (ReadSequence.read hold-ahead) so the writer
+                # can never reclaim the shared history frames.  That
+                # only avoids deadlock when the ring also absorbs the
+                # writer's reserve granularity (its ghost span, sized
+                # by the producer which resized this ring before this
+                # sequence became visible) on top of both spans.
+                fb = iseq.tensor['frame_nbyte']
+                ghost_nframe = -(-iseq.ring.ghost_span // fb)
+                buf_nframe = max(3 * igulp_nframe,
+                                 igulp_nframe + istride_nframe +
+                                 ghost_nframe)
             iseq.resize(gulp_nframe=igulp_nframe,
-                        buf_nframe=self.buffer_nframe,
+                        buf_nframe=buf_nframe,
                         buffer_factor=buffer_factor)
 
         iframe0s = [0 for _ in igulp_nframes]
@@ -1586,6 +1624,16 @@ class MultiTransformBlock(Block):
                         ospans = self.reserve_spans(
                             ospan_stack, oseqs, iskip_nframes)
                         ostrides = self._on_skip(iskip_slices, ospans)
+                        # skip spans commit their FULL zero-filled
+                        # reservation: the lost frames carry no re-read
+                        # history, so the overlap holdback that
+                        # commit_spans applies to data spans would
+                        # splice ``overlap`` frames out of the output
+                        # stream at every skip
+                        if ostrides is None:
+                            ostrides = [None] * len(ospans)
+                        ostrides = [osp.nframe if s is None else s
+                                    for s, osp in zip(ostrides, ospans)]
                         self._sync_gulp(ospans)
                         # the zero-fill is a real dispatch: keep BOTH
                         # the ring-level (ring.<name>.gulps via
@@ -1650,7 +1698,9 @@ class MultiTransformBlock(Block):
                     # sub-gulp is a real dispatch unit)
                     ngulps = 1
                     if batch > 1 and self._macro_gulp_in:
-                        ngulps = max(1, -(-ispans[0].nframe //
+                        # overlap frames are history, not new gulps
+                        ngulps = max(1, -(-(ispans[0].nframe -
+                                            self._macro_overlap_in) //
                                           self._macro_gulp_in))
                     for ospan in ospans:
                         ospan._ngulps = ngulps
@@ -1769,6 +1819,12 @@ class TransformBlock(MultiTransformBlock):
         if not self._donation_on():
             return None
         from .telemetry import counters
+        if getattr(self, '_macro_overlap_in', 0):
+            # overlapped reads share ring bytes between successive
+            # spans: donating would let XLA recycle the history frames
+            # the NEXT span re-reads
+            counters.inc('donation.misses')
+            return None
         x = ispan.take_data(allow_parts=allow_parts)
         counters.inc('donation.hits' if x is not None
                      else 'donation.misses')

@@ -723,6 +723,13 @@ class Ring(object):
         return self._size
 
     @property
+    def ghost_span(self):
+        """Max contiguous span in bytes (the ghost region size) — the
+        reserve granularity bound ReadSequence.read's hold-ahead
+        capacity check needs, core-agnostic."""
+        return self._ghost
+
+    @property
     def nringlet(self):
         return self._nringlet
 
@@ -1537,17 +1544,69 @@ class ReadSequence(_SequenceAPI):
         return ReadSpan(self, frame_offset, nframe)
 
     def read(self, nframe, stride=None, begin=0):
-        """Generator of gulp-sized spans (reference: ring2.py:301-311)."""
+        """Generator of gulp-sized spans (reference: ring2.py:301-311).
+
+        Overlapped reads (stride < nframe, i.e. the consumer declared
+        overlap history) acquire span N+1 BEFORE releasing span N.
+        The core's reader guarantee then steps from span N's begin to
+        span N+1's begin — never past the history frames both spans
+        share.  The release-then-reacquire order instead advances the
+        guarantee to span N's END (the drop_oldest shed accounting
+        requires that for fully-consumed spans), leaving the trailing
+        ``overlap`` frames unprotected for a moment; a writer that
+        fills the ring in that window overwrites the reader's history
+        and the next acquire comes back short (nframe_skipped > 0),
+        silently corrupting the stream.  Holding ahead is only
+        deadlock-free when the ring can absorb the writer's reserve
+        granularity on top of both spans: while the guarantee is
+        pinned at span N's begin, the writer must still be able to
+        reserve up to one full ghost span past the bytes span N+1
+        waits for (writer limit: reserve_head - size <=
+        min_guarantee), i.e. ``size >= (nframe + stride) * frame_nbyte
+        + ghost``.  When the ring is smaller, GROW it (request_resize
+        is MAX-negotiated and applies at quiescence) and fall back to
+        release-first — the pre-fix behavior, racy only in the
+        overwrite window — until the new geometry lands; fused scopes
+        that share ONE gulp of buffering simply never hold.
+        """
         if stride is None:
             stride = nframe
         offset = begin
-        while True:
-            try:
-                with self.acquire(offset, nframe) as ispan:
-                    yield ispan
-                    offset += stride
-            except EndOfDataStop:
-                return
+        if stride >= nframe:
+            while True:
+                try:
+                    with self.acquire(offset, nframe) as ispan:
+                        yield ispan
+                        offset += stride
+                except EndOfDataStop:
+                    return
+        fb = self.tensor['frame_nbyte']
+        hold_nbyte = (nframe + stride) * fb
+        prev = None
+        try:
+            while True:
+                if prev is not None:
+                    # ghost re-read each stride: the writer's first
+                    # oversized reserve may grow it mid-stream
+                    ring = self._ring
+                    ghost = ring.ghost_span
+                    need = hold_nbyte + ghost
+                    if ring.total_span < need and \
+                            not ring.request_resize(ghost, need):
+                        prev.release()
+                        prev = None
+                try:
+                    span = self.acquire(offset, nframe)
+                except EndOfDataStop:
+                    return
+                if prev is not None:
+                    prev.release()
+                prev = span
+                yield span
+                offset += stride
+        finally:
+            if prev is not None:
+                prev.release()
 
     def resize(self, gulp_nframe, buf_nframe=None, buffer_factor=None):
         """Reader-side buffering request; default buffer_factor=3 gives the

@@ -307,6 +307,13 @@ class NativeRing(Ring):
         return size.value
 
     @property
+    def ghost_span(self):
+        ghost = ctypes.c_longlong()
+        native.check(self._lib.bft_ring_geometry(
+            self._handle, None, None, ctypes.byref(ghost), None))
+        return ghost.value
+
+    @property
     def nringlet(self):
         nrl = ctypes.c_longlong()
         native.check(self._lib.bft_ring_geometry(

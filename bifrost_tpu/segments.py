@@ -78,7 +78,15 @@ MODES = ('off', 'auto', 'force')
 REASONS = {
     'multi_reader': 'interior ring has more than one reader',
     'tap': 'a block_view tap reads the interior ring through a view',
-    'overlap': 'consumer declares overlap/ghost history across gulps',
+    'overlap': 'consumer declares overlap/ghost history across gulps '
+               'that the chain cannot carry in-program (not a '
+               "'block'-mode stage chain, or the declared overlap "
+               'does not match the stage-derived lookahead)',
+    'overlap_carried': 'consumer overlap/ghost history is carried '
+                       'INSIDE the compiled segment (halo carry): the '
+                       'boundary fused, the ghost frames ride the '
+                       'span head once, and the interior ring is '
+                       'elided',
     'host': 'one side is not a jit-backed device stage block',
     'bridge': 'one side is a cross-host bridge endpoint',
     'mesh_reshard': 'the boundary crosses inequivalent mesh scopes',
@@ -244,8 +252,29 @@ def _boundary_reason(producer, oring, consumers, mode):
     if not _eligible(producer) or not _eligible(c):
         return 'host'
     ov = _static_overlap(c)
-    if ov is None or ov != 0:
+    if ov is None:
         return 'overlap'
+    # halo carry (docs/perf.md): a consumer's declared overlap no
+    # longer breaks fusion when the MERGED chain can carry the ghost
+    # history in-program — every stage time-concat equivariant
+    # ('block' mode, so any span length computes with identical
+    # per-frame math), the consumer's declaration matching its
+    # stage-derived lookahead exactly, and the merged lookahead
+    # converting to a whole head-input frame count.  The merged-chain
+    # check also guards the subtler case of a ZERO-overlap boundary
+    # downstream of a lookahead stage: fusing a non-equivariant stage
+    # behind one would feed it ghost frames it cannot ignore.
+    carried = False
+    from .macro import chain_batch_mode
+    from .stages import chain_overlap_nframe
+    merged = (_stage_chain(producer) or []) + (_stage_chain(c) or [])
+    merged_ov = chain_overlap_nframe(merged)
+    if ov or merged_ov is None or merged_ov != 0:
+        if merged_ov is None or \
+                chain_batch_mode(merged) != 'block' or \
+                chain_overlap_nframe(_stage_chain(c) or []) != ov:
+            return 'overlap'
+        carried = bool(ov)
     if not _meshes_ok(producer, c):
         return 'mesh_reshard'
     if not _compatible(producer, c):
@@ -254,7 +283,7 @@ def _boundary_reason(producer, oring, consumers, mode):
         return 'supervision'
     if mode == 'off':
         return 'disabled'
-    return None
+    return 'overlap_carried' if carried else None
 
 
 def plan(pipeline, mode=None):
@@ -296,10 +325,13 @@ def plan(pipeline, mode=None):
                                               for c in cs)):
                 continue
             reason = _boundary_reason(p, oring, cs, mode)
-            if reason is None:
+            if reason is None or reason == 'overlap_carried':
+                # 'overlap_carried' boundaries FUSE — the record below
+                # is informational (verify maps it to BF-I192), not a
+                # break
                 nxt[id(p)] = cs[0]
                 prev[id(cs[0])] = p
-            else:
+            if reason is not None:
                 boundaries.append({
                     'ring': getattr(base, 'name', '?'),
                     'producer': getattr(p, 'name', '?'),
@@ -413,7 +445,14 @@ def _segment_block_cls():
             ``_segment_split`` knob clamped to the member-boundary
             count.  Mesh segments never split (the sub-programs would
             need their own in/out shardings per part; the fused mesh
-            plan already exists and is the measured-better path)."""
+            plan already exists and is the measured-better path).
+
+            Splits compose with a carried halo: a halo-carrying
+            segment is 'block'-mode throughout (the fusion rule
+            requires it), so every part computes the FULL overlapped
+            span — ghost frames propagate part to part and only
+            contaminate output frames past the committed stride, which
+            go uncommitted.  No per-part halo bookkeeping is needed."""
             if self.mesh is not None:
                 return 0
             try:
@@ -512,7 +551,10 @@ def _segment_block_cls():
             dur_s = time.perf_counter() - t0
             ngulps = 1
             if self._gulp_batch_active > 1 and self._macro_gulp_in:
-                ngulps = max(1, -(-ispan.nframe //
+                # a carried halo rides the span head ONCE — it is
+                # history, not an extra gulp's worth of work
+                halo = getattr(self, '_macro_overlap_in', 0)
+                ngulps = max(1, -(-(ispan.nframe - halo) //
                                   self._macro_gulp_in))
             _tseg.note_dispatch(
                 self.name, self._members, ndispatches=ndisp,
@@ -660,6 +702,15 @@ def compile_pipeline(pipeline, mode=None):
                 parent._children.remove(blk)
         counters.inc('segment.compiled')
         counters.inc('segment.elided_rings', len(elided))
+        # halo-carry engagement signal (tools/telemetry_diff.py watches
+        # it): overlap boundaries this chain absorbed in-program — a
+        # drop to 0 on a lookahead chain means carry silently
+        # disengaged and the chain broke at the overlap instead
+        carried = sum(1 for b in boundaries
+                      if b['reason'] == 'overlap_carried'
+                      and b['producer'] in members)
+        if carried:
+            counters.inc('segment.overlap_carried', carried)
         segments.append(seg)
     # accumulate: a test/tuner may compile before run() re-plans (the
     # re-plan finds nothing new — compiled segments sit between

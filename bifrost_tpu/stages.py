@@ -24,12 +24,14 @@ from copy import deepcopy
 import numpy as np
 
 from .dtype import DataType
-from .units import transform_units
+from .units import convert_units, transform_units
 
 __all__ = ['Stage', 'FftStage', 'DetectStage', 'ReduceStage',
            'FftShiftStage', 'ReverseStage', 'TransposeStage',
            'ScrunchStage', 'MapStage', 'BeamformStage',
-           'QuantizeStage', 'CorrelateStage', 'AccumulateStage']
+           'QuantizeStage', 'CorrelateStage', 'AccumulateStage',
+           'FdmtStage', 'MatchedFilterStage', 'ThresholdStage',
+           'chain_overlap_nframe']
 
 
 class Stage(object):
@@ -49,6 +51,18 @@ class Stage(object):
     #: which routes them through the per-gulp 'sliced' mode instead —
     #: never a semantic change, just less fusion.
     batch_safe = False
+
+    #: Frames of FUTURE input (lookahead) each output frame may
+    #: reference: output frame t depends on input frames
+    #: [t, t + overlap_nframe], so the last overlap_nframe output
+    #: frames of any span are invalid until the next span recomputes
+    #: them.  A wrapping block advertises the chain total as its ring
+    #: overlap (define_input_overlap_nframe); inside a compiled
+    #: segment the halo carry slices the ghost frames from the macro
+    #: span head once and keeps interior handoffs elided
+    #: (docs/perf.md).  Only meaningful on nframe_ratio == (1, 1)
+    #: stages today.
+    overlap_nframe = 0
 
     def transform_header(self, hdr):
         return hdr
@@ -771,6 +785,190 @@ class MapStage(Stage):
             ev.out = {'b': jnp.zeros(x.shape, otype.as_jax_dtype())}
             ev.run(body)
             return ev.out['b']
+        return fn
+
+
+def chain_overlap_nframe(stages):
+    """Input-frame lookahead a stage chain needs, or None.
+
+    Walks the chain BACK from the sink, converting each downstream
+    halo through the stage's frame ratio and adding the stage's own
+    declared ``overlap_nframe``.  Returns None when a downstream halo
+    does not convert to a whole input-frame count — the caller must
+    then treat the chain as carry-unsafe (fall back to the plain
+    per-gulp overlap boundary)."""
+    halo = 0
+    for stage in reversed(stages):
+        num, den = getattr(stage, 'nframe_ratio', (1, 1))
+        if halo:
+            if (halo * den) % num:
+                return None
+            halo = halo * den // num
+        halo += int(getattr(stage, 'overlap_nframe', 0) or 0)
+    return halo
+
+
+class FdmtStage(Stage):
+    """Incoherent dedispersion (FDMT) as a fusable stage — the pure
+    core of :class:`bifrost_tpu.blocks.fdmt.FdmtBlock` with a STATIC
+    ``max_delay``, so the lookahead requirement is known at chain
+    construction (``overlap_nframe``) before any header flows.
+
+    Input tensor ``[..., 'freq', 'time']`` (time is the frame axis and
+    rides last, the ring's lane-contiguous layout); output replaces
+    the freq axis with ``max_delay`` dispersion trials.  Output frame
+    t is a fixed-order sum over input frames [t, t + max_delay]
+    (positive delays only — the lookahead convention the ring overlap
+    machinery implements), so committed frames are byte-identical
+    whatever span they were computed in: time-concat equivariance
+    holds for the non-ghost frames, which is what makes the chain
+    macro-gulp 'block' eligible and halo-carriable inside a compiled
+    segment.  The per-gulp core is the raced engine
+    (:class:`bifrost_tpu.ops.fdmt.Fdmt`; ``BF_FDMT_IMPL`` forces one).
+    """
+
+    batch_safe = True
+
+    def __init__(self, max_delay, exponent=-2.0):
+        from .ops.fdmt import Fdmt
+        self.max_delay = int(max_delay)
+        if self.max_delay < 1:
+            raise ValueError('max_delay must be >= 1')
+        self.exponent = exponent
+        self.overlap_nframe = self.max_delay
+        self.engine = Fdmt()
+
+    def transform_header(self, hdr):
+        from .ops.fdmt import KDM
+        itensor = hdr['_tensor']
+        labels = itensor.get('labels')
+        if not labels or labels[-1] != 'time' or labels[-2] != 'freq':
+            raise KeyError("fdmt requires [..., 'freq', 'time'] input "
+                           "labels, got %r" % (labels,))
+        nchan = itensor['shape'][-2]
+        f0_, df_ = itensor['scales'][-2]
+        dt_ = itensor['scales'][-1][1]
+        units = itensor.get('units')
+        funit = units[-2] if units else 'MHz'
+        tunit = units[-1] if units else 's'
+        f0 = convert_units(f0_, funit, 'MHz')
+        df = convert_units(df_, funit, 'MHz')
+        dt = convert_units(dt_, tunit, 's')
+        fac = f0 ** -2 - (f0 + nchan * df) ** -2
+        max_dm = self.max_delay * dt / (KDM * abs(fac))
+        self.dm_step = max_dm / self.max_delay
+        self.engine.init(nchan, self.max_delay, f0, df, self.exponent,
+                         space='tpu')
+        ohdr = deepcopy(hdr)
+        refdm = convert_units(hdr['refdm'], hdr['refdm_units'],
+                              'pc cm^-3') if 'refdm' in hdr else 0.
+        otensor = ohdr['_tensor']
+        otensor['dtype'] = 'f32'
+        otensor['shape'][-2] = self.max_delay
+        otensor['labels'][-2] = 'dispersion'
+        if 'scales' in otensor:
+            otensor['scales'][-2] = [refdm, self.dm_step]
+        if units:
+            otensor['units'][-2] = 'pc cm^-3'
+        ohdr['max_dm'] = max_dm
+        ohdr['max_dm_units'] = 'pc cm^-3'
+        ohdr['cfreq'] = f0_ + 0.5 * (nchan - 1) * df_
+        ohdr['cfreq_units'] = funit
+        ohdr['bw'] = nchan * df_
+        ohdr['bw_units'] = funit
+        return ohdr
+
+    def build(self, in_meta):
+        import jax
+        import jax.numpy as jnp
+        shape = in_meta['shape']
+        # probe/lock the measured core at the ACTUAL (nchan, T) the
+        # chain will trace — no jit here, the enclosing chain jit owns
+        # compilation
+        core = self.engine._pick_core(False, shape=(int(shape[-2]),
+                                                    int(shape[-1])))
+
+        def fn(x):
+            xs = x.astype(jnp.float32) if not jnp.issubdtype(
+                x.dtype, jnp.floating) else x
+            if xs.ndim == 2:
+                return core(xs)
+            flat = xs.reshape((-1,) + xs.shape[-2:])
+            out = jax.vmap(core)(flat)
+            return out.reshape(xs.shape[:-2] + out.shape[-2:])
+        return fn
+
+
+class MatchedFilterStage(Stage):
+    """Boxcar matched filter along the frame (time) axis: output frame
+    t = sum of input frames [t, t + ntap - 1], summed in a FIXED order
+    (ntap shifted adds — never a cumsum difference, whose float
+    cancellation would break byte-identity across span positions).
+    Declares ``ntap - 1`` frames of lookahead; the trailing invalid
+    frames are recomputed by the next span exactly like the FDMT
+    ghost region, so the stage composes into halo-carried segments."""
+
+    batch_safe = True
+
+    def __init__(self, ntap):
+        self.ntap = int(ntap)
+        if self.ntap < 1:
+            raise ValueError('ntap must be >= 1')
+        self.overlap_nframe = self.ntap - 1
+
+    def transform_header(self, hdr):
+        ohdr = deepcopy(hdr)
+        t = ohdr['_tensor']
+        self.taxis = t['shape'].index(-1)
+        self.otype = DataType(t['dtype']).as_floating_point()
+        if self.otype.is_complex:
+            raise TypeError('matched filter requires real input, got '
+                            '%s' % t['dtype'])
+        t['dtype'] = str(self.otype)
+        return ohdr
+
+    def build(self, in_meta):
+        import jax.numpy as jnp
+        from jax import lax
+        W, taxis = self.ntap, self.taxis
+        odt = self.otype.as_jax_dtype()
+
+        def fn(x):
+            x = x.astype(odt)
+            if W == 1:
+                return x
+            T = x.shape[taxis]
+            pads = [(0, 0)] * x.ndim
+            pads[taxis] = (0, W - 1)
+            xp = jnp.pad(x, pads)
+            y = lax.slice_in_dim(xp, 0, T, axis=taxis)
+            for i in range(1, W):
+                y = y + lax.slice_in_dim(xp, i, i + T, axis=taxis)
+            return y
+        return fn
+
+
+class ThresholdStage(Stage):
+    """Peak detect: zero every sample below ``threshold`` (elementwise
+    and frame-local, so trivially batch-safe).  The candidate sink
+    counts the surviving nonzero samples — keeping the zeroed shape
+    instead of emitting a ragged candidate list is what keeps the
+    whole search chain static-shaped and segment-fusable."""
+
+    batch_safe = True
+
+    def __init__(self, threshold):
+        self.threshold = float(threshold)
+
+    def transform_header(self, hdr):
+        return deepcopy(hdr)
+
+    def build(self, in_meta):
+        import jax.numpy as jnp
+        thr = self.threshold
+
+        def fn(x):
+            return jnp.where(x >= thr, x, jnp.zeros((), x.dtype))
         return fn
 
 

@@ -496,6 +496,173 @@ def test_macro_blocked_reserve_wakes_on_poison():
     assert caught and 'consumer died' in str(caught[0])
 
 
+def test_macro_overlap_history_ghost_wrap():
+    """K>1 macro-gulp OVERLAPPED reads (the halo-carry span shape:
+    K strides plus one overlap history at the head, pipeline.py) must
+    return history frames byte-identical to the previous span's tail
+    at every stride — including spans whose head history wraps through
+    the ghost region."""
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(4,))
+    NSPAN, STRIDE, OV = 5, 16, 4   # K=2 gulps of 8, 4-frame halo
+    GULP = STRIDE + OV
+    reader_attached = threading.Event()
+
+    def writer():
+        with ring.begin_writing() as wr:
+            # buf 56 frames: strides land at 48 -> 64 across the
+            # nominal end, so at least one overlapped acquire reads
+            # its history through the ghost mirror
+            with wr.begin_sequence(hdr, gulp_nframe=STRIDE,
+                                   buf_nframe=56) as seq:
+                for k in range(NSPAN):
+                    if k == 1:
+                        assert reader_attached.wait(30)
+                    with seq.reserve(STRIDE) as span:
+                        span.data.as_numpy()[...] = \
+                            np.arange(STRIDE * 4).reshape(STRIDE, 4) \
+                            + 1000 * k
+                        span.commit(STRIDE)
+
+    ref = np.concatenate(
+        [np.arange(STRIDE * 4).reshape(STRIDE, 4) + 1000 * k
+         for k in range(NSPAN)])
+    t = threading.Thread(target=writer)
+    t.start()
+    received = []
+    for seq in ring.read(guarantee=True):
+        reader_attached.set()
+        seq.resize(gulp_nframe=GULP, buffer_factor=3)
+        for span in seq.read(GULP, STRIDE):
+            assert span.nframe_skipped == 0
+            received.append((span.frame_offset,
+                             np.array(span.data.as_numpy(),
+                                      copy=True)))
+    t.join()
+    # 4 full overlapped spans + the EOD partial (final stride has no
+    # successor to lend it a halo)
+    assert [n.shape[0] for _, n in received] == \
+        [GULP] * (NSPAN - 1) + [STRIDE]
+    for i, (off, arr) in enumerate(received):
+        assert off == i * STRIDE
+        np.testing.assert_array_equal(arr, ref[off:off + arr.shape[0]])
+        if i > 0:
+            # the halo IS the previous span's tail, byte for byte
+            np.testing.assert_array_equal(arr[:OV],
+                                          received[i - 1][1][-OV:])
+
+
+def test_macro_overlap_history_eod_partial():
+    """An overlapped reader blocked on a full K-gulp span wakes at
+    sequence end with the partial remainder — and the partial's halo
+    history frames are byte-identical to the previous span's tail
+    (the macro-gulp EOD partial-batch flush depends on this)."""
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(2,))
+    STRIDE, OV = 16, 4
+    GULP = STRIDE + OV
+    got_first = threading.Event()
+
+    def writer():
+        with ring.begin_writing() as wr:
+            with wr.begin_sequence(hdr, gulp_nframe=STRIDE,
+                                   buf_nframe=4 * STRIDE) as seq:
+                with seq.reserve(STRIDE) as span:
+                    span.data.as_numpy()[...] = \
+                        np.arange(STRIDE * 2).reshape(STRIDE, 2)
+                    span.commit(STRIDE)
+                with seq.reserve(STRIDE // 2) as span:
+                    span.data.as_numpy()[...] = \
+                        np.arange((STRIDE // 2) * 2).reshape(
+                            STRIDE // 2, 2) + 5000
+                    span.commit(STRIDE // 2)
+                # reader now blocks wanting [16, 36); ending the
+                # sequence must wake it with the partial [16, 24)
+                assert got_first.wait(30)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    received = []
+    for seq in ring.read(guarantee=True):
+        seq.resize(gulp_nframe=GULP, buffer_factor=3)
+        for span in seq.read(GULP, STRIDE):
+            assert span.nframe_skipped == 0
+            received.append(np.array(span.data.as_numpy(), copy=True))
+            got_first.set()
+    t.join()
+    # the writer produced 24 frames: span0 covers [0, 20), the EOD
+    # partial covers [16, 24) — OV frames of history plus the 4 new
+    assert [r.shape[0] for r in received] == [GULP, STRIDE // 2]
+    # the EOD partial still carries its OV-frame history at the head
+    np.testing.assert_array_equal(received[1][:OV], received[0][-OV:])
+
+
+def test_overlap_hold_ahead_grows_small_ring():
+    """Hold-ahead regression (the overlapped-reader guarantee race):
+    an overlapped reader keeps span N open while acquiring span N+1,
+    so the writer can never reclaim the shared history frames — and
+    when the ring is too small to also absorb the writer's reserve
+    granularity, ReadSequence.read must GROW it (request_resize)
+    instead of deadlocking.  Every span arrives unskipped and
+    byte-exact even with the writer racing ahead."""
+    ring = Ring(space='system')
+    hdr = _hdr(frame_shape=(4,))
+    NSPAN, STRIDE, OV = 30, 8, 4
+    GULP = STRIDE + OV
+    reader_attached = threading.Event()
+    received = []
+    errors = []
+
+    def writer():
+        with ring.begin_writing() as wr:
+            # 2 strides of buffering: far below the hold-ahead
+            # capacity bound (gulp + stride + ghost)
+            with wr.begin_sequence(hdr, gulp_nframe=STRIDE,
+                                   buf_nframe=2 * STRIDE) as seq:
+                for k in range(NSPAN):
+                    if k == 1:
+                        assert reader_attached.wait(30)
+                    with seq.reserve(STRIDE) as span:
+                        span.data.as_numpy()[...] = \
+                            np.arange(STRIDE * 4).reshape(STRIDE, 4) \
+                            + 1000 * k
+                        span.commit(STRIDE)
+
+    def reader():
+        try:
+            for seq in ring.read(guarantee=True):
+                reader_attached.set()
+                seq.resize(gulp_nframe=GULP, buffer_factor=2)
+                for span in seq.read(GULP, STRIDE):
+                    assert span.nframe_skipped == 0
+                    received.append(
+                        np.array(span.data.as_numpy(), copy=True))
+        except Exception as exc:          # pragma: no cover
+            errors.append(exc)
+
+    wt = threading.Thread(target=writer, daemon=True)
+    rt = threading.Thread(target=reader, daemon=True)
+    wt.start()
+    rt.start()
+    wt.join(60)
+    rt.join(60)
+    assert not wt.is_alive() and not rt.is_alive(), \
+        "overlapped read deadlocked on an undersized ring"
+    assert not errors
+    ref = np.concatenate(
+        [np.arange(STRIDE * 4).reshape(STRIDE, 4) + 1000 * k
+         for k in range(NSPAN)])
+    assert [r.shape[0] for r in received] == \
+        [GULP] * (NSPAN - 1) + [STRIDE]
+    off = 0
+    for arr in received:
+        np.testing.assert_array_equal(arr, ref[off:off + arr.shape[0]])
+        off += STRIDE
+    # the generator grew the ring to the deadlock-free bound
+    fb = 4 * 4
+    assert ring.total_span >= (GULP + STRIDE) * fb + ring.ghost_span
+
+
 def test_device_ring_take_tiling_macro_donation():
     """Macro-span donation proof: several exclusively-owned per-gulp
     chunks exactly tiling a macro span are claimed as a list; a

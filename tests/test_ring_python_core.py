@@ -67,6 +67,12 @@ test_macro_blocked_acquire_partial_on_eod = \
     test_ring.test_macro_blocked_acquire_partial_on_eod
 test_macro_blocked_reserve_wakes_on_poison = \
     test_ring.test_macro_blocked_reserve_wakes_on_poison
+test_macro_overlap_history_ghost_wrap = \
+    test_ring.test_macro_overlap_history_ghost_wrap
+test_macro_overlap_history_eod_partial = \
+    test_ring.test_macro_overlap_history_eod_partial
+test_overlap_hold_ahead_grows_small_ring = \
+    test_ring.test_overlap_hold_ahead_grows_small_ring
 test_device_ring_take_tiling_macro_donation = \
     test_ring.test_device_ring_take_tiling_macro_donation
 

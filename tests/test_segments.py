@@ -326,6 +326,153 @@ def test_ringcheck_sees_no_traffic_on_elided_interiors(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# in-program halo carry: the lifted 'overlap' boundary (BF-I192;
+# docs/perf.md "FDMT FRB search")
+# ---------------------------------------------------------------------------
+
+F_DM, T_DM, G_DM, MD_DM, NTAP_DM = 8, 256, 32, 8, 4
+
+
+class _FilterbankSource(bf.SourceBlock):
+    """Time-LAST (freq, time) f32 stream — the dedispersion chain's
+    native layout (NumpySourceBlock is frame-axis-first)."""
+
+    def __init__(self, **kwargs):
+        super(_FilterbankSource, self).__init__(
+            ['filterbank'], gulp_nframe=G_DM, **kwargs)
+
+    def create_reader(self, name):
+        class R(object):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+        return R()
+
+    def on_sequence(self, reader, name):
+        rng = np.random.RandomState(11)
+        self._data = rng.randn(F_DM, T_DM).astype(np.float32)
+        self._pos = 0
+        return [{'name': 'filterbank', 'time_tag': 0,
+                 '_tensor': {'shape': [F_DM, -1], 'dtype': 'f32',
+                             'labels': ['freq', 'time'],
+                             'scales': [[100.0, 1.0], [0.0, 1e-3]],
+                             'units': ['MHz', 's']}}]
+
+    def on_data(self, reader, ospans):
+        if self._pos >= T_DM:
+            return [0]
+        n = min(ospans[0].nframe, T_DM - self._pos)
+        ospans[0].data.as_numpy()[:, :n] = \
+            self._data[:, self._pos:self._pos + n]
+        self._pos += n
+        return [n]
+
+
+def _run_dm_chain(segments=None, gulp_batch=1):
+    """src -> copy h2d -> fdmt -> matched_filter -> threshold -> copy
+    d2h -> sink: every interior boundary is an overlap boundary the
+    halo carry must lift."""
+    counters.reset()
+    collected = []
+
+    class _TimeLastSink(bf.SinkBlock):
+        def on_sequence(self, iseq):
+            pass
+
+        def on_data(self, ispan):
+            from bifrost_tpu.xfer import to_host
+            collected.append(np.array(to_host(ispan.data), copy=True))
+
+    with bf.Pipeline(segments=segments, gulp_batch=gulp_batch,
+                     sync_depth=4) as p:
+        src = _FilterbankSource()
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fdmt_stage(b, max_delay=MD_DM)
+        b = bf.blocks.matched_filter(b, NTAP_DM)
+        b = bf.blocks.threshold(b, 0.5)
+        b = bf.blocks.copy(b, space='system')
+        _TimeLastSink(b)
+        p.run()
+    out = np.concatenate(collected, axis=-1)
+    return out, p, counters.snapshot()
+
+
+def test_halo_carry_fuses_overlap_chain_byte_identical():
+    """A provably-safe overlap chain fuses WITH the in-program halo
+    carry: byte-identical output, one segment, interior rings elided
+    with zero traffic, and the segment.overlap_carried counter
+    records each lifted boundary."""
+    base, p0, snap0 = _run_dm_chain(None)
+    assert snap0.get('segment.overlap_carried', 0) == 0
+    out, p, snap = _run_dm_chain('force')
+    assert np.array_equal(base, out)
+    assert len(p._segments) == 1
+    seg = p._segments[0]
+    assert [_type_name(m) for m in seg._members] == \
+        ['FdmtStageBlock', 'MatchedFilterBlock', 'ThresholdBlock']
+    # both interior boundaries (fdmt->mf, mf->threshold) carried
+    assert snap['segment.overlap_carried'] == 1
+    assert snap['segment.compiled'] == 1
+    assert snap['segment.elided_rings'] == 2
+    for ring in seg._elided:
+        assert counters.get('ring.%s.gulps' % ring) == 0
+    for m in seg._members:
+        assert ('block.%s.dispatches' % m) not in snap
+
+
+def test_halo_carry_macro_gulp_byte_identical():
+    """K>1 macro gulps over the carried segment: the ghost history is
+    sliced from the span head ONCE and the interior handoffs are
+    elided inside the scanned program — still byte-identical, with
+    K fewer dispatches."""
+    base, _, _ = _run_dm_chain(None)
+    out, p, snap = _run_dm_chain('force', gulp_batch=4)
+    assert np.array_equal(base, out)
+    assert snap['segment.overlap_carried'] == 1
+    # 8 logical gulps at K=4 -> 2 dispatches
+    assert snap['segment.dispatches'] == 2
+    assert snap['segment.gulps'] == 8
+
+
+def test_boundary_overlap_carried_reason():
+    """The planner reports 'overlap_carried' (a FUSING record) for
+    derivable stage overlap, and still cuts with 'overlap' when the
+    consumer's declaration cannot be derived from its stages
+    (test_boundary_overlap holds the mismatch case)."""
+    with bf.Pipeline() as p:
+        src = _FilterbankSource()
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fdmt_stage(b, max_delay=MD_DM)
+        b = bf.blocks.matched_filter(b, NTAP_DM)
+        GatherSink(bf.blocks.copy(b, space='system'))
+    _chains, boundaries = bseg.plan(p, 'auto')
+    reasons = {(_type_name(b['producer']), b['reason'])
+               for b in boundaries}
+    assert ('FdmtStageBlock', 'overlap_carried') in reasons
+    assert ('FdmtStageBlock', 'overlap') not in reasons
+
+
+def test_validate_reports_bf_i192_for_carried_boundary():
+    """Pipeline.validate() surfaces each lifted overlap boundary as a
+    BF-I192 info (never an error: carry is an optimization, and its
+    silent disengage is what telemetry_diff watches)."""
+    with bf.Pipeline(segments='auto') as p:
+        src = _FilterbankSource()
+        b = bf.blocks.copy(src, space='tpu')
+        b = bf.blocks.fdmt_stage(b, max_delay=MD_DM)
+        b = bf.blocks.matched_filter(b, NTAP_DM)
+        GatherSink(bf.blocks.copy(b, space='system'))
+    diags = [d for d in p.validate() if d.code == 'BF-I192']
+    assert len(diags) == 1
+    assert diags[0].severity == 'info'
+    assert 'halo carry' in diags[0].message
+    assert not [d for d in p.validate()
+                if d.code == 'BF-I190' and 'overlap' in d.message]
+
+
+# ---------------------------------------------------------------------------
 # split/re-fuse (the auto-tuner's segment-boundary knob)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""FDMT FRB-search flagship gate: the capture -> channelize -> FDMT ->
+matched-filter -> threshold -> candidate-sink chain must be EXACT,
+halo-carried, and inside its latency SLO — this publishes the
+BENCH_FDMT_*.json artifact series.
+
+Runs bench_suite config 22 (bench_suite.bench_fdmt_chain: three arms —
+unfused block chain, halo-carried segment, halo-carried segment at
+macro K=4 — interleaved over the same dispersed-pulse stream) in a
+fresh subprocess pinned to the CPU backend, and asserts:
+
+- ``byte_identical``          — all three arms' candidate streams are
+  byte-identical: the in-program halo carry is a scheduling
+  optimization, never a numerics change;
+- ``oracle_within_rtol``      — every arm matches the sequential
+  float64 numpy oracle (fdmt_numpy + fixed-order boxcar) within the
+  FDMT race gate rtol (BF_FDMT_GATE_RTOL, default 1e-4);
+- ``candidates_match_oracle`` — the candidate count at the fixed
+  false-alarm rate matches the oracle's count (the headline
+  candidates/s metric counts real detections, not numeric noise);
+- ``halo_carry_engaged``      — under BF_SEGMENTS=force the chain
+  compiled into ONE segment, the member blocks dispatched ZERO times,
+  the ``segment.overlap_carried`` counter shows the ``overlap``
+  boundary was lifted (BF-I192), and the interior rings registered
+  zero span traffic under BF_RINGCHECK=1;
+- ``p99_under_budget``        — capture-to-candidate exit age p99
+  (worst arm) is under BF_SLO_MS.
+
+Exit codes: 0 pass, 3 a gate condition failed, 2 the bench failed to
+produce a result.  ``tools/watch_and_bench.sh`` runs this after the
+FX-correlator gate (``BF_SKIP_FDMT_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config22(timeout=1800):
+    """One bench_suite --config 22 subprocess on the CPU backend;
+    returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # a configured global batch/donate/impl would skew the arm
+    # comparison — the bench sets its own per-arm knobs
+    env.pop('BF_GULP_BATCH', None)
+    env.pop('BF_DONATE', None)
+    env.pop('BF_FDMT_IMPL', None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '22'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'fdmt' in d:
+            return d
+        if isinstance(d, dict) and d.get('error'):
+            raise RuntimeError('config 22 failed: %s' % d['error'])
+    raise RuntimeError(
+        'config 22 produced no result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1000:], out.stderr[-1000:]))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    round_ = os.environ.get('BF_BENCH_ROUND', 'cpu')
+    ap.add_argument('--out', default='BENCH_FDMT_%s.json' % round_,
+                    help='artifact path (full config-22 result + '
+                         'verdict)')
+    ap.add_argument('--timeout', type=float, default=1800.0,
+                    help='bench subprocess timeout in seconds')
+    args = ap.parse_args()
+
+    try:
+        res = run_config22(timeout=args.timeout)
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        print('fdmt_gate: bench failed: %s' % exc, file=sys.stderr)
+        return 2
+
+    byte_ok = bool(res.get('byte_identical'))
+    oracle_ok = bool(res.get('oracle_within_rtol'))
+    cand_ok = bool(res.get('candidates_match_oracle'))
+    carry_ok = bool(res.get('halo_carry_engaged'))
+    slo = res.get('slo', {})
+    slo_ok = bool(slo.get('p99_under_budget'))
+    ok = byte_ok and oracle_ok and cand_ok and carry_ok and slo_ok
+    artifact = dict(res,
+                    gate={'byte_identical': byte_ok,
+                          'oracle_within_rtol': oracle_ok,
+                          'candidates_match_oracle': cand_ok,
+                          'halo_carry_engaged': carry_ok,
+                          'p99_under_budget': slo_ok,
+                          'pass': ok,
+                          'round': os.environ.get('BF_BENCH_ROUND',
+                                                  '')})
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write('\n')
+    fd = res.get('fdmt', {})
+    print('fdmt_gate: %s candidates/s (winner %s), %d candidates '
+          '(oracle %d) @ FAR %s, p99 %.0f ms / budget %.0f ms, '
+          'byte_identical=%s oracle_within_rtol=%s '
+          'halo_carry_engaged=%s %s'
+          % (fd.get('candidates_per_s', -1), fd.get('winner'),
+             fd.get('candidates', -1), fd.get('oracle_candidates', -1),
+             fd.get('false_alarm_rate'),
+             slo.get('exit_age_p99_ms_worst_arm', -1),
+             slo.get('budget_ms', -1), byte_ok, oracle_ok, carry_ok,
+             'PASS' if ok else 'FAIL'))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -177,7 +177,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--family', default=None,
                     help='limit to one cache family (e.g. beamform, '
-                         'linalg_xcorr, xengine, corner_turn)')
+                         'linalg_xcorr, xengine, corner_turn, fdmt)')
     ap.add_argument('--json', action='store_true',
                     help='dump the merged report as JSON')
     ap.add_argument('--clear', action='store_true',

@@ -33,6 +33,9 @@ DIRECTION is bad:
                                          silently dropping)
     fleet.hosts_live          lower      any decrease (a publisher
                                          stopped streaming)
+    fdmt.candidates_per_s     lower      10%%
+    segment.overlap_carried   lower      any decrease (halo carry
+                                         silently disengaged)
 
 Unmatched numeric keys are compared informationally (reported at
 >50%% drift, never flagged).  Exit code 0 = no regressions (advisory
@@ -78,6 +81,13 @@ WATCHLIST = [
     # X-engine's winner rate — a drop means the quantized candidate
     # stopped winning or the race landed somewhere slower
     ('*xengine.gops_per_s*', 'lower', 'pct', 10.0),
+    # FDMT FRB-search flagship (BENCH_FDMT, config 22): the headline
+    # candidates/s at fixed false-alarm rate, and the halo-carry
+    # engagement counter — overlap_carried dropping between
+    # same-config rounds means the in-program halo carry silently
+    # disengaged and the chain fell back to per-gulp overlapped reads
+    ('*fdmt.candidates_per_s*', 'lower', 'pct', 10.0),
+    ('*segment.overlap_carried*', 'lower', 'any', 0.0),
     # elastic control plane (SCHED_CHAOS, config 20): the chaos drill
     # SIGKILLs a host mid-stream — fewer migrations or re-placement
     # events between same-config rounds means the death watch or the

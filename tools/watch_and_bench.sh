@@ -393,6 +393,23 @@ for i in $(seq 1 400); do
           exit "$xrc"
         fi
       fi
+      # FDMT FRB-search flagship gate: config 22 — all three arms
+      # (unfused / halo-carried segment / segment at macro K) must be
+      # byte-identical and match the float64 numpy oracle, the
+      # ``overlap`` fusion boundary must be provably lifted (zero
+      # member dispatches, zero interior-ring span traffic under
+      # BF_RINGCHECK=1), and capture-to-candidate p99 must sit under
+      # BF_SLO_MS.  Writes BENCH_FDMT_${ROUND}.json.
+      if [ "${BF_SKIP_FDMT_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) fdmt frb-search gate (config 22)" >> "$LOG"
+        python tools/fdmt_gate.py --out "BENCH_FDMT_${ROUND}.json" >> "$LOG" 2>&1
+        frc=$?
+        echo "$(date -u +%FT%TZ) fdmt gate rc=$frc" >> "$LOG"
+        if [ "$frc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) fdmt frb-search gate FAILED" >> "$LOG"
+          exit "$frc"
+        fi
+      fi
       exit 0
     fi
     # never leave a truncated artifact where round automation could
