@@ -10,7 +10,7 @@ import os
 __all__ = ['get_core', 'set_core', 'set_openmp_cores',
            'numa_node_of_core', 'bind_memory_to_node',
            'bind_memory_to_core', 'available_cores',
-           'partition_cores']
+           'partition_cores', 'spread_cores']
 
 
 def available_cores():
@@ -121,6 +121,19 @@ def partition_cores(weights, cores=None):
         out[t] = cores[pos:pos + share[t]]
         pos += share[t]
     return out
+
+
+def spread_cores(n, cores=None):
+    """Pick ``n`` pin targets for a worker group (sharded capture
+    threads): the pool round-robins when it is smaller than ``n`` so
+    every worker still gets a core to pin to (shared, not exclusive).
+    ``cores`` is an explicit pool, else this process's affinity mask."""
+    if cores is None:
+        cores = available_cores()
+    cores = list(cores)
+    if not cores:
+        return [None] * n
+    return [cores[i % len(cores)] for i in range(n)]
 
 
 def numa_node_of_core(core):

@@ -84,6 +84,8 @@ CODES = {
     'BF-E210': 'duplicate tenant id in a service spec',
     'BF-E211': 'tenant quota smaller than one gulp span',
     'BF-W212': 'tenant core requests oversubscribe the host',
+    'BF-W230': 'capture ring sized below two capture spans',
+    'BF-W231': 'tenant quota below its declared ingest rate',
     'BF-E220': 'tenant core demand exceeds every schedulable host',
     'BF-E221': 'placement pins a tenant to an unknown fabric host',
     'BF-E222': 'placement fabric pre-gate failed (verify_fabric '
@@ -1291,7 +1293,16 @@ def verify_service(specs, ncores=None):
     - **BF-W212** core oversubscription: the tenants' summed
       ``ncores`` requests exceed the host pool — tenants will SHARE
       cores round-robin (``affinity.partition_cores``) instead of
-      owning them.
+      owning them;
+    - **BF-W230** capture ring below two spans: a 'udp' source whose
+      ``ring_nframe`` is smaller than 2x its ``buffer_ntime`` cannot
+      hold the capture engine's double-buffered span window — the
+      writer stalls against its own open span and the socket drops at
+      wire rate;
+    - **BF-W231** quota below ingest rate: a 'udp' source declares
+      ``ingest_bytes_per_s`` above the tenant's ``quota_bytes_per_s``
+      — the quota gate sheds a stream the capture tier was explicitly
+      sized to sustain.
 
     ``ncores`` is the schedulable core count (default: this process's
     affinity mask).  Returns :class:`Diagnostic` s anchored on
@@ -1323,6 +1334,35 @@ def verify_service(specs, ncores=None):
                 'raise the quota above one span per second, shrink '
                 'the gulp, or use the pace policy'
                 % (s.id, s.quota_bytes_per_s, s.gulp_nbyte),
+                block='tenant:%s' % s.id))
+    for s in specs:
+        src = s.source if isinstance(s.source, dict) else {}
+        if src.get('kind') != 'udp':
+            continue
+        buf_ntime = int(src.get('buffer_ntime', 64) or 64)
+        ring_nframe = src.get('ring_nframe')
+        if ring_nframe is not None and \
+                int(ring_nframe) < 2 * buf_ntime:
+            diags.append(Diagnostic(
+                'BF-W230',
+                'tenant %r capture ring holds %d frames but the '
+                'capture engine keeps a double-buffered window of 2 x '
+                'buffer_ntime = %d frames open: the writer stalls '
+                'against its own open span and the socket drops at '
+                'wire rate — raise ring_nframe to at least %d'
+                % (s.id, int(ring_nframe), 2 * buf_ntime,
+                   2 * buf_ntime),
+                block='tenant:%s' % s.id))
+        ingest = src.get('ingest_bytes_per_s')
+        if ingest and s.quota_bytes_per_s > 0 and \
+                float(ingest) > s.quota_bytes_per_s:
+            diags.append(Diagnostic(
+                'BF-W231',
+                'tenant %r declares an ingest rate of %.0f B/s but '
+                'its quota admits only %.0f B/s: the quota gate will '
+                'shed a stream the capture tier was sized to sustain '
+                '— raise the quota or lower the declared rate'
+                % (s.id, float(ingest), s.quota_bytes_per_s),
                 block='tenant:%s' % s.id))
     if ncores is None:
         from ..affinity import available_cores

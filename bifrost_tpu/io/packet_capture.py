@@ -22,7 +22,11 @@ from __future__ import annotations
 
 import ctypes
 import errno
+import os
+import select
 import socket as socket_mod
+import threading
+import time as time_mod
 
 import numpy as np
 
@@ -30,7 +34,7 @@ from .packet_formats import get_format, PacketDesc
 from ..ring import RingWriter
 
 __all__ = ['PacketCaptureCallback', 'UDPCapture', 'NativeUDPCapture',
-           'UDPSniffer', 'DiskReader',
+           'ShardedUDPCapture', 'UDPSniffer', 'DiskReader',
            'CAPTURE_STARTED', 'CAPTURE_CONTINUED', 'CAPTURE_ENDED',
            'CAPTURE_NO_DATA', 'CAPTURE_INTERRUPTED']
 
@@ -106,9 +110,24 @@ class _PacketCapture(object):
         self._wseq = None
         self._seq0 = None
         self._bufs = []          # [(start_seq, WriteSpan, view, got_mask)]
+        # loss ledger: nignored is kept as the historical aggregate and
+        # always equals nlate + nalien (late = seq behind the window or
+        # before seq0; alien = src outside [src0, src0+nsrc))
         self.stats = {'ngood_bytes': 0, 'nmissing_bytes': 0,
                       'nignored': 0, 'ninvalid': 0,
+                      'nlate': 0, 'nalien': 0, 'ndup': 0, 'nreceived': 0,
                       'src_ngood': np.zeros(self.nsrc, np.int64)}
+        # one lock serializes all window/ledger state; recvmmsg and
+        # header decode run outside it.  RLock: _process_one nests
+        # inside _ingest_batch's critical section on mixed batches.
+        self._lock = threading.RLock()
+        self._claim_cv = threading.Condition(self._lock)
+        self._commit_cv = threading.Condition(self._lock)
+        self._claims = {}        # span start -> in-flight zero-copy claims
+        self._ncommits = 0
+        self._max_seq = None     # highest seq seen (reorder-depth ref)
+        self._raw_stride = max_payload_size + 1024
+        self._reorder_hist = 'capture.%s.reorder_depth' % ring.name
         from ..proclog import ProcLog
         self._stats_proclog = ProcLog('%s_capture/stats' % ring.name)
 
@@ -125,47 +144,161 @@ class _PacketCapture(object):
         hdr.setdefault('name', hdr.get('name', 'capture-%d' % time_tag))
         # downstream pipeline blocks size their gulps from the header
         hdr.setdefault('gulp_nframe', self.buffer_ntime)
+        # stamp cumulative capture loss into _overload so it rides the
+        # same shed-accounting channel ring.py merges writer-side
+        # (nonzero on sequence restarts after a gapped stream)
+        stamp = dict(hdr.get('_overload') or {})
+        stamp.update({
+            'capture_missing_bytes': int(self.stats['nmissing_bytes']),
+            'capture_late': int(self.stats['nlate']),
+            'capture_alien': int(self.stats['nalien']),
+            'capture_invalid': int(self.stats['ninvalid'])})
+        hdr['_overload'] = stamp
         self._wseq = self._writer.begin_sequence(
             hdr, gulp_nframe=self.buffer_ntime,
             buf_nframe=4 * self.buffer_ntime)
         self._seq0 = (desc.seq // self.slot_ntime) * self.slot_ntime
         self._bufs = []
+        self._committed_end = 0
 
     def _open_buf(self, start):
         span = self._wseq.reserve(self.buffer_ntime)
         view = span.data.as_numpy().view(np.uint8).reshape(
             self.buffer_ntime, self.nsrc, -1)
-        view[...] = 0
+        # NOTE: no view[...] = 0 here — only the cells still missing at
+        # commit get blanked (from the got-mask complement), so the hot
+        # path never touches bytes a packet is about to overwrite
         got = np.zeros((self.buffer_ntime, self.nsrc), bool)
         self._bufs.append((start, span, view, got))
 
+    def _span_retirable(self, start):
+        """Whether the head span may retire now (engine lock held).
+        The sharded engine overrides this with bounded-skew
+        backpressure; the single-threaded engines always say yes."""
+        return True
+
     def _commit_oldest(self):
+        # zero-copy claims pin a span against commit; cv.wait drops
+        # the engine lock, so several workers can be in here at once.
+        # Each call retires AT MOST the span that was head at entry:
+        # if the head moved while we waited, a sibling already retired
+        # it and popping again would empty (and then restart!) the
+        # window.
+        if not self._bufs:
+            return
+        target = self._bufs[0][0]
+        deadline = None
+        while self._bufs and self._bufs[0][0] == target:
+            if self._claims.get(target, 0):
+                self._claim_cv.wait()
+                continue
+            if not self._span_retirable(target):
+                # give lagging zero-copy workers a short grace to fill
+                # this span before retiring it (their queued packets
+                # would otherwise all turn into late drops); the bound
+                # keeps a stalled flow from wedging the window
+                now = time_mod.monotonic()
+                if deadline is None:
+                    deadline = now + 0.05
+                if now < deadline:
+                    self._claim_cv.wait(deadline - now)
+                    continue
+            break
+        if not self._bufs or self._bufs[0][0] != target:
+            return
         start, span, view, got = self._bufs.pop(0)
+        self._committed_end = start + self.buffer_ntime
+        # blank ONLY what was missed: per-span zero-fill is gone, so
+        # never-written cells hold stale ring bytes until this point
+        miss_t, miss_s = np.nonzero(~got)
+        if miss_t.size:
+            view[miss_t, miss_s, :] = 0
         # per-source loss accounting + >50%-loss blanking
         # (reference: packet_capture.hpp:505-534)
         pkt_bytes = self.payload_size
-        for src in range(self.nsrc):
-            ngood = int(got[:, src].sum())
-            self.stats['src_ngood'][src] += ngood * pkt_bytes
-            nmiss = self.buffer_ntime - ngood
-            self.stats['nmissing_bytes'] += nmiss * pkt_bytes
-            self.stats['ngood_bytes'] += ngood * pkt_bytes
-            if ngood * 2 < self.buffer_ntime:
-                view[:, src] = 0   # blank unreliable source
+        ngood_col = got.sum(axis=0).astype(np.int64)
+        self.stats['src_ngood'] += ngood_col * pkt_bytes
+        ngood = int(ngood_col.sum())
+        self.stats['ngood_bytes'] += ngood * pkt_bytes
+        self.stats['nmissing_bytes'] += \
+            (self.buffer_ntime * self.nsrc - ngood) * pkt_bytes
+        for src in np.nonzero(ngood_col * 2 < self.buffer_ntime)[0]:
+            view[:, src] = 0   # blank unreliable source
         span.commit(self.buffer_ntime)
         span.close()
-        self._stats_proclog.update({
-            'ngood_bytes': self.stats['ngood_bytes'],
-            'nmissing_bytes': self.stats['nmissing_bytes'],
-            'ninvalid': self.stats['ninvalid'],
-            'nignored': self.stats['nignored'],
-            'npackets': self.stats['ngood_bytes'] // self.payload_size})
+        self._ncommits += 1
+        self._commit_cv.notify_all()
+        self._stats_proclog.update(self._stats_snapshot())
+
+    def _stats_snapshot(self):
+        st = self.stats
+        d = {'ngood_bytes': st['ngood_bytes'],
+             'nmissing_bytes': st['nmissing_bytes'],
+             'ninvalid': st['ninvalid'],
+             'nignored': st['nignored'],
+             'nlate': st['nlate'],
+             'nalien': st['nalien'],
+             'ndup': st['ndup'],
+             'nreceived': st['nreceived'],
+             'npackets': st['ngood_bytes'] // self.payload_size}
+        for i, w in enumerate(getattr(self, '_wstats', ()) or ()):
+            d['worker%d_npackets' % i] = w['npackets']
+            d['worker%d_nbytes' % i] = w['nbytes']
+            d['worker%d_zero_copy' % i] = w['zero_copy']
+        return d
+
+    def _ensure_window(self, off):
+        """Slide/open spans (engine lock held) until ``off`` lies below
+        the window end.  Returns True if any span was committed."""
+        committed = False
+        while True:
+            if self._bufs:
+                last_end = self._bufs[-1][0] + self.buffer_ntime
+            else:
+                # empty window mid-stream (flush, or every span just
+                # retired): NEVER restart from 0 — resume at the
+                # committed high-water mark, jumping forward to the
+                # span holding ``off`` if the stream skipped ahead
+                last_end = max(
+                    getattr(self, '_committed_end', 0),
+                    off // self.buffer_ntime * self.buffer_ntime)
+            if self._bufs and off < last_end:
+                return committed
+            if len(self._bufs) == 2:
+                self._commit_oldest()   # may drop the lock on claim waits
+                committed = True
+                continue                # re-derive: window may have moved
+            self._open_buf(last_end)
+
+    def _note_seqs(self, seqs):
+        """Track the highest seq seen and feed the reorder-depth
+        histogram (how far behind the running max each arrival is)."""
+        if not len(seqs):
+            return
+        prev = self._max_seq
+        if prev is None:
+            self._max_seq = int(seqs.max())
+            return
+        seqs = np.asarray(seqs, np.int64)
+        run = np.maximum.accumulate(
+            np.concatenate(([prev], seqs)))[:-1]
+        depths = run - seqs
+        from ..telemetry import histograms
+        for d in depths[depths > 0][:32]:      # bound the slow path
+            histograms.observe(self._reorder_hist, int(d))
+        self._max_seq = max(prev, int(seqs.max()))
 
     # -- vectorized batch path (recvmmsg + decode_batch formats) -----------
-    def _assign_batch(self, offs, srcs, payloads):
+    def _assign_batch(self, offs, srcs, payloads, rows=None):
         """Scatter a decoded batch into the open window, sliding it as
-        needed.  Returns True if any span was committed."""
+        needed.  ``offs``/``srcs`` are compact (already filtered);
+        ``rows`` maps them back to rows of ``payloads`` so the gather +
+        span write is the only payload copy.  Returns True if any span
+        was committed."""
         committed = False
+        if rows is None:
+            rows = np.arange(len(offs))
+        pw = payloads.shape[1]
         remaining = np.ones(len(offs), bool)
         while remaining.any():
             last_end = (self._bufs[-1][0] + self.buffer_ntime) \
@@ -180,18 +313,26 @@ class _PacketCapture(object):
                     if m.any():
                         sel = idx[m]
                         ts = offs[sel] - start
-                        view[ts, srcs[sel], :payloads.shape[1]] = \
-                            payloads[sel]
-                        got[ts, srcs[sel]] = True
+                        ss = srcs[sel]
+                        ndup = int(got[ts, ss].sum())
+                        if ndup:
+                            self.stats['ndup'] += ndup
+                        view[ts, ss, :pw] = payloads[rows[sel]]
+                        if pw < view.shape[2]:
+                            view[ts, ss, pw:] = 0   # stale lane tails
+                        got[ts, ss] = True
                 if self._bufs:
-                    too_late = o < self._bufs[0][0]
-                    self.stats['nignored'] += int(too_late.sum())
+                    nlate = int((o < self._bufs[0][0]).sum())
+                    if nlate:
+                        self.stats['nlate'] += nlate
+                        self.stats['nignored'] += nlate
                 remaining[idx] = False
             if beyond.any():
-                if len(self._bufs) == 2:
-                    self._commit_oldest()
-                    committed = True
-                self._open_buf(last_end)
+                # slide ONLY to the nearest out-of-window offset: jumping
+                # straight to the batch max would retire the intermediate
+                # spans before this batch's packets landed in them
+                # (anything still pending would then misclassify as late)
+                committed |= self._ensure_window(int(offs[beyond].min()))
             elif not idx.size:
                 break
         return committed
@@ -207,45 +348,95 @@ class _PacketCapture(object):
             if raw is None:
                 return CAPTURE_NO_DATA if self._seq0 is None \
                     else CAPTURE_INTERRUPTED
-            n = len(lengths)
-            stride = self._raw_stride
-            arr = np.frombuffer(raw, np.uint8,
-                                count=n * stride).reshape(n, stride)
-            if len(set(lengths)) != 1:
-                # mixed sizes: per-packet fallback for this batch
-                for i in range(n):
-                    s, c = self._process_one(bytes(arr[i, :lengths[i]]))
-                    started = started or s
-                    committed = committed or c
-                continue
+            s, c = self._ingest_batch(raw, lengths)
+            started = started or s
+            committed = committed or c
+        return CAPTURE_STARTED if started else CAPTURE_CONTINUED
+
+    def _ingest_batch(self, raw, lengths, wstat=None, info=None):
+        """Decode one recvmmsg batch (outside the lock) and scatter it
+        into the window (under the lock).  ``wstat`` is an optional
+        per-worker counter dict; ``info`` an optional out-dict filled
+        with the batch's in-range srcs + max seq (used by sharded
+        workers to learn their flow for zero-copy engagement).
+        Returns (started, committed)."""
+        n = len(lengths)
+        stride = self._raw_stride
+        arr = np.frombuffer(raw, np.uint8,
+                            count=n * stride).reshape(n, stride)
+        if wstat is not None:
+            wstat['npackets'] += n
+            wstat['nbytes'] += int(sum(lengths))
+        started = committed = False
+        fallback = len(set(lengths)) != 1
+        ok = seqs = srcs = hoff = None
+        if not fallback:
             if lengths[0] < self.fmt.header_size:
-                self.stats['ninvalid'] += n     # runts
-                continue
-            seqs, srcs, hoff = self.fmt.decode_batch(arr)
-            srcs = srcs - self.src0
-            valid = (srcs >= 0) & (srcs < self.nsrc)
-            self.stats['nignored'] += int((~valid).sum())
-            if not valid.any():
-                continue
+                with self._lock:
+                    self.stats['nreceived'] += n
+                    self.stats['ninvalid'] += n     # runts
+                return False, False
+            try:
+                out = self.fmt.decode_batch(arr, lengths[0])
+            except ValueError:
+                # e.g. a VDIF batch mixing legacy/non-legacy framing
+                fallback = True
+            else:
+                seqs, srcs, hoff = out[:3]
+                fvalid = out[3] if len(out) > 3 else None
+                ok = np.ones(n, bool) if fvalid is None \
+                    else np.asarray(fvalid, bool).copy()
+        if fallback:
+            # mixed sizes / undecodable batch: per-packet slow path
+            # over zero-copy slices of the raw buffer
+            for i in range(n):
+                s, c = self._process_one(
+                    raw[i * stride:i * stride + lengths[i]])
+                started = started or s
+                committed = committed or c
+            return started, committed
+        srcs = srcs - self.src0
+        in_range = (srcs >= 0) & (srcs < self.nsrc)
+        with self._lock:
+            self.stats['nreceived'] += n
+            ninvalid = n - int(ok.sum())
+            if ninvalid:
+                self.stats['ninvalid'] += ninvalid
+            nalien = int((ok & ~in_range).sum())
+            if nalien:
+                self.stats['nalien'] += nalien
+                self.stats['nignored'] += nalien
+            ok &= in_range
+            if not ok.any():
+                return False, False
             if self._seq0 is None:
-                first = int(np.nonzero(valid)[0][0])
+                first = int(np.nonzero(ok)[0][0])
                 desc = self.fmt.unpack(bytes(arr[first, :lengths[first]]))
                 if desc is None:
                     self.stats['ninvalid'] += 1
-                    continue
+                    return False, False
                 desc.src -= self.src0
                 self._begin_sequence(desc)
                 started = True
-            offs = seqs - self._seq0
-            fresh = valid & (offs >= 0)
-            self.stats['nignored'] += int((valid & ~fresh).sum())
+            keep = np.nonzero(ok)[0]
+            kseqs = seqs[keep].astype(np.int64)
+            self._note_seqs(kseqs)
+            if info is not None:
+                info['srcs'] = np.unique(srcs[keep])
+                info['max_seq'] = int(kseqs.max())
+            offs = kseqs - self._seq0
+            fresh = offs >= 0
+            nlate = int((~fresh).sum())
+            if nlate:
+                self.stats['nlate'] += nlate
+                self.stats['nignored'] += nlate
             if not fresh.any():
-                continue
+                return started, False
             payloads = arr[:, hoff:lengths[0]]
-            committed = self._assign_batch(offs[fresh].astype(np.int64),
-                                           srcs[fresh].astype(np.int64),
-                                           payloads[fresh]) or committed
-        return CAPTURE_STARTED if started else CAPTURE_CONTINUED
+            committed = self._assign_batch(
+                offs[fresh], srcs[keep[fresh]].astype(np.int64),
+                payloads, keep[fresh])
+        return started, committed
 
     def _recv_raw_batch(self):
         return None, None       # only UDPCapture implements this
@@ -253,44 +444,45 @@ class _PacketCapture(object):
     def _process_one(self, pkt):
         """Single-packet slow path used by recv() and mixed batches."""
         desc = self.fmt.unpack(pkt)
-        if desc is None or desc.valid_mode:
-            # reference decoders gate on valid_mode (tbn.hpp:64,
-            # drx.hpp:64); the native engine does the same
-            self.stats['ninvalid'] += 1
-            return False, False
-        desc.src -= self.src0
-        if desc.src < 0 or desc.src >= self.nsrc:
-            self.stats['nignored'] += 1
-            return False, False
-        started = False
-        if self._seq0 is None:
-            self._begin_sequence(desc)
-            started = True
-        off = desc.seq - self._seq0
-        if off < 0:
-            self.stats['nignored'] += 1
-            return started, False
-        committed = False
-        while True:
-            last_end = (self._bufs[-1][0] + self.buffer_ntime) \
-                if self._bufs else 0
-            if off < last_end:
-                break
-            if len(self._bufs) == 2:
-                self._commit_oldest()
-                committed = True
-            self._open_buf(last_end)
-        for start, span, view, got in self._bufs:
-            if start <= off < start + self.buffer_ntime:
-                t = off - start
-                payload = np.frombuffer(desc.payload, np.uint8)
-                view[t, desc.src, :len(payload)] = payload
-                got[t, desc.src] = True
-                break
-            elif off < start:
-                self.stats['nignored'] += 1   # too late
-                break
-        return started, committed
+        with self._lock:
+            self.stats['nreceived'] += 1
+            if desc is None or desc.valid_mode:
+                # reference decoders gate on valid_mode (tbn.hpp:64,
+                # drx.hpp:64); the native engine does the same
+                self.stats['ninvalid'] += 1
+                return False, False
+            desc.src -= self.src0
+            if desc.src < 0 or desc.src >= self.nsrc:
+                self.stats['nalien'] += 1
+                self.stats['nignored'] += 1
+                return False, False
+            started = False
+            if self._seq0 is None:
+                self._begin_sequence(desc)
+                started = True
+            self._note_seqs(np.asarray([desc.seq], np.int64))
+            off = desc.seq - self._seq0
+            if off < 0:
+                self.stats['nlate'] += 1
+                self.stats['nignored'] += 1
+                return started, False
+            committed = self._ensure_window(off)
+            for start, span, view, got in self._bufs:
+                if start <= off < start + self.buffer_ntime:
+                    t = off - start
+                    payload = np.frombuffer(desc.payload, np.uint8)
+                    if got[t, desc.src]:
+                        self.stats['ndup'] += 1
+                    view[t, desc.src, :len(payload)] = payload
+                    if len(payload) < view.shape[2]:
+                        view[t, desc.src, len(payload):] = 0
+                    got[t, desc.src] = True
+                    break
+                elif off < start:
+                    self.stats['nlate'] += 1
+                    self.stats['nignored'] += 1   # too late
+                    break
+            return started, committed
 
     def recv(self):
         """Process packets until one buffer's worth of time has been
@@ -310,26 +502,35 @@ class _PacketCapture(object):
         return CAPTURE_STARTED if started else CAPTURE_CONTINUED
 
     def flush(self):
-        while self._bufs:
-            self._commit_oldest()
+        with self._lock:
+            # Trim trailing speculative spans first: a zero-copy claim
+            # may have opened a span purely on seq prediction (the
+            # readable packet turned out late/alien, so nothing ever
+            # landed).  An all-empty unclaimed TRAILING span holds no
+            # evidence its seqs exist on the wire — drop the
+            # reservation (zero-frame commit) rather than publish a
+            # phantom all-missing span that breaks the
+            # good+missing == window-covered ledger identity.
+            while (self._bufs and not self._bufs[-1][3].any()
+                   and not self._claims.get(self._bufs[-1][0], 0)):
+                _, span, _, _ = self._bufs.pop()
+                span.commit(0)
+                span.close()
+            while self._bufs:
+                self._commit_oldest()
 
     def end(self):
         self.flush()
-        # final cumulative stats must land regardless of throttling
-        self._stats_proclog.update({
-            'ngood_bytes': self.stats['ngood_bytes'],
-            'nmissing_bytes': self.stats['nmissing_bytes'],
-            'ninvalid': self.stats['ninvalid'],
-            'nignored': self.stats['nignored'],
-            'npackets': self.stats['ngood_bytes'] // self.payload_size},
-            force=True)
-        if self._wseq is not None:
-            self._wseq.end()
-            self._wseq = None
-        if self._writer is not None:
-            self.ring.end_writing()
-            self._writer = None
-        self._seq0 = None
+        with self._lock:
+            # final cumulative stats must land regardless of throttling
+            self._stats_proclog.update(self._stats_snapshot(), force=True)
+            if self._wseq is not None:
+                self._wseq.end()
+                self._wseq = None
+            if self._writer is not None:
+                self.ring.end_writing()
+                self._writer = None
+            self._seq0 = None
         return CAPTURE_ENDED
 
     def __enter__(self):
@@ -640,6 +841,470 @@ class _NativeCaptureStats(object):
 
     def __repr__(self):
         return repr(self._read())
+
+
+class ShardedUDPCapture(_PacketCapture):
+    """N-worker sharded UDP capture: worker threads drain private
+    ``SO_REUSEPORT`` socket queues (or dup()s of one shared queue when
+    REUSEPORT is unavailable), each pinned through affinity.py, all
+    scattering into the SAME double-buffered span window under one
+    engine lock — per-source loss accounting and the >50%-blanking
+    protocol stay exactly as exact as the single-thread engine's
+    (docs/networking.md "Wire-rate capture").
+
+    Zero-copy scatter engages per worker when every condition holds:
+
+    - the format has a fixed frame size (``fmt.frame_size`` or the
+      ``frame_size`` hint) and a ``decode_batch``,
+    - the frame's payload fits the ring lane,
+    - the worker's queue is exclusive (REUSEPORT mode, or a single
+      worker), and
+    - the worker has learned its flow: REUSEPORT hashes datagrams per
+      5-tuple, so a staged batch showing exactly one in-range source
+      means this worker owns that source's stream.
+
+    An engaged worker claims its source's next expected span cells
+    (claims pin spans against commit), points ``recvmmsg`` split
+    iovecs at them (header -> sidecar, payload -> cell), consumes
+    nonblockingly, and verifies the decoded headers against the
+    prediction — misses are repaired per packet (bounce-copy to the
+    true cell) and the worker falls back to the staged
+    one-vectorized-copy path until the flow looks clean again.
+
+    Construction: pass an :class:`.udp_socket.Address` to let the
+    engine create + bind its worker sockets (REUSEPORT mode), or an
+    already-bound socket to shard it across threads."""
+
+    def __init__(self, fmt, addr_or_sock, ring, nsrc, src0,
+                 max_payload_size, buffer_ntime, slot_ntime,
+                 sequence_callback, core=None, nthreads=None,
+                 vlen=None, zero_copy=None, frame_size=None,
+                 cores=None, timeout=0.25):
+        super(ShardedUDPCapture, self).__init__(
+            fmt, ring, nsrc, src0, max_payload_size, buffer_ntime,
+            slot_ntime, sequence_callback, core)
+        env = os.environ
+        if nthreads is None:
+            nthreads = int(env.get('BF_CAPTURE_THREADS', '') or 2)
+        if vlen is None:
+            vlen = int(env.get('BF_CAPTURE_VLEN', '') or 64)
+        if zero_copy is None:
+            zero_copy = env.get('BF_CAPTURE_ZERO_COPY', '1') != '0'
+        self.nthreads = max(int(nthreads), 1)
+        self.vlen = max(min(int(vlen), self.buffer_ntime), 1)
+        self._timeout = timeout
+
+        from .udp_socket import UDPSocket, Address
+        self._own_socks = []
+        if hasattr(addr_or_sock, 'sockaddr'):     # an Address
+            first = UDPSocket(reuseport=True).bind(addr_or_sock)
+            self._own_socks.append(first)
+            socks = [first]
+            if first.reuseport:
+                # siblings bind the RESOLVED port (addr.port may be 0)
+                port = first.sock.getsockname()[1]
+                sib = Address(addr_or_sock.address, port) \
+                    if port != addr_or_sock.port else addr_or_sock
+                for _ in range(self.nthreads - 1):
+                    s = UDPSocket(reuseport=True).bind(sib)
+                    self._own_socks.append(s)
+                    socks.append(s)
+            else:
+                for _ in range(self.nthreads - 1):
+                    s = UDPSocket.from_fd(first.fileno())
+                    self._own_socks.append(s)
+                    socks.append(s)
+            self._exclusive = first.reuseport or self.nthreads == 1
+        else:
+            base = addr_or_sock
+            self.sock = base                       # caller still owns it
+            if hasattr(base, 'recv_mmsg_raw'):
+                socks = [base]
+            else:
+                w = UDPSocket.from_fd(base.fileno())
+                self._own_socks.append(w)
+                socks = [w]
+            for _ in range(self.nthreads - 1):
+                s = UDPSocket.from_fd(base.fileno())
+                self._own_socks.append(s)
+                socks.append(s)
+            self._exclusive = self.nthreads == 1
+        self._socks = socks
+        for s in self._socks:
+            s.set_timeout(timeout)
+
+        # Deterministic source steering: when the wire format carries a
+        # single-byte source id (chips' leading roach byte), a classic
+        # BPF on the REUSEPORT group routes worker = (id - bias) & mask
+        # over the UDP payload, pinning each source's stream to ONE
+        # worker queue regardless of sender ports.  Without it the
+        # kernel's 4-tuple hash may pile several sources onto one
+        # worker (zero-copy then can't engage) — steering makes the
+        # flow-learning deterministic.  Power-of-two worker counts
+        # only (classic BPF has AND but no modulus).
+        steer = getattr(self.fmt, 'SRC_STEER_BYTE', None)
+        self._steered = False
+        if (steer is not None and self.nthreads > 1 and
+                getattr(socks[0], 'reuseport', False) and
+                self.nthreads & (self.nthreads - 1) == 0 and
+                hasattr(socks[0], 'attach_reuseport_cbpf')):
+            off, bias = steer
+            try:
+                socks[0].attach_reuseport_cbpf([
+                    (0x30, 0, 0, off),             # ldb payload[off]
+                    (0x14, 0, 0, bias),            # sub #bias
+                    (0x54, 0, 0, self.nthreads - 1),   # and #mask
+                    (0x16, 0, 0, 0)])              # ret A
+                self._steered = True
+            except OSError:
+                pass
+
+        self._frame_size = frame_size or \
+            getattr(self.fmt, 'frame_size', None)
+        pay = (self._frame_size - self.fmt.header_size) \
+            if self._frame_size else 0
+        self._zc_payload = pay
+        self._zero_copy_ok = bool(
+            zero_copy and self._exclusive and
+            hasattr(self.fmt, 'decode_batch') and
+            0 < pay <= self.payload_size and
+            all(hasattr(s, 'recv_mmsg_scatter') for s in self._socks))
+
+        self._wstats = [dict(npackets=0, nbytes=0, zero_copy=0)
+                        for _ in range(self.nthreads)]
+        self._wstate = [dict(src=None, next=None, zc=False)
+                        for _ in range(self.nthreads)]
+        from .. import affinity
+        self._cores = affinity.spread_cores(
+            self.nthreads, cores if cores is not None else
+            ([core] if core is not None and core >= 0 else None))
+        self._stop = False
+        self._error = None
+        self._started_seen = False
+        self._threads = []
+        for i in range(self.nthreads):
+            t = threading.Thread(
+                target=self._worker, args=(i,),
+                name='capture-%s-w%d' % (ring.name, i), daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    # -- worker side -------------------------------------------------------
+    def _worker(self, widx):
+        sock = self._socks[widx]
+        try:
+            core = self._cores[widx] if self._cores else None
+            if core is not None:
+                from .. import affinity
+                affinity.set_core(core)
+            st = self._wstate[widx]
+            while not self._stop:
+                if st['zc'] and self._seq0 is not None:
+                    self._zero_copy_round(widx, sock, st)
+                else:
+                    self._staged_round(widx, sock, st)
+        except BaseException as e:
+            with self._lock:
+                self._error = e
+                self._commit_cv.notify_all()
+                self._claim_cv.notify_all()
+
+    def _staged_round(self, widx, sock, st):
+        raw, lengths = sock.recv_mmsg_raw(self.vlen, self._raw_stride)
+        if raw is None:
+            return
+        info = {}
+        self._ingest_batch(raw, lengths, self._wstats[widx], info)
+        if not self._zero_copy_ok:
+            return
+        u = info.get('srcs')
+        with self._lock:
+            if u is not None and len(u) == 1:
+                # the kernel hashes per flow: one in-range source in
+                # the whole batch means this worker owns that source's
+                # stream
+                st['src'] = int(u[0])
+                st['next'] = int(info['max_seq']) + 1
+                st['zc'] = True
+            else:
+                st['src'] = None
+                st['zc'] = False
+            self._claim_cv.notify_all()
+
+    def _zero_copy_round(self, widx, sock, st):
+        H = self.fmt.header_size
+        F = self._frame_size
+        P = self._zc_payload
+        # wait for data BEFORE claiming: claims must only ever be held
+        # across the nonblocking recvmmsg below.  While the queue is
+        # hot (last batch came back full) skip the select — the claim
+        # is released immediately on an empty recv, so the worst case
+        # is one wasted claim per queue drain.
+        if not st.get('hot'):
+            ready, _, _ = select.select([sock.sock], [], [],
+                                        self._timeout)
+            if not ready or self._stop:
+                # idle flow: drop the engagement so a stale cursor
+                # can't hold the skew gate (_span_retirable) against
+                # commits
+                with self._lock:
+                    st['zc'] = False
+                    st['src'] = None
+                    self._claim_cv.notify_all()
+                return
+        with self._lock:
+            claim = self._claim_cells(st['src'], st['next'])
+            if claim is None:
+                # cursor unreachable (window raced past it) — resync
+                # through the staged path
+                st['zc'] = False
+                st['src'] = None
+                self._claim_cv.notify_all()
+                return
+            addrs, starts = claim
+        try:
+            side, lens = sock.recv_mmsg_scatter(addrs, H, P)
+        except BaseException:
+            with self._lock:
+                self._release_claims(starts)
+            raise
+        with self._lock:
+            self._release_claims(starts)
+            if side is None:
+                st['hot'] = False
+                return
+            n = len(lens)
+            st['hot'] = n == len(addrs)
+            ws = self._wstats[widx]
+            ws['npackets'] += n
+            ws['nbytes'] += int(sum(lens))
+            ws['zero_copy'] += n
+            self.stats['nreceived'] += n
+            hdr_arr = np.frombuffer(side, np.uint8,
+                                    count=n * H).reshape(n, H)
+            try:
+                out = self.fmt.decode_batch(hdr_arr, F)
+            except ValueError:
+                self.stats['ninvalid'] += n
+                st['zc'] = False
+                st['src'] = None
+                self._claim_cv.notify_all()
+                return
+            seqs, srcs, hoff = out[:3]
+            fvalid = out[3] if len(out) > 3 else None
+            if hoff != H:
+                self.stats['ninvalid'] += n
+                st['zc'] = False
+                st['src'] = None
+                self._claim_cv.notify_all()
+                return
+            seqs = np.asarray(seqs, np.int64)
+            e = int(st['next'])
+            exp = np.arange(e, e + n, dtype=np.int64)
+            okrow = np.asarray(lens, np.int64) == F
+            if fvalid is not None:
+                okrow &= np.asarray(fvalid, bool)
+            srcs0 = np.asarray(srcs, np.int64) - self.src0
+            self._note_seqs(seqs[okrow])
+            hit = okrow & (srcs0 == st['src']) & (seqs == exp)
+            if bool(hit.all()):
+                self._mark_got(exp - self._seq0, st['src'])
+                st['next'] = e + n
+            else:
+                self._repair_zc_batch(st, exp, seqs, srcs0, okrow, P)
+            self._claim_cv.notify_all()   # progress: skew gate may open
+
+    def _span_retirable(self, start):
+        """Bounded-skew backpressure (engine lock held): the head span
+        may not retire while an ENGAGED zero-copy sibling's cursor is
+        still inside it.  On skewed hosts one worker would otherwise
+        slide the window ahead and turn the other worker's entire
+        kernel queue into late drops.  Advisory only — _commit_oldest
+        waits a bounded grace, so a stalled flow cannot wedge the
+        window."""
+        if self._seq0 is None:
+            return True
+        end = start + self.buffer_ntime
+        for st in self._wstate:
+            nxt = st['next']
+            if st['zc'] and nxt is not None and \
+                    nxt - self._seq0 < end:
+                return False
+        return True
+
+    def _claim_cells(self, src, e):
+        """Engine lock held.  Claim the span cells for seqs
+        [e, e+vlen) of ``src`` — sliding the window forward as needed —
+        and return (cell_addresses, claimed_span_starts), or None when
+        the cursor is unreachable (behind seq0 or the window head).
+        Claims pin their spans against commit until released."""
+        off0 = e - self._seq0
+        if off0 < 0:
+            return None
+        self._ensure_window(off0)
+        if not self._bufs or off0 < self._bufs[0][0]:
+            return None
+        last_end = self._bufs[-1][0] + self.buffer_ntime
+        k = min(self.vlen, last_end - off0)
+        addrs = np.empty(k, np.uint64)
+        starts = []
+        P = self._zc_payload
+        for start, span, view, got in self._bufs:
+            lo = max(off0, start)
+            hi = min(off0 + k, start + self.buffer_ntime)
+            if lo >= hi:
+                continue
+            lane = view.shape[2]
+            ts = np.arange(lo - start, hi - start, dtype=np.int64)
+            addrs[lo - off0:hi - off0] = \
+                (view.ctypes.data +
+                 (ts * self.nsrc + src) * lane).astype(np.uint64)
+            if P < lane:
+                view[ts, src, P:] = 0     # pre-zero stale lane tails
+            self._claims[start] = self._claims.get(start, 0) + 1
+            starts.append(start)
+        return addrs, starts
+
+    def _release_claims(self, starts):
+        for s in starts:
+            c = self._claims.get(s, 0) - 1
+            if c > 0:
+                self._claims[s] = c
+            else:
+                self._claims.pop(s, None)
+        self._claim_cv.notify_all()
+
+    def _locate(self, off):
+        for start, span, view, got in self._bufs:
+            if start <= off < start + self.buffer_ntime:
+                return view, got, off - start
+        return None
+
+    def _mark_got(self, offs, src):
+        for start, span, view, got in self._bufs:
+            m = (offs >= start) & (offs < start + self.buffer_ntime)
+            if m.any():
+                ts = offs[m] - start
+                ndup = int(got[ts, src].sum())
+                if ndup:
+                    self.stats['ndup'] += ndup
+                got[ts, src] = True
+
+    def _repair_zc_batch(self, st, exp, seqs, srcs0, okrow, P):
+        """Engine lock held.  Slow path after a speculative scatter
+        whose decoded headers disagree with the prediction: each
+        payload currently sits at its PREDICTED cell
+        (exp[i], st['src']).  Pass 1 bounce-copies every misplaced
+        payload out BEFORE any window motion (a slide for one packet
+        must not retire a span still holding another's bytes); pass 2
+        places them at their true cells."""
+        n = len(exp)
+        src_pred = st['src']
+        moves = []            # (i, seq, src, payload_copy)
+        good_max = None
+        demote = False
+        for i in range(n):
+            if not okrow[i]:
+                self.stats['ninvalid'] += 1
+                continue
+            q = int(seqs[i])
+            s = int(srcs0[i])
+            if s < 0 or s >= self.nsrc:
+                self.stats['nalien'] += 1
+                self.stats['nignored'] += 1
+                demote = True
+                continue
+            good_max = q if good_max is None else max(good_max, q)
+            if s != src_pred:
+                demote = True
+            if q == int(exp[i]) and s == src_pred:
+                self._mark_got(np.asarray([q - self._seq0]), s)
+                continue
+            loc = self._locate(int(exp[i]) - self._seq0)
+            if loc is None:           # predicted span raced away
+                self.stats['nlate'] += 1
+                self.stats['nignored'] += 1
+                continue
+            pview, _, pt = loc
+            moves.append((q, s, pview[pt, src_pred, :P].copy()))
+        for q, s, payload in moves:
+            toff = q - self._seq0
+            if self._bufs and toff < self._bufs[0][0]:
+                self.stats['nlate'] += 1
+                self.stats['nignored'] += 1
+                continue
+            self._ensure_window(toff)
+            loc = self._locate(toff)
+            if loc is None:
+                self.stats['nlate'] += 1
+                self.stats['nignored'] += 1
+                continue
+            tview, tgot, tt = loc
+            if tgot[tt, s]:
+                self.stats['ndup'] += 1
+            tview[tt, s, :P] = payload
+            if P < tview.shape[2]:
+                tview[tt, s, P:] = 0
+            tgot[tt, s] = True
+        if good_max is not None:
+            st['next'] = good_max + 1
+        if demote:
+            st['zc'] = False
+            st['src'] = None
+
+    # -- consumer side -----------------------------------------------------
+    def set_timeout(self, secs):
+        self._timeout = secs
+        for s in self._socks:
+            s.set_timeout(secs)
+
+    def recv(self):
+        """Block until the workers commit a span (or the timeout
+        expires): the worker threads ARE the capture loop; recv() is
+        the pacing/observation point the single-thread engine's recv()
+        is for callers."""
+        with self._commit_cv:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            n0 = self._ncommits
+            deadline = (time_mod.monotonic() + self._timeout) \
+                if self._timeout is not None else None
+            while (self._ncommits == n0 and self._error is None and
+                    not self._stop):
+                if deadline is None:
+                    self._commit_cv.wait(1.0)
+                else:
+                    rem = deadline - time_mod.monotonic()
+                    if rem <= 0:
+                        break
+                    self._commit_cv.wait(rem)
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            if self._ncommits == n0:
+                return CAPTURE_NO_DATA if self._seq0 is None \
+                    else CAPTURE_INTERRUPTED
+            if not self._started_seen:
+                self._started_seen = True
+                return CAPTURE_STARTED
+            return CAPTURE_CONTINUED
+
+    def end(self):
+        self._stop = True
+        with self._lock:
+            self._commit_cv.notify_all()
+            self._claim_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        rc = super(ShardedUDPCapture, self).end()
+        for s in self._own_socks:
+            try:
+                s.close()
+            except Exception:
+                pass
+        self._own_socks = []
+        return rc
 
 
 class UDPSniffer(_PacketCapture):

@@ -13,6 +13,16 @@ Each codec here is a small object with
   *Decoder* field-for-field (so real recorded packets decode
   identically); returns None where the reference's frame-size /
   validity gates reject the packet outright
+- ``decode_batch(arr) -> (seqs, srcs, payload_offset[, valid])`` —
+  vectorized header decode over a ``(npkt, pkt_bytes)`` uint8 batch
+  (one recvmmsg worth); EVERY gallery codec implements it so no wire
+  format falls into the per-packet ``struct.unpack`` slow path.  The
+  optional 4th element is a bool mask mirroring unpack's rejection
+  gates (sync word, frame size, valid_mode bit); ``None``/omitted
+  means all rows valid.  A codec whose payload offset is not uniform
+  across the batch (VDIF mixing legacy and non-legacy framing) raises
+  ValueError and the capture engine falls back to per-packet decode
+  for that batch.
 
 Wire-convention notes (all faithful to the reference):
 
@@ -47,6 +57,32 @@ SYNC_WORD = 0x5CDEC0DE
 TBN_FRAME_SIZE = 1048     # reference: tbn.hpp:33
 DRX_FRAME_SIZE = 4128     # reference: drx.hpp:33
 DRX8_FRAME_SIZE = 8224    # reference: drx8.hpp:33
+
+
+def _field(arr, off, dtype):
+    """Per-row fixed-width header field at byte offset ``off`` of a
+    (npkt, pkt_bytes) uint8 batch, widened to int64 (every decode_batch
+    works in int64 so seq arithmetic never wraps)."""
+    nbyte = np.dtype(dtype).itemsize
+    return arr[:, off:off + nbyte].copy().view(dtype).astype(
+        np.int64).ravel()
+
+
+def _field_raw(arr, off, dtype):
+    """Like :func:`_field` but keeps the native unsigned dtype — for
+    sync-word comparisons whose values don't fit in int63."""
+    nbyte = np.dtype(dtype).itemsize
+    return arr[:, off:off + nbyte].copy().view(dtype).ravel()
+
+
+def _isqrt(x):
+    """Exact elementwise integer sqrt of a nonnegative int64 array —
+    matches ``math.isqrt`` (np.sqrt alone can round across the
+    perfect-square boundary)."""
+    r = np.sqrt(x.astype(np.float64)).astype(np.int64)
+    r -= r * r > x
+    r += (r + 1) * (r + 1) <= x
+    return r
 
 
 class PacketDesc(object):
@@ -121,11 +157,11 @@ class SimpleFormat(_FormatBase):
         return PacketDesc(seq=seq, src=0, nsrc=1, nchan=1,
                           payload=buf[self.header_size:])
 
-    def decode_batch(self, arr):
+    def decode_batch(self, arr, length=None):
         """Vectorized header decode for a (npkt, pkt_bytes) uint8 array
         (recvmmsg batch).  Returns (seqs, srcs, payload_offset)."""
-        seqs = arr[:, :8].copy().view('>u8').astype(np.int64).ravel()
-        return seqs, np.zeros(len(arr), np.int64), self.header_size
+        return _field(arr, 0, '>u8'), np.zeros(len(arr), np.int64), \
+            self.header_size
 
 
 class ChipsFormat(_FormatBase):
@@ -137,6 +173,10 @@ class ChipsFormat(_FormatBase):
 
     name = 'chips'
     header_struct = struct.Struct('>BBBBBBHQ')
+    #: (byte offset, wire bias) of a single-byte source id usable for
+    #: deterministic REUSEPORT steering: worker = (byte - bias) & mask
+    #: (udp_socket.attach_reuseport_cbpf)
+    SRC_STEER_BYTE = (0, 1)
 
     def pack(self, desc, framecount=0):
         # mirror CHIPSHeaderFiller (chips.hpp:169-183)
@@ -155,11 +195,11 @@ class ChipsFormat(_FormatBase):
                           tuning=gbe, nchan=nchan, chan0=chan0,
                           payload=buf[self.header_size:])
 
-    def decode_batch(self, arr):
-        """Vectorized header decode (see SimpleFormat.decode_batch)."""
-        seqs = arr[:, 8:16].copy().view('>u8').astype(np.int64).ravel() - 1
-        srcs = arr[:, 0].astype(np.int64) - 1
-        return seqs, srcs, self.header_size
+    def decode_batch(self, arr, length=None):
+        """Vectorized header decode (see SimpleFormat.decode_batch) —
+        wire seq and roach are 1-based, exactly like unpack."""
+        return _field(arr, 8, '>u8') - 1, \
+            arr[:, 0].astype(np.int64) - 1, self.header_size
 
 
 class PBeamFormat(_FormatBase):
@@ -204,6 +244,18 @@ class PBeamFormat(_FormatBase):
                           chan0=chan0 - nchan * src,
                           payload=buf[self.header_size:])
 
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack: src composes the
+        1-based wire (beam, server) pair with src0 applied in wire-beam
+        units (pbeam.hpp:70), seq divides the wire timestamp by navg."""
+        server = arr[:, 0].astype(np.int64)
+        beam = arr[:, 1].astype(np.int64)
+        nserver = np.maximum(arr[:, 5].astype(np.int64), 1)
+        navg = np.maximum(_field(arr, 6, '>u2'), 1)
+        wseq = _field(arr, 10, '>u8')
+        srcs = (beam - self.src0) * nserver + (server - 1)
+        return wseq // navg, srcs, self.header_size
+
 
 class TbnFormat(_FormatBase):
     """LWA TBN frames, 1048 bytes total (reference: src/formats/tbn.hpp).
@@ -216,6 +268,7 @@ class TbnFormat(_FormatBase):
     constructor parameter."""
 
     name = 'tbn'
+    frame_size = TBN_FRAME_SIZE
     header_struct = struct.Struct('<I')
     _rest = struct.Struct('>IIHHQ')
     seq_quantum = 512
@@ -250,6 +303,25 @@ class TbnFormat(_FormatBase):
             gain=gain, valid_mode=(tbn_id >> 15) & 1,
             decimation=self.decimation, sync=sync, nchan=1,
             payload=buf[self.header_size:])
+
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack's gates: frame size must
+        be exactly 1048, sync word must match, and the TBN-mode bit
+        (tbn_id bit 15 — the engine's valid_mode reject) marks the row
+        invalid.  ``length`` is the true datagram size when ``arr`` is
+        padded to a receive stride (or truncated to a header sidecar)."""
+        tbn_id = _field(arr, 12, '>u2')
+        time_tag = _field(arr, 16, '>u8')
+        seqs = time_tag // self.decimation // self.seq_quantum
+        srcs = (tbn_id & 1023) - 1
+        if (arr.shape[1] if length is None else length) \
+                != TBN_FRAME_SIZE:
+            valid = np.zeros(len(arr), bool)
+        else:
+            valid = np.equal(_field_raw(arr, 0, '<u4'),
+                             np.uint32(SYNC_WORD))
+            valid &= ((tbn_id >> 15) & 1) == 0
+        return seqs, srcs, self.header_size, valid
 
 
 class DrxFormat(_FormatBase):
@@ -308,6 +380,25 @@ class DrxFormat(_FormatBase):
             desc.tuning1 = tuning_word
         return desc
 
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack (drx8 inherits with its
+        own frame_size/seq_quantum): src composes the wire id byte's
+        tuning and pol bits; the reserved bit (valid_mode) rejects."""
+        pkt_id = arr[:, 4].astype(np.int64)
+        decim = np.maximum(_field(arr, 12, '>u2'), 1)
+        time_tag = _field(arr, 16, '>u8') - _field(arr, 14, '>u2')
+        tune = ((pkt_id >> 3) & 0x7) - 1
+        srcs = (tune << 1) | ((pkt_id >> 7) & 0x1)
+        seqs = time_tag // decim // self.seq_quantum
+        if (arr.shape[1] if length is None else length) \
+                != self.frame_size:
+            valid = np.zeros(len(arr), bool)
+        else:
+            valid = np.equal(_field_raw(arr, 0, '<u4'),
+                             np.uint32(SYNC_WORD))
+            valid &= ((pkt_id >> 6) & 0x1) == 0
+        return seqs, srcs, self.header_size, valid
+
 
 class Drx8Format(DrxFormat):
     """DRX with 8+8-bit samples, 8224 bytes total (reference:
@@ -354,6 +445,12 @@ class IBeamFormat(_FormatBase):
                           tuning=gbe, nchan=nchan,
                           chan0=chan0 - nchan * src,
                           payload=buf[self.header_size:])
+
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack — wire seq and server
+        are 1-based, exactly like chips."""
+        return _field(arr, 7, '>u8') - 1, \
+            arr[:, 0].astype(np.int64) - 1, self.header_size
 
 
 class CorFormat(_FormatBase):
@@ -428,6 +525,27 @@ class CorFormat(_FormatBase):
             tuning=(nserver << 8) | max(server - 1, 0), gain=gain,
             sync=sync, payload=pld)
 
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack: src enumerates the
+        (baseline, server) pair from the 1-based wire stands, with the
+        stand count recovered from this codec's nsrc per packet (the
+        per-packet nserver rides the frame-count word) and src0
+        applied in baseline units (cor.hpp:77)."""
+        fcw = _field(arr, 4, '>u4')
+        time_tag = _field(arr, 16, '>u8')
+        navg = np.maximum(_field(arr, 24, '>u4'), 1)
+        stand0 = _field(arr, 28, '>u2') - 1
+        stand1 = _field(arr, 30, '>u2') - 1
+        nserver = np.maximum((fcw >> 8) & 0xFF, 1)
+        server = fcw & 0xFF
+        nstand = (_isqrt(8 * (self.nsrc // nserver) + 1) - 1) // 2
+        srcs = (stand0 * (2 * (nstand - 1) + 1 - stand0) // 2 +
+                stand1 + 1 - self.src0) * nserver + (server - 1)
+        seqs = time_tag // 196000000 // np.maximum(navg // 100, 1)
+        valid = np.equal(_field_raw(arr, 0, '<u4'),
+                         np.uint32(SYNC_WORD))
+        return seqs, srcs, self.header_size, valid
+
 
 class Snap2Format(_FormatBase):
     """SNAP2 F-engine packets (reference: src/formats/snap2.hpp:50-60).
@@ -468,6 +586,18 @@ class Snap2Format(_FormatBase):
             npol=npol, npol_tot=npol_tot, pol0=pol0,
             src=pol0 // npol + chan_block_id * npol_blocks,
             payload=buf[self.header_size:])
+
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack: src composes the pol
+        block with the channel block id."""
+        seqs = _field(arr, 0, '>u8')
+        npol = np.maximum(_field(arr, 12, '>u2'), 1)
+        npol_tot = _field(arr, 14, '>u2')
+        chan_block_id = _field(arr, 20, '>u4')
+        pol0 = _field(arr, 28, '>u4')
+        srcs = pol0 // npol + chan_block_id * \
+            np.maximum(npol_tot // npol, 1)
+        return seqs, srcs, self.header_size
 
 
 class VdifFormat(_FormatBase):
@@ -553,6 +683,28 @@ class VdifFormat(_FormatBase):
             tuning=(ref_epoch << 16) | (nbit << 8) | is_complex,
             payload=pld)
 
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack: the invalid bit rejects
+        the row; the legacy bit selects the 16- vs 32-byte payload
+        offset.  A batch MIXING legacy and non-legacy framing has no
+        single payload offset — raise ValueError so the engine falls
+        back to per-packet decode for that batch."""
+        w0 = _field(arr, 0, '<u4')
+        w1 = _field(arr, 4, '<u4')
+        w3 = _field(arr, 12, '<u4')
+        legacy = (w0 >> 30) & 1
+        if int(legacy.min()) != int(legacy.max()):
+            raise ValueError(
+                'VDIF batch mixes legacy and non-legacy framing: no '
+                'uniform payload offset')
+        off = self.header_struct.size + \
+            (0 if legacy[0] else self.ext_struct.size)
+        seqs = (w0 & 0x3FFFFFFF) * self.frames_per_second + \
+            (w1 & 0xFFFFFF)
+        srcs = (w3 >> 16) & 0x3FF
+        valid = (w0 & 0x80000000) == 0
+        return seqs, srcs, int(off), valid
+
 
 class TbfFormat(_FormatBase):
     """LWA TBF buffered-voltage frames (reference: src/formats/tbf.hpp
@@ -589,6 +741,14 @@ class TbfFormat(_FormatBase):
         return PacketDesc(seq=time_tag, time_tag=time_tag,
                           src=first_chan, nsrc=nstand, sync=sync,
                           payload=buf[self.header_size:])
+
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack: seq IS the time tag and
+        src rides the first_chan field."""
+        valid = np.equal(_field_raw(arr, 0, '<u4'),
+                         np.uint32(SYNC_WORD))
+        return _field(arr, 16, '>u8'), _field(arr, 12, '>u2'), \
+            self.header_size, valid
 
 
 class VBeamFormat(_FormatBase):
@@ -632,6 +792,14 @@ class VBeamFormat(_FormatBase):
         return PacketDesc(seq=time_tag, time_tag=sync_time,
                           nchan=max(nchan, 1), chan0=chan0, npol=npol,
                           payload=buf[self.header_size:])
+
+    def decode_batch(self, arr, length=None):
+        """Vectorized decode mirroring unpack: single-source stream,
+        seq from the big-endian time tag, gated on the 64-bit sync."""
+        valid = np.equal(_field_raw(arr, 0, '<u8'),
+                         np.uint64(self.SYNC))
+        return _field(arr, 16, '>u8'), np.zeros(len(arr), np.int64), \
+            self.header_size, valid
 
 
 FORMATS = {}

@@ -117,6 +117,10 @@ class _mmsghdr(ctypes.Structure):
 
 
 _MSG_DONTWAIT = 0x40
+#: pass MSG_TRUNC in recvmmsg flags so msg_len reports each datagram's
+#: TRUE length even when the iovecs are smaller (runt/oversize
+#: detection on the zero-copy scatter path)
+_MSG_TRUNC = 0x20
 
 _libc = None
 
@@ -161,9 +165,22 @@ class Address(object):
 class UDPSocket(object):
     """Thin RAII UDP socket (reference: python/bifrost/udp_socket.py)."""
 
-    def __init__(self):
+    def __init__(self, reuseport=False):
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # SO_REUSEPORT lets N capture workers bind the SAME addr:port,
+        # with the kernel flow-hashing datagrams across their private
+        # queues (the sharded-capture fan-out, docs/networking.md).
+        # Best-effort: callers check .reuseport before relying on the
+        # exclusive-queue property.
+        self.reuseport = False
+        if reuseport:
+            try:
+                self.sock.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_REUSEPORT, 1)
+                self.reuseport = True
+            except (AttributeError, OSError):
+                pass
         try:
             self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
                                  1 << 22)
@@ -171,9 +188,49 @@ class UDPSocket(object):
             pass
         self._timeout = None
 
+    @classmethod
+    def from_fd(cls, fd):
+        """Wrap a dup() of an existing socket fd: shares the SAME
+        kernel receive queue but carries its own Python-side state
+        (mmsg buffer caches, timeout) — the sharded capture's
+        N-threads-one-socket fallback needs private per-worker receive
+        buffers even when the queue is shared."""
+        obj = cls.__new__(cls)
+        obj.sock = socket.socket(fileno=os.dup(fd))
+        obj.reuseport = False
+        obj._timeout = None
+        return obj
+
     def bind(self, addr):
         self.sock.bind(addr.sockaddr)
         return self
+
+    def attach_reuseport_cbpf(self, insns):
+        """Attach a classic-BPF selector to this socket's REUSEPORT
+        group: the kernel runs the program over each datagram's UDP
+        payload and the return value picks the group member (by join
+        order) that receives it.  Deterministic steering — e.g. by a
+        source-id byte in the packet header — replaces the default
+        4-tuple flow hash, so a multi-worker capture can pin each
+        wire source to one worker's queue regardless of what ports
+        the senders happen to use.  ``insns`` is a list of
+        (code, jt, jf, k) classic-BPF instructions; raises OSError
+        when the kernel rejects the program."""
+        class _Filter(ctypes.Structure):
+            _fields_ = [('code', ctypes.c_uint16),
+                        ('jt', ctypes.c_uint8),
+                        ('jf', ctypes.c_uint8),
+                        ('k', ctypes.c_uint32)]
+
+        class _Fprog(ctypes.Structure):
+            _fields_ = [('len', ctypes.c_uint16),
+                        ('filter', ctypes.POINTER(_Filter))]
+        arr = (_Filter * len(insns))(*[_Filter(*i) for i in insns])
+        prog = _Fprog(len(insns), arr)
+        SO_ATTACH_REUSEPORT_CBPF = getattr(
+            socket, 'SO_ATTACH_REUSEPORT_CBPF', 51)
+        self.sock.setsockopt(socket.SOL_SOCKET,
+                             SO_ATTACH_REUSEPORT_CBPF, bytes(prog))
 
     def connect(self, addr):
         self.sock.connect(addr.sockaddr)
@@ -243,6 +300,79 @@ class UDPSocket(object):
         if n == 0:
             return None, None
         return memoryview(bufs), [hdrs[i].msg_len for i in range(n)]
+
+    # -- zero-copy split scatter -------------------------------------------
+    def _scatter_setup(self, vlen, head_size, pay_size):
+        sidecar = ctypes.create_string_buffer(vlen * head_size)
+        iovecs = (_iovec * (2 * vlen))()
+        hdrs = (_mmsghdr * vlen)()
+        sbase = ctypes.addressof(sidecar)
+        iov_size = ctypes.sizeof(_iovec)
+        for i in range(vlen):
+            iovecs[2 * i].iov_base = sbase + i * head_size
+            iovecs[2 * i].iov_len = head_size
+            iovecs[2 * i + 1].iov_base = None
+            iovecs[2 * i + 1].iov_len = pay_size
+            hdrs[i].msg_hdr.msg_name = None
+            hdrs[i].msg_hdr.msg_namelen = 0
+            hdrs[i].msg_hdr.msg_iov = ctypes.cast(
+                ctypes.byref(iovecs, 2 * i * iov_size),
+                ctypes.POINTER(_iovec))
+            hdrs[i].msg_hdr.msg_iovlen = 2
+            hdrs[i].msg_hdr.msg_control = None
+            hdrs[i].msg_hdr.msg_controllen = 0
+        # numpy view over the iovec table: an _iovec is two native
+        # words, so (2*vlen, 2) uint64 — column 0 of the odd rows holds
+        # the payload pointers, poked VECTORIZED per batch
+        import numpy as _np
+        iov_np = _np.frombuffer(iovecs, dtype=_np.uint64).reshape(
+            2 * vlen, 2)
+        self._scat = (vlen, head_size, pay_size, sidecar, iovecs,
+                      hdrs, iov_np)
+
+    def recv_mmsg_scatter(self, addrs, head_size, pay_size):
+        """Consume up to ``len(addrs)`` datagrams in ONE ``recvmmsg``,
+        SPLITTING each across two iovecs: the wire header lands in an
+        internal per-socket sidecar buffer (``head_size`` bytes per
+        row) and the payload lands DIRECTLY at the caller-supplied
+        memory address ``addrs[i]`` (``pay_size`` bytes capacity) — no
+        staging copy; this is the zero-copy capture scatter
+        (docs/networking.md "Wire-rate capture").
+
+        ``addrs`` is a uint64 array/sequence of raw destination
+        addresses the caller guarantees exclusive and alive across the
+        call (the capture engine's span-cell claims).  Nonblocking:
+        the caller selects for readability first.  Returns
+        ``(sidecar_memoryview, lengths)`` where ``lengths`` are TRUE
+        datagram lengths (``MSG_TRUNC``: a length != the expected
+        frame size marks a runt/oversize whose payload cell must be
+        repaired), or ``(None, None)`` when nothing was queued."""
+        vlen = len(addrs)
+        sc = getattr(self, '_scat', None)
+        if sc is None or sc[0] < vlen or sc[1] != head_size or \
+                sc[2] != pay_size:
+            self._scatter_setup(max(vlen, sc[0] if sc else 0),
+                                head_size, pay_size)
+            sc = self._scat
+        _, _, _, sidecar, _, hdrs, iov_np = sc
+        import numpy as _np
+        iov_np[1:2 * vlen:2, 0] = _np.asarray(addrs, _np.uint64)
+
+        def _drain():
+            n = _get_libc().recvmmsg(
+                self.sock.fileno(), hdrs, vlen,
+                _MSG_DONTWAIT | _MSG_TRUNC, None)
+            if n < 0:
+                err = ctypes.get_errno()
+                if err in (errno_mod.EAGAIN, errno_mod.EWOULDBLOCK):
+                    return 0
+                raise OSError(err, 'recvmmsg (scatter) failed')
+            return n
+
+        n = retry_transient(_drain)
+        if n == 0:
+            return None, None
+        return memoryview(sidecar), [hdrs[i].msg_len for i in range(n)]
 
     def recv_mmsg(self, vlen, pkt_size):
         """recv_mmsg_raw + per-packet memoryview slicing (slices are

@@ -416,16 +416,24 @@ class _UdpCapturePump(object):
     def __init__(self, src, tenant_id):
         from .ring import Ring
         from .io.udp_socket import Address, UDPSocket
-        from .io.packet_capture import (UDPCapture,
+        from .io.packet_capture import (UDPCapture, ShardedUDPCapture,
                                         PacketCaptureCallback)
         nsrc = int(src.get('nsrc', 1))
         payload = int(src.get('payload', 1024))
         buf_ntime = int(src.get('buffer_ntime', 64))
+        # sharded wire-rate capture knobs (docs/networking.md):
+        # capture_threads > 1 builds a ShardedUDPCapture with that many
+        # REUSEPORT workers; capture_vlen sizes its recvmmsg batches
+        nthreads = int(src.get('capture_threads', 1))
+        vlen = src.get('capture_vlen')
+        timeout = float(src.get('timeout_s', 0.25))
         addr = Address(src.get('address', '0.0.0.0'),
                        int(src.get('port', 0)))
-        self._sock = UDPSocket().bind(addr)
-        self._sock.set_timeout(float(src.get('timeout_s', 0.25)))
-        self.port = self._sock.sock.getsockname()[1]
+        if nthreads > 1:
+            self._sock = None
+        else:
+            self._sock = UDPSocket().bind(addr)
+            self._sock.set_timeout(timeout)
         self.ring = Ring(space='system',
                          name='tenant.%s.capture' % tenant_id)
 
@@ -438,9 +446,18 @@ class _UdpCapturePump(object):
                                    'units': [None] * 3}}
         cb = PacketCaptureCallback()
         cb.set_chips(_hdr)
-        self._capture = UDPCapture(src.get('format', 'chips'),
-                                   self._sock, self.ring, nsrc, 0,
-                                   payload, buf_ntime, buf_ntime, cb)
+        if nthreads > 1:
+            self._capture = ShardedUDPCapture(
+                src.get('format', 'chips'), addr, self.ring, nsrc, 0,
+                payload, buf_ntime, buf_ntime, cb, nthreads=nthreads,
+                vlen=int(vlen) if vlen else None, timeout=timeout)
+            self.port = \
+                self._capture._socks[0].sock.getsockname()[1]
+        else:
+            self._capture = UDPCapture(src.get('format', 'chips'),
+                                       self._sock, self.ring, nsrc, 0,
+                                       payload, buf_ntime, buf_ntime, cb)
+            self.port = self._sock.sock.getsockname()[1]
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._pump, name='bf-serve-udp-%s' % tenant_id,
@@ -474,10 +491,11 @@ class _UdpCapturePump(object):
                 self._capture.end()
             except Exception:
                 pass
-        try:
-            self._sock.close()
-        except Exception:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except Exception:
+                pass
 
 
 def _build_source(spec, job):

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Wire-rate capture flagship gate: the sharded zero-copy UDP ingest
+tier must sustain its packets/s ladder with an EXACT loss ledger and
+byte-exact ring contents — this publishes the BENCH_CAPTURE_*.json
+artifact series.
+
+Runs bench_suite config 23 (bench_suite.bench_capture_wire_rate: a
+paced loopback blaster drives a rate ladder into two paired arms —
+the sharded zero-copy engine and the staged single-thread engine —
+with alien/late packets injected mid-ladder) in a fresh subprocess
+pinned to the CPU backend, and asserts:
+
+- ``byte_identical``     — every ring cell equals the regenerated
+  blaster oracle (zero-copy scatter is a data-path optimization,
+  never a data change);
+- ``ledger_exact``       — on every run of both arms,
+  good + missing == the span grid and
+  good == received - late - alien - dup - invalid (every received
+  packet is accounted), with the injected alien count matched
+  exactly;
+- ``sustained_nonzero``  — each run held at least one rung under the
+  loss ceiling (<1% by default);
+- ``zero_copy_win``      — the zero-copy sharded arm's paired-median
+  sustained pps beats the staged single-thread arm's.
+
+Exit codes: 0 pass, 3 a gate condition failed, 2 the bench failed to
+produce a result.  ``tools/watch_and_bench.sh`` runs this after the
+FDMT gate (``BF_SKIP_CAPTURE_GATE=1`` opts out).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config23(timeout=1800):
+    """One bench_suite --config 23 subprocess on the CPU backend;
+    returns its result dict."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # global capture knobs would skew the paired arm comparison — the
+    # bench sets its own thread/vlen/zero-copy configuration
+    env.pop('BF_CAPTURE_THREADS', None)
+    env.pop('BF_CAPTURE_VLEN', None)
+    env.pop('BF_CAPTURE_ZERO_COPY', None)
+    env.pop('BF_NO_NATIVE_CAPTURE', None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'bench_suite.py'),
+         '--config', '23'],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=timeout)
+    for line in out.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and 'capture' in d:
+            return d
+        if isinstance(d, dict) and d.get('error'):
+            raise RuntimeError('config 23 failed: %s' % d['error'])
+    raise RuntimeError(
+        'config 23 produced no result (rc=%d):\n%s\n%s'
+        % (out.returncode, out.stdout[-1000:], out.stderr[-1000:]))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    round_ = os.environ.get('BF_BENCH_ROUND', 'cpu')
+    ap.add_argument('--out', default='BENCH_CAPTURE_%s.json' % round_,
+                    help='artifact path (full config-23 result + '
+                         'verdict)')
+    ap.add_argument('--timeout', type=float, default=1800.0,
+                    help='bench subprocess timeout in seconds')
+    args = ap.parse_args()
+
+    try:
+        res = run_config23(timeout=args.timeout)
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        print('capture_gate: bench failed: %s' % exc, file=sys.stderr)
+        return 2
+
+    cap = res.get('capture', {})
+    led = cap.get('ledger', {})
+    byte_ok = bool(cap.get('byte_identical'))
+    ledger_ok = bool(cap.get('all_runs_exact')) and \
+        bool(led.get('alien_exact'))
+    sustained_ok = int(cap.get('pps', 0)) > 0 and \
+        int(cap.get('pps_staged_single', 0)) > 0
+    win = float(cap.get('paired_median_win', 0.0))
+    win_ok = win > 1.0
+    ok = byte_ok and ledger_ok and sustained_ok and win_ok
+    artifact = dict(res,
+                    gate={'byte_identical': byte_ok,
+                          'ledger_exact': ledger_ok,
+                          'sustained_nonzero': sustained_ok,
+                          'zero_copy_win': win_ok,
+                          'pass': ok,
+                          'round': os.environ.get('BF_BENCH_ROUND',
+                                                  '')})
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write('\n')
+    print('capture_gate: %d pps / %.3f Gbit/s sustained at '
+          'loss_frac=%s (staged single %d pps, paired-median win '
+          '%.3f, %d zero-copy pkts), late=%s alien=%s '
+          'byte_identical=%s ledger_exact=%s %s'
+          % (cap.get('pps', -1), cap.get('gbps', -1),
+             cap.get('loss_frac'), cap.get('pps_staged_single', -1),
+             win, cap.get('zero_copy_pkts', -1), led.get('nlate'),
+             led.get('nalien'), byte_ok, ledger_ok,
+             'PASS' if ok else 'FAIL'))
+    return 0 if ok else 3
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -51,6 +51,18 @@ def get_transmit_receive():
                          'invalid': st.get('ninvalid', 0),
                          'ignored': st.get('nignored', 0),
                          'npackets': st.get('npackets', 0)}
+                # sharded capture engines publish per-worker counters
+                # (workerN_npackets/_nbytes/_zero_copy)
+                workers, i = [], 0
+                while ('worker%d_npackets' % i) in st:
+                    workers.append({
+                        'npackets': st['worker%d_npackets' % i],
+                        'nbytes': st.get('worker%d_nbytes' % i, 0),
+                        'zero_copy':
+                            st.get('worker%d_zero_copy' % i, 0)})
+                    i += 1
+                if workers:
+                    entry['workers'] = workers
             elif 'nbytes' in st:
                 kind = 'tx'
                 entry = {'good': st.get('nbytes', 0), 'missing': 0,
@@ -98,12 +110,21 @@ def get_statistics(curr_list, prev_list):
         agg['prate'] += max(0.0, prate)
         agg['gloss'] = max(agg['gloss'], gloss)
         agg['closs'] = max(agg['closs'], closs)
+        workers = []
+        for i, w in enumerate(curr.get('workers', [])):
+            wprev = (prev or {}).get('workers', [])
+            wrate = 0.0
+            if i < len(wprev) and prev is not None and \
+                    curr['time'] > prev['time']:
+                wrate = (w['npackets'] - wprev[i]['npackets']) / \
+                    (curr['time'] - prev['time'])
+            workers.append(dict(w, prate=max(0.0, wrate)))
         agg['blocks'].append({
             'name': curr['name'], 'good': curr['good'],
             'missing': curr['missing'], 'invalid': curr['invalid'],
             'ignored': curr['ignored'], 'drate': max(0.0, drate),
             'prate': max(0.0, prate), 'gloss': gloss, 'closs': closs,
-            'bridge': curr.get('bridge', False)})
+            'bridge': curr.get('bridge', False), 'workers': workers})
     return out
 
 
@@ -155,6 +176,14 @@ def render_pid(pid, stats, history, width=78):
             out.append('  %-28s %12d %12d %9d %9d %5.1f%s%s'
                        % (b['name'][:28], b['good'], b['missing'],
                           b['invalid'], b['ignored'], bv, bu[0], tag))
+            for i, w in enumerate(b.get('workers', [])):
+                zc_pct = 100.0 * w['zero_copy'] / w['npackets'] \
+                    if w['npackets'] else 0.0
+                wv, wu = set_units(w['nbytes'])
+                out.append('    worker%-2d %10d pkts %8.1f %-4s '
+                           '%8.1f pkt/s  zero-copy %5.1f%%'
+                           % (i, w['npackets'], wv, wu.rstrip('/s'),
+                              w['prate'], zc_pct))
         hist = history.get((pid, kind))
         if hist:
             out.append('  history (%ds):' % len(hist))

@@ -174,7 +174,7 @@ def get_device_memory_usage(timeout=10.0):
 
 
 def collect_blocks(pids=None, autotune=None, health=None, fabric=None,
-                   tenants=None, sched=None):
+                   tenants=None, sched=None, captures=None):
     """Per-block rows across pipelines: pid/name/cmd/core and the perf
     times (reference: like_top.py:305-330).  Pass a dict as
     ``autotune`` to collect each process's ``analysis/autotune`` knob
@@ -182,9 +182,12 @@ def collect_blocks(pids=None, autotune=None, health=None, fabric=None,
     (docs/robustness.md) — as ``fabric`` its ``fabric/health``
     membership/end-to-end row (docs/fabric.md) — as ``tenants``
     its ``service/tenants`` multi-tenant pane (docs/service.md) —
-    and as ``sched`` its ``sched/placements`` control-plane row
-    (docs/scheduler.md) — from the SAME proclog walk (a separate
-    collect pass would re-parse every proclog file per refresh).
+    as ``sched`` its ``sched/placements`` control-plane row
+    (docs/scheduler.md) — and as ``captures`` the per-worker counters
+    of any sharded capture engine (``workerN_npackets`` keys in a
+    capture stats block; docs/networking.md "Wire-rate capture") —
+    from the SAME proclog walk (a separate collect pass would
+    re-parse every proclog file per refresh).
     ``pids`` entries may be bare PIDs or fabric instance strings
     (``<pid>@<host>.<role>``)."""
     rows = {}
@@ -214,6 +217,25 @@ def collect_blocks(pids=None, autotune=None, health=None, fabric=None,
         for block, logs in contents.items():
             if block == 'rings':
                 continue
+            st = logs.get('stats')
+            if captures is not None and st and \
+                    'worker0_npackets' in st:
+                workers, i = [], 0
+                while ('worker%d_npackets' % i) in st:
+                    workers.append(
+                        {'npackets': _num(st['worker%d_npackets' % i]),
+                         'nbytes':
+                             _num(st.get('worker%d_nbytes' % i, 0)),
+                         'zero_copy':
+                             _num(st.get('worker%d_zero_copy' % i,
+                                         0))})
+                    i += 1
+                captures.setdefault(pid, []).append(
+                    {'name': block, 'workers': workers,
+                     'npackets': _num(st.get('npackets', 0)),
+                     'ngood_bytes': _num(st.get('ngood_bytes', 0)),
+                     'nlate': _num(st.get('nlate', 0)),
+                     'nalien': _num(st.get('nalien', 0))})
             core = logs.get('bind', {}).get('core0', -1)
             perf = logs.get('perf', {})
             if not perf and 'bind' not in logs:
@@ -275,7 +297,8 @@ def collect_autotune(pids=None):
 
 def render_text(load, cpu, mem, dev, rows, tuners=None,
                 sort_key='process', sort_rev=True, width=140,
-                health=None, fabric=None, tenants=None, sched=None):
+                health=None, fabric=None, tenants=None, sched=None,
+                captures=None):
     """Render the full display as text lines (shared by --once and the
     curses loop)."""
     host = socket.gethostname()
@@ -416,6 +439,28 @@ def render_text(load, cpu, mem, dev, rows, tuners=None,
                                             else ''))
             out.append('   ' + '  '.join(placed)
                        [:max(width - 3, 0)])
+    # sharded capture worker pane (capture stats ProcLog with
+    # workerN_* counters — docs/networking.md "Wire-rate capture"):
+    # one row per worker with its packet/byte share and what fraction
+    # of its packets took the zero-copy scatter path — a zero-copy
+    # share collapsing toward 0%% on a fixed-frame format means the
+    # engaged fast path silently disengaged (every packet then pays
+    # the staging copy again)
+    for pid in sorted(captures or {}, key=str):
+        for cb in captures[pid]:
+            out.append('')
+            out.append('[capture] pid %s  %s  %d worker(s)  '
+                       '%d pkts  late %d  alien %d'
+                       % (pid, cb['name'].split('/')[-1][:28],
+                          len(cb['workers']), int(cb['npackets']),
+                          int(cb['nlate']), int(cb['nalien'])))
+            for i, w in enumerate(cb['workers']):
+                zc_pct = (100.0 * w['zero_copy'] / w['npackets']) \
+                    if w['npackets'] else 0.0
+                out.append('   worker%-2d %12d pkts %14d bytes  '
+                           'zero-copy %5.1f%%'
+                           % (i, int(w['npackets']), int(w['nbytes']),
+                              zc_pct))
     # live auto-tuner knob panel (analysis/autotune ProcLog, fed by
     # the autotune.* counters — docs/autotune.md)
     for pid in sorted(tuners or {}, key=str):
@@ -585,20 +630,23 @@ def run_curses(args):
             now = time.time()
             if now - t_last > args.interval or state is None:
                 tuners, health, fab, tens, schd = {}, {}, {}, {}, {}
+                caps = {}
                 state = (get_load_average(), get_processor_usage(),
                          get_memory_swap_usage(),
                          get_device_memory_usage() if args.devices
                          else None,
                          collect_blocks(autotune=tuners,
                                         health=health, fabric=fab,
-                                        tenants=tens, sched=schd),
-                         tuners, health, fab, tens, schd)
+                                        tenants=tens, sched=schd,
+                                        captures=caps),
+                         tuners, health, fab, tens, schd, caps)
                 t_last = now
             maxy, maxx = scr.getmaxyx()
             lines = render_text(*state[:6], sort_key=sort_key,
                                 sort_rev=sort_rev, width=maxx,
                                 health=state[6], fabric=state[7],
-                                tenants=state[8], sched=state[9])
+                                tenants=state[8], sched=state[9],
+                                captures=state[10])
             for y, line in enumerate(lines[:maxy - 1]):
                 attr = curses.A_REVERSE if line.startswith('   PID') \
                     else curses.A_NORMAL
@@ -650,14 +698,15 @@ def main():
         get_processor_usage()        # prime the delta state
         time.sleep(0.05)
         tuners, health, fab, tens, schd = {}, {}, {}, {}, {}
+        caps = {}
         lines = render_text(
             get_load_average(), get_processor_usage(),
             get_memory_swap_usage(),
             get_device_memory_usage() if args.devices else None,
             collect_blocks(autotune=tuners, health=health, fabric=fab,
-                           tenants=tens, sched=schd),
+                           tenants=tens, sched=schd, captures=caps),
             tuners, sort_key=args.sort, health=health, fabric=fab,
-            tenants=tens, sched=schd)
+            tenants=tens, sched=schd, captures=caps)
         print('\n'.join(lines))
         return 0
     run_curses(args)

@@ -36,6 +36,10 @@ DIRECTION is bad:
     fdmt.candidates_per_s     lower      10%%
     segment.overlap_carried   lower      any decrease (halo carry
                                          silently disengaged)
+    capture.pps /
+      capture.gbps            lower      10%% (zero-copy batched
+                                         capture path disengaged)
+    capture.loss_frac         higher     +0.005 absolute
 
 Unmatched numeric keys are compared informationally (reported at
 >50%% drift, never flagged).  Exit code 0 = no regressions (advisory
@@ -96,6 +100,14 @@ WATCHLIST = [
     # (no trailing glob: 'replacements_refused' DROPPING is fine)
     ('*scheduler.migrations', 'lower', 'any', 0.0),
     ('*scheduler.replacements', 'lower', 'any', 0.0),
+    # wire-rate capture flagship (BENCH_CAPTURE, config 23): sustained
+    # ingest rate of the sharded zero-copy engine — a pps/gbps drop
+    # between same-config rounds usually means the zero-copy batched
+    # path silently disengaged (every packet still arrives, each just
+    # pays the staging copy again); loss_frac is gated absolutely
+    ('*capture.pps*', 'lower', 'pct', 10.0),
+    ('*capture.gbps*', 'lower', 'pct', 10.0),
+    ('*capture.loss_frac*', 'higher', 'abs', 0.005),
     ('*crc_errors*', 'higher', 'any', 0.0),
     ('*reconnects*', 'higher', 'any', 0.0),
     ('*fallback*', 'higher', 'any', 0.0),

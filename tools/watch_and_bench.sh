@@ -410,6 +410,21 @@ for i in $(seq 1 400); do
           exit "$frc"
         fi
       fi
+      # Wire-rate capture flagship gate: config 23 — the sharded
+      # zero-copy UDP engine must sustain its loopback rate ladder at
+      # <1% loss with an exact loss ledger, ring contents byte-equal
+      # to the blaster oracle, and a paired-median win over the
+      # staged single-thread arm.  Writes BENCH_CAPTURE_${ROUND}.json.
+      if [ "${BF_SKIP_CAPTURE_GATE:-0}" != "1" ]; then
+        echo "$(date -u +%FT%TZ) wire-rate capture gate (config 23)" >> "$LOG"
+        python tools/capture_gate.py --out "BENCH_CAPTURE_${ROUND}.json" >> "$LOG" 2>&1
+        crc=$?
+        echo "$(date -u +%FT%TZ) capture gate rc=$crc" >> "$LOG"
+        if [ "$crc" -ne 0 ]; then
+          echo "$(date -u +%FT%TZ) wire-rate capture gate FAILED" >> "$LOG"
+          exit "$crc"
+        fi
+      fi
       exit 0
     fi
     # never leave a truncated artifact where round automation could
